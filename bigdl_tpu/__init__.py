@@ -1,0 +1,22 @@
+"""bigdl_tpu — a TPU-native deep learning framework.
+
+A ground-up re-design of the capabilities of the reference framework
+(barakb/BigDL: Scala/Spark + MKL CPU engine) for TPU hardware:
+
+- compute: jax/jnp under XLA:TPU (+ Pallas kernels for fused hot ops)
+- modules: pytree-functional `Module` with `init`/`apply` (the reference's
+  `AbstractModule.forward/backward` becomes pure functions + `jax.grad`)
+- distribution: `jax.sharding.Mesh` + collectives over ICI/DCN (the
+  reference's Spark BlockManager parameter plane becomes
+  `psum_scatter` → sharded optimizer → `all_gather`, i.e. the same
+  ZeRO-1 shape executed on-device)
+- data: host-side Python/C++ input pipeline with per-host sharding
+
+Reference parity map: see SURVEY.md at the repo root.
+"""
+
+__version__ = "0.1.0"
+
+from bigdl_tpu.utils.engine import Engine
+
+__all__ = ["Engine", "__version__"]

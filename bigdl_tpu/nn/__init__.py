@@ -1,0 +1,49 @@
+"""bigdl_tpu.nn — the module/criterion library.
+
+Reference parity: bigdl/nn/ (see SURVEY.md §2.2). Import everything from
+here: ``from bigdl_tpu import nn; nn.Sequential().add(nn.Linear(2, 3))``.
+"""
+
+from bigdl_tpu.nn.module import Module, Criterion
+from bigdl_tpu.nn.container import (
+    Container, Sequential, Concat, ConcatTable, ParallelTable, MapTable, Bottle,
+)
+from bigdl_tpu.nn.graph import Graph, Input, Node
+from bigdl_tpu.nn.initialization import (
+    InitializationMethod, Xavier, MsraFiller, RandomUniform, RandomNormal,
+    Zeros, Ones, ConstInitMethod,
+)
+from bigdl_tpu.nn.linear import Linear, Bilinear, CMul, CAdd
+from bigdl_tpu.nn.conv import (
+    SpatialConvolution, SpatialShareConvolution, SpatialDilatedConvolution,
+    SpatialFullConvolution,
+)
+from bigdl_tpu.nn.pooling import SpatialMaxPooling, SpatialAveragePooling
+from bigdl_tpu.nn.normalization import (
+    BatchNormalization, SpatialBatchNormalization, SpatialCrossMapLRN, Normalize,
+)
+from bigdl_tpu.nn.activation import (
+    ReLU, ReLU6, Tanh, Sigmoid, SoftMax, LogSoftMax, SoftPlus, SoftSign,
+    ELU, GELU, LeakyReLU, HardTanh, Clamp, Abs, Power, Square, Sqrt, Log, Exp,
+    PReLU,
+)
+from bigdl_tpu.nn.dropout import (
+    Dropout, SpatialDropout2D, GaussianNoise, GaussianDropout,
+)
+from bigdl_tpu.nn.reshape import (
+    Reshape, View, Squeeze, Unsqueeze, Select, Narrow, Transpose, Contiguous,
+    Identity, Echo, SpatialZeroPadding, Padding,
+)
+from bigdl_tpu.nn.table_ops import (
+    CAddTable, CMulTable, CSubTable, CDivTable, CMaxTable, CMinTable,
+    JoinTable, SplitTable, SelectTable, FlattenTable, MM, MV, DotProduct,
+    CosineDistance, Sum, Mean, Max, Min,
+)
+from bigdl_tpu.nn.embedding import LookupTable
+from bigdl_tpu.nn.criterion import (
+    ClassNLLCriterion, CrossEntropyCriterion, MSECriterion, AbsCriterion,
+    BCECriterion, SmoothL1Criterion, MarginCriterion, MultiLabelMarginCriterion,
+    HingeEmbeddingCriterion, CosineEmbeddingCriterion, DistKLDivCriterion,
+    KLDCriterion, L1Cost, ClassSimplexCriterion, ParallelCriterion,
+    MultiCriterion, TimeDistributedCriterion,
+)

@@ -1,0 +1,169 @@
+"""Activation layers.
+
+Reference parity: nn/ReLU.scala, nn/ReLU6.scala, nn/Tanh.scala,
+nn/Sigmoid.scala, nn/SoftMax.scala, nn/LogSoftMax.scala, nn/ELU.scala,
+nn/PReLU.scala, nn/LeakyReLU.scala, nn/HardTanh.scala, nn/SoftPlus.scala,
+nn/SoftSign.scala, nn/Power.scala, nn/Square.scala, nn/Sqrt.scala,
+nn/Abs.scala, nn/Clamp.scala, nn/Log.scala, nn/Exp.scala, nn/GELU (later
+snapshots). All are elementwise VPU ops; XLA fuses them into neighboring
+matmuls/convs, which is exactly the fusion the reference's MKL-DNN layer
+did by hand (nn/mkldnn/Fusion.scala).
+
+The reference's `ip` (in-place) flags are accepted and ignored — in-place
+is meaningless in a functional program; XLA does buffer reuse itself.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+class _Elementwise(Module):
+    def __init__(self, ip: bool = False, name: Optional[str] = None):
+        super().__init__(name=name)
+
+    def _fn(self, x):
+        raise NotImplementedError
+
+    def apply(self, variables, x, training=False, rng=None):
+        return self._fn(x), variables["state"]
+
+
+class ReLU(_Elementwise):
+    def _fn(self, x):
+        return jax.nn.relu(x)
+
+
+class ReLU6(_Elementwise):
+    def _fn(self, x):
+        return jnp.minimum(jax.nn.relu(x), 6.0)
+
+
+class Tanh(_Elementwise):
+    def _fn(self, x):
+        return jnp.tanh(x)
+
+
+class Sigmoid(_Elementwise):
+    def _fn(self, x):
+        return jax.nn.sigmoid(x)
+
+
+class SoftMax(_Elementwise):
+    def _fn(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+
+class LogSoftMax(_Elementwise):
+    def _fn(self, x):
+        return jax.nn.log_softmax(x, axis=-1)
+
+
+class SoftPlus(_Elementwise):
+    def __init__(self, beta: float = 1.0, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.beta = beta
+
+    def _fn(self, x):
+        return jax.nn.softplus(self.beta * x) / self.beta
+
+
+class SoftSign(_Elementwise):
+    def _fn(self, x):
+        return jax.nn.soft_sign(x)
+
+
+class ELU(_Elementwise):
+    def __init__(self, alpha: float = 1.0, ip: bool = False, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.alpha = alpha
+
+    def _fn(self, x):
+        return jax.nn.elu(x, alpha=self.alpha)
+
+
+class GELU(_Elementwise):
+    def _fn(self, x):
+        return jax.nn.gelu(x)
+
+
+class LeakyReLU(_Elementwise):
+    def __init__(self, negval: float = 0.01, ip: bool = False, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.negval = negval
+
+    def _fn(self, x):
+        return jnp.where(x >= 0, x, self.negval * x)
+
+
+class HardTanh(_Elementwise):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 ip: bool = False, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.min_value, self.max_value = min_value, max_value
+
+    def _fn(self, x):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class Clamp(HardTanh):
+    def __init__(self, min_value: float, max_value: float, name: Optional[str] = None):
+        super().__init__(min_value, max_value, name=name)
+
+
+class Abs(_Elementwise):
+    def _fn(self, x):
+        return jnp.abs(x)
+
+
+class Power(_Elementwise):
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def _fn(self, x):
+        return (self.scale * x + self.shift) ** self.power
+
+
+class Square(_Elementwise):
+    def _fn(self, x):
+        return x * x
+
+
+class Sqrt(_Elementwise):
+    def _fn(self, x):
+        return jnp.sqrt(x)
+
+
+class Log(_Elementwise):
+    def _fn(self, x):
+        return jnp.log(x)
+
+
+class Exp(_Elementwise):
+    def _fn(self, x):
+        return jnp.exp(x)
+
+
+class PReLU(Module):
+    """Learnable leaky slope (reference: nn/PReLU.scala; nOutputPlane=0 → one
+    shared slope)."""
+
+    def __init__(self, n_output_plane: int = 0, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.n_output_plane = n_output_plane
+
+    def init_params(self, rng):
+        n = max(self.n_output_plane, 1)
+        return {"weight": jnp.full((n,), 0.25, jnp.float32)}
+
+    def apply(self, variables, x, training=False, rng=None):
+        w = variables["params"]["weight"]
+        # shared slope broadcasts; per-channel slope rides the trailing C axis
+        return jnp.where(x >= 0, x, w * x), variables["state"]

@@ -1,0 +1,164 @@
+"""Containers.
+
+Reference parity: nn/Container.scala, nn/Sequential.scala, nn/Concat.scala,
+nn/ConcatTable.scala, nn/ParallelTable.scala, nn/MapTable.scala,
+nn/Bottle.scala.
+
+Child parameters are stored under the child's unique name so the variable
+pytree is self-describing: ``{'params': {'0_Linear_3': {...}, ...}}``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module, _fold_rng
+from bigdl_tpu.utils.table import Table, T
+
+
+class Container(Module):
+    """Base container (reference: nn/Container.scala#Container.modules)."""
+
+    def __init__(self, *modules: Module, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.modules: List[Module] = []
+        self._keys: List[str] = []
+        for m in modules:
+            self.add(m)
+
+    def add(self, module: Module) -> "Container":
+        key = f"{len(self.modules)}_{module.name}"
+        self.modules.append(module)
+        self._keys.append(key)
+        return self
+
+    def init_params(self, rng):
+        return {
+            k: m.init_params(jax.random.fold_in(rng, i))
+            for i, (k, m) in enumerate(zip(self._keys, self.modules))
+        }
+
+    def init_state(self):
+        return {k: m.init_state() for k, m in zip(self._keys, self.modules)}
+
+    def _child_vars(self, variables, key):
+        return {"params": variables["params"][key], "state": variables["state"][key]}
+
+    def __getitem__(self, i: int) -> Module:
+        return self.modules[i]
+
+    def __len__(self):
+        return len(self.modules)
+
+    def __repr__(self):
+        inner = "\n  ".join(repr(m) for m in self.modules)
+        return f"{type(self).__name__}(\n  {inner}\n)"
+
+
+class Sequential(Container):
+    """Feed-forward chain (reference: nn/Sequential.scala)."""
+
+    def apply(self, variables, *inputs, training=False, rng=None):
+        x = inputs[0] if len(inputs) == 1 else T(*inputs)
+        new_state = {}
+        for i, (k, m) in enumerate(zip(self._keys, self.modules)):
+            x, s = m.apply(
+                self._child_vars(variables, k), x,
+                training=training, rng=_fold_rng(rng, i),
+            )
+            new_state[k] = s
+        return x, new_state
+
+
+class ConcatTable(Container):
+    """Apply every child to the same input; output is a Table of results
+    (reference: nn/ConcatTable.scala)."""
+
+    def apply(self, variables, input, training=False, rng=None):
+        outs, new_state = Table(), {}
+        for i, (k, m) in enumerate(zip(self._keys, self.modules)):
+            o, s = m.apply(
+                self._child_vars(variables, k), input,
+                training=training, rng=_fold_rng(rng, i),
+            )
+            outs.insert(o)
+            new_state[k] = s
+        return outs, new_state
+
+
+class ParallelTable(Container):
+    """i-th child consumes i-th element of the input table
+    (reference: nn/ParallelTable.scala)."""
+
+    def apply(self, variables, input, training=False, rng=None):
+        elems = list(input.values()) if isinstance(input, dict) else list(input)
+        outs, new_state = Table(), {}
+        for i, (k, m, x) in enumerate(zip(self._keys, self.modules, elems)):
+            o, s = m.apply(
+                self._child_vars(variables, k), x,
+                training=training, rng=_fold_rng(rng, i),
+            )
+            outs.insert(o)
+            new_state[k] = s
+        return outs, new_state
+
+
+class Concat(Container):
+    """Apply every child to the input, concatenate outputs along `dimension`
+    (reference: nn/Concat.scala; dimension is 1-based including batch, as in
+    the reference)."""
+
+    def __init__(self, dimension: int, *modules: Module, name: Optional[str] = None):
+        super().__init__(*modules, name=name)
+        self.dimension = dimension
+
+    def apply(self, variables, input, training=False, rng=None):
+        outs, new_state = [], {}
+        for i, (k, m) in enumerate(zip(self._keys, self.modules)):
+            o, s = m.apply(
+                self._child_vars(variables, k), input,
+                training=training, rng=_fold_rng(rng, i),
+            )
+            outs.append(o)
+            new_state[k] = s
+        return jnp.concatenate(outs, axis=self.dimension - 1), new_state
+
+
+class MapTable(Container):
+    """Apply the single shared child to every element of the input table
+    (reference: nn/MapTable.scala — shared weights across elements)."""
+
+    def apply(self, variables, input, training=False, rng=None):
+        elems = list(input.values()) if isinstance(input, dict) else list(input)
+        k, m = self._keys[0], self.modules[0]
+        outs = Table()
+        s = variables["state"][k]
+        for i, x in enumerate(elems):
+            o, s = m.apply(
+                {"params": variables["params"][k], "state": s}, x,
+                training=training, rng=_fold_rng(rng, i),
+            )
+            outs.insert(o)
+        return outs, {k: s}
+
+
+class Bottle(Container):
+    """Collapse leading dims, apply child, restore (reference: nn/Bottle.scala)."""
+
+    def __init__(self, module: Module, n_input_dim: int = 2, n_output_dim: int = 2,
+                 name: Optional[str] = None):
+        super().__init__(module, name=name)
+        self.n_input_dim = n_input_dim
+        self.n_output_dim = n_output_dim
+
+    def apply(self, variables, input, training=False, rng=None):
+        k, m = self._keys[0], self.modules[0]
+        lead = input.shape[: input.ndim - self.n_input_dim + 1]
+        flat = input.reshape((-1,) + input.shape[input.ndim - self.n_input_dim + 1:])
+        out, s = m.apply(self._child_vars(variables, k), flat,
+                         training=training, rng=rng)
+        out = out.reshape(lead + out.shape[1:])
+        return out, {k: s}

@@ -1,0 +1,295 @@
+"""Loss functions.
+
+Reference parity: nn/ClassNLLCriterion.scala, nn/CrossEntropyCriterion.scala,
+nn/MSECriterion.scala, nn/AbsCriterion.scala, nn/BCECriterion.scala,
+nn/SmoothL1Criterion.scala, nn/MultiLabelMarginCriterion.scala,
+nn/MarginCriterion.scala, nn/ClassSimplexCriterion.scala,
+nn/ParallelCriterion.scala, nn/TimeDistributedCriterion.scala,
+nn/MultiCriterion.scala, nn/KLDCriterion (autoencoder snapshots),
+nn/DistKLDivCriterion.scala, nn/HingeEmbeddingCriterion.scala,
+nn/L1Cost.scala, nn/CosineEmbeddingCriterion.scala.
+
+All criterions are pure scalar-valued functions — the reference's
+hand-written `updateGradInput` is `jax.grad` here. Class targets are
+0-based int arrays (reference uses 1-based Float tensors — documented
+divergence), and may carry an optional trailing `weights` channel via the
+`weights` kwarg instead.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Criterion
+
+
+def _reduce(x, size_average: bool):
+    return jnp.mean(x) if size_average else jnp.sum(x)
+
+
+class ClassNLLCriterion(Criterion):
+    """Negative log-likelihood over log-probability input
+    (reference: nn/ClassNLLCriterion.scala — expects LogSoftMax output).
+
+    input: (N, C) log-probs; target: (N,) int class ids (0-based).
+    """
+
+    def __init__(self, weights: Optional[jax.Array] = None,
+                 size_average: bool = True, logProbAsInput: bool = True):
+        self.weights = weights
+        self.size_average = size_average
+        self.log_prob_as_input = logProbAsInput
+
+    def forward(self, input, target):
+        logp = input if self.log_prob_as_input else jnp.log(jnp.maximum(input, 1e-8))
+        target = target.astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, target[:, None], axis=1)[:, 0]
+        if self.weights is not None:
+            w = jnp.take(self.weights, target)
+            loss = -(w * picked)
+            return jnp.sum(loss) / jnp.sum(w) if self.size_average else jnp.sum(loss)
+        return _reduce(-picked, self.size_average)
+
+
+class CrossEntropyCriterion(Criterion):
+    """LogSoftMax + ClassNLL fused (reference: nn/CrossEntropyCriterion.scala).
+    input: (N, C) logits; target: (N,) int ids."""
+
+    def __init__(self, weights: Optional[jax.Array] = None, size_average: bool = True):
+        self.weights = weights
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        logp = jax.nn.log_softmax(input, axis=-1)
+        return ClassNLLCriterion(self.weights, self.size_average).forward(logp, target)
+
+
+class MSECriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        return _reduce((input - target) ** 2, self.size_average)
+
+
+class AbsCriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        return _reduce(jnp.abs(input - target), self.size_average)
+
+
+class BCECriterion(Criterion):
+    """Binary cross-entropy over probabilities (reference: nn/BCECriterion.scala)."""
+
+    def __init__(self, weights: Optional[jax.Array] = None, size_average: bool = True):
+        self.weights = weights
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        eps = 1e-12
+        p = jnp.clip(input, eps, 1.0 - eps)
+        loss = -(target * jnp.log(p) + (1.0 - target) * jnp.log(1.0 - p))
+        if self.weights is not None:
+            loss = loss * self.weights
+        return _reduce(loss, self.size_average)
+
+
+class SmoothL1Criterion(Criterion):
+    """Huber-style loss (reference: nn/SmoothL1Criterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        d = jnp.abs(input - target)
+        loss = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+        return _reduce(loss, self.size_average)
+
+
+class MarginCriterion(Criterion):
+    """Hinge loss, targets in {1, -1} (reference: nn/MarginCriterion.scala)."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True,
+                 squared: bool = False):
+        self.margin = margin
+        self.size_average = size_average
+        self.squared = squared
+
+    def forward(self, input, target):
+        h = jnp.maximum(0.0, self.margin - input * target)
+        if self.squared:
+            h = h * h
+        return _reduce(h, self.size_average)
+
+
+class MultiLabelMarginCriterion(Criterion):
+    """Multi-label margin (reference: nn/MultiLabelMarginCriterion.scala).
+    target: (N, C) 0/1 indicator (divergence from the reference's
+    index-list encoding — indicator is jit-friendly)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        pos_mask = target > 0.5
+        # for each (pos, neg) pair: max(0, 1 - (x_pos - x_neg))
+        x_pos = jnp.where(pos_mask, input, jnp.inf)[..., :, None]
+        x_neg = jnp.where(pos_mask, -jnp.inf, input)[..., None, :]
+        pair = jnp.maximum(0.0, 1.0 - (x_pos - x_neg))
+        pair = jnp.where(jnp.isfinite(pair), pair, 0.0)
+        c = input.shape[-1]
+        per_sample = jnp.sum(pair, axis=(-1, -2)) / c
+        return _reduce(per_sample, self.size_average)
+
+
+class HingeEmbeddingCriterion(Criterion):
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        self.margin = margin
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        loss = jnp.where(target > 0, input,
+                         jnp.maximum(0.0, self.margin - input))
+        return _reduce(loss, self.size_average)
+
+
+class CosineEmbeddingCriterion(Criterion):
+    """(reference: nn/CosineEmbeddingCriterion.scala) input: 2-table."""
+
+    def __init__(self, margin: float = 0.0, size_average: bool = True):
+        self.margin = margin
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        a, b = (input[1], input[2]) if isinstance(input, dict) else (input[0], input[1])
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(target > 0, 1.0 - cos,
+                         jnp.maximum(0.0, cos - self.margin))
+        return _reduce(loss, self.size_average)
+
+
+class DistKLDivCriterion(Criterion):
+    """KL(target || input) with log-prob input (reference: nn/DistKLDivCriterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        loss = jnp.where(target > 0, target * (jnp.log(jnp.maximum(target, 1e-12)) - input), 0.0)
+        return jnp.sum(loss) / input.shape[0] if self.size_average else jnp.sum(loss)
+
+
+class KLDCriterion(Criterion):
+    """VAE latent KL to N(0, I); input: table (mean, log_var)
+    (reference: nn/KLDCriterion.scala)."""
+
+    def forward(self, input, target=None):
+        mean, log_var = (input[1], input[2]) if isinstance(input, dict) else (input[0], input[1])
+        kl = 0.5 * jnp.sum(mean ** 2 + jnp.exp(log_var) - log_var - 1.0, axis=-1)
+        return jnp.mean(kl)
+
+
+class L1Cost(Criterion):
+    def forward(self, input, target=None):
+        return jnp.sum(jnp.abs(input))
+
+
+class ClassSimplexCriterion(Criterion):
+    """MSE against simplex-embedded class targets
+    (reference: nn/ClassSimplexCriterion.scala)."""
+
+    def __init__(self, n_classes: int):
+        self.n_classes = n_classes
+        self.simplex = self._build_simplex(n_classes)
+
+    @staticmethod
+    def _build_simplex(n):
+        import numpy as np
+        mat = np.zeros((n, n), dtype=np.float32)
+        mat[0, 0] = 1.0
+        for k in range(1, n - 1):
+            s = float(np.dot(mat[k - 1, :k], mat[k, :k])) if k > 0 else 0.0
+            # regular simplex construction (Gram-Schmidt style)
+        # closed form: vertices of regular simplex in R^n
+        a = (1.0 - np.sqrt(1.0 + n)) / n
+        mat = np.eye(n, dtype=np.float32) + a / np.sqrt(n) * np.ones((n, n), np.float32)
+        mat = mat / np.linalg.norm(mat, axis=1, keepdims=True)
+        return jnp.asarray(mat)
+
+    def forward(self, input, target):
+        t = jnp.take(self.simplex, target.astype(jnp.int32), axis=0)
+        return jnp.mean((input - t) ** 2)
+
+
+class ParallelCriterion(Criterion):
+    """Weighted sum of criterions over a table of (input, target) pairs
+    (reference: nn/ParallelCriterion.scala)."""
+
+    def __init__(self, repeat_target: bool = False):
+        self.criterions = []
+        self.weights = []
+        self.repeat_target = repeat_target
+
+    def add(self, criterion: Criterion, weight: float = 1.0) -> "ParallelCriterion":
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def forward(self, input, target):
+        ins = list(input.values()) if isinstance(input, dict) else list(input)
+        if self.repeat_target:
+            tgts = [target] * len(ins)
+        else:
+            tgts = list(target.values()) if isinstance(target, dict) else list(target)
+        total = 0.0
+        for crit, w, i, t in zip(self.criterions, self.weights, ins, tgts):
+            total = total + w * crit.forward(i, t)
+        return total
+
+
+class MultiCriterion(Criterion):
+    """Weighted sum of criterions on the SAME (input, target)
+    (reference: nn/MultiCriterion.scala)."""
+
+    def __init__(self):
+        self.criterions = []
+        self.weights = []
+
+    def add(self, criterion: Criterion, weight: float = 1.0) -> "MultiCriterion":
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def forward(self, input, target):
+        total = 0.0
+        for crit, w in zip(self.criterions, self.weights):
+            total = total + w * crit.forward(input, target)
+        return total
+
+
+class TimeDistributedCriterion(Criterion):
+    """Apply a criterion at every timestep of (N, T, ...) input
+    (reference: nn/TimeDistributedCriterion.scala)."""
+
+    def __init__(self, criterion: Criterion, size_average: bool = False,
+                 dimension: int = 2):
+        self.criterion = criterion
+        self.size_average = size_average
+        self.dimension = dimension
+
+    def forward(self, input, target):
+        n, t = input.shape[0], input.shape[1]
+        flat_in = input.reshape((n * t,) + input.shape[2:])
+        flat_tgt = target.reshape((n * t,) + target.shape[2:])
+        loss = self.criterion.forward(flat_in, flat_tgt)
+        # inner criterion with size_average=True already averages over N*T;
+        # reference semantics: size_average=False → divide by N only
+        if not self.size_average and getattr(self.criterion, "size_average", True):
+            loss = loss * t
+        return loss

@@ -1,0 +1,93 @@
+"""Dropout layers.
+
+Reference parity: nn/Dropout.scala (inverted dropout, scale-at-train),
+nn/SpatialDropout2D (later snapshots), nn/GaussianDropout, nn/GaussianNoise.
+
+Randomness is explicit: `apply` consumes the `rng` threaded by containers
+(deterministic per-position fold), so a jitted train step with a fixed seed
+is bit-reproducible — the test-mode determinism SURVEY.md §5.2 calls for.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+class Dropout(Module):
+    """Inverted dropout (reference: nn/Dropout.scala — scales by 1/(1-p) at
+    train time so eval is identity)."""
+
+    def __init__(self, init_p: float = 0.5, ip: bool = False,
+                 scale: bool = True, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.p = init_p
+        self.scale = scale
+
+    def apply(self, variables, x, training=False, rng=None):
+        if not training or self.p <= 0.0:
+            return x, variables["state"]
+        if rng is None:
+            raise ValueError(
+                f"{self.name}: Dropout in training mode needs an rng "
+                "(pass rng= to apply/forward)"
+            )
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        y = jnp.where(mask, x, 0.0)
+        if self.scale:
+            y = y / keep
+        return y, variables["state"]
+
+
+class SpatialDropout2D(Module):
+    """Drop whole feature maps (NHWC: mask over channels)."""
+
+    def __init__(self, init_p: float = 0.5, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.p = init_p
+
+    def apply(self, variables, x, training=False, rng=None):
+        if not training or self.p <= 0.0:
+            return x, variables["state"]
+        if rng is None:
+            raise ValueError(f"{self.name}: needs rng in training mode")
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, (x.shape[0], 1, 1, x.shape[-1]))
+        return jnp.where(mask, x, 0.0) / keep, variables["state"]
+
+
+class GaussianNoise(Module):
+    """Additive zero-mean noise at train time (reference: nn/GaussianNoise.scala)."""
+
+    def __init__(self, stddev: float, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.stddev = stddev
+
+    def apply(self, variables, x, training=False, rng=None):
+        if not training:
+            return x, variables["state"]
+        if rng is None:
+            raise ValueError(f"{self.name}: needs rng in training mode")
+        return x + self.stddev * jax.random.normal(rng, x.shape, x.dtype), variables["state"]
+
+
+class GaussianDropout(Module):
+    """Multiplicative gaussian noise (reference: nn/GaussianDropout.scala)."""
+
+    def __init__(self, rate: float, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.rate = rate
+
+    def apply(self, variables, x, training=False, rng=None):
+        if not training:
+            return x, variables["state"]
+        if rng is None:
+            raise ValueError(f"{self.name}: needs rng in training mode")
+        stddev = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + stddev * jax.random.normal(rng, x.shape, x.dtype)
+        return x * noise, variables["state"]

@@ -1,0 +1,59 @@
+"""Embedding layers.
+
+Reference parity: nn/LookupTable.scala (embedding with optional max-norm
+renorm and padding index), nn/LookupTableSparse (sparse input variant —
+served here by the same gather path).
+
+TPU note: gathers from an (V, D) table are HBM-bandwidth bound; XLA lowers
+`jnp.take` to a dynamic-gather that keeps the table resident. For very
+large vocabularies shard the table over the mesh model axis
+(bigdl_tpu/parallel/ops.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.initialization import InitializationMethod, RandomNormal
+from bigdl_tpu.nn.module import Module
+
+
+class LookupTable(Module):
+    """Index → embedding row (reference: nn/LookupTable.scala).
+
+    Indices are 1-based in the reference; here 0-based (documented
+    divergence — Python-native). `padding_value` rows emit zeros.
+    """
+
+    def __init__(self, n_index: int, n_output: int,
+                 padding_value: Optional[int] = None,
+                 max_norm: Optional[float] = None,
+                 w_init: Optional[InitializationMethod] = None,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.n_index = n_index
+        self.n_output = n_output
+        self.padding_value = padding_value
+        self.max_norm = max_norm
+        self.w_init = w_init or RandomNormal(0.0, 1.0)
+
+    def init_params(self, rng):
+        return {
+            "weight": self.w_init(rng, (self.n_index, self.n_output),
+                                  fan_in=self.n_index, fan_out=self.n_output)
+        }
+
+    def apply(self, variables, idx, training=False, rng=None):
+        w = variables["params"]["weight"]
+        if self.max_norm is not None:
+            norms = jnp.linalg.norm(w, axis=1, keepdims=True)
+            w = w * jnp.minimum(1.0, self.max_norm / jnp.maximum(norms, 1e-7))
+        idx = idx.astype(jnp.int32)
+        out = jnp.take(w, idx, axis=0)
+        if self.padding_value is not None:
+            mask = (idx != self.padding_value)[..., None]
+            out = out * mask.astype(out.dtype)
+        return out, variables["state"]

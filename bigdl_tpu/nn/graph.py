@@ -1,0 +1,142 @@
+"""Static DAG graph container.
+
+Reference parity: nn/Graph.scala / nn/StaticGraph.scala, `Input()`,
+node wiring via `layer.inputs(...)`, topological execution over
+utils/DirectedGraph.scala. `Graph.backward`'s reverse traversal is
+subsumed by jax.grad over the pure forward.
+
+API (matches the reference's functional wiring style)::
+
+    x = Input()
+    h = Linear(784, 100)(x)
+    y = LogSoftMax()(ReLU()(h))
+    model = Graph(x, y)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+
+from bigdl_tpu.nn.module import Module, _fold_rng
+from bigdl_tpu.utils.table import Table, T
+
+
+class Node:
+    """A wiring node: a module plus its input nodes
+    (reference: utils/Node.scala wrapped by nn/Graph)."""
+
+    def __init__(self, module: Optional[Module], inputs: Sequence["Node"] = ()):
+        self.module = module
+        self.inputs: List[Node] = list(inputs)
+
+    @staticmethod
+    def wire(module: Module, inputs: Sequence["Node"]) -> "Node":
+        return Node(module, inputs)
+
+    def __repr__(self):
+        return f"Node({self.module!r}, n_in={len(self.inputs)})"
+
+
+def Input() -> Node:
+    """Placeholder input node (reference: nn/Input.scala)."""
+    return Node(None, ())
+
+
+class Graph(Module):
+    """Execute a DAG of modules in topological order
+    (reference: nn/StaticGraph.scala#StaticGraph.updateOutput)."""
+
+    def __init__(
+        self,
+        inputs: Union[Node, Sequence[Node]],
+        outputs: Union[Node, Sequence[Node]],
+        name: Optional[str] = None,
+    ):
+        super().__init__(name=name)
+        self.input_nodes = [inputs] if isinstance(inputs, Node) else list(inputs)
+        self.output_nodes = [outputs] if isinstance(outputs, Node) else list(outputs)
+        self._order = self._topo_sort()
+        self._keys: Dict[int, str] = {}
+        for i, node in enumerate(self._order):
+            if node.module is not None:
+                self._keys[id(node)] = f"{i}_{node.module.name}"
+
+    def _topo_sort(self) -> List[Node]:
+        order, seen, stack = [], set(), []
+
+        def visit(n: Node):
+            if id(n) in seen:
+                return
+            # iterative DFS to survive deep graphs
+            st = [(n, iter(n.inputs))]
+            path = {id(n)}
+            while st:
+                node, it = st[-1]
+                nxt = next(it, None)
+                if nxt is None:
+                    st.pop()
+                    path.discard(id(node))
+                    if id(node) not in seen:
+                        seen.add(id(node))
+                        order.append(node)
+                elif id(nxt) not in seen:
+                    if id(nxt) in path:
+                        raise ValueError("Graph contains a cycle")
+                    st.append((nxt, iter(nxt.inputs)))
+                    path.add(id(nxt))
+            return
+
+        for out in self.output_nodes:
+            visit(out)
+        for inp in self.input_nodes:
+            if id(inp) not in seen:
+                raise ValueError("Graph input is not connected to any output")
+        return order
+
+    def init_params(self, rng):
+        return {
+            self._keys[id(n)]: n.module.init_params(jax.random.fold_in(rng, i))
+            for i, n in enumerate(self._order)
+            if n.module is not None
+        }
+
+    def init_state(self):
+        return {
+            self._keys[id(n)]: n.module.init_state()
+            for n in self._order
+            if n.module is not None
+        }
+
+    def apply(self, variables, *inputs, training=False, rng=None):
+        if len(inputs) == 1 and isinstance(inputs[0], (tuple, list)):
+            inputs = tuple(inputs[0])
+        if len(inputs) != len(self.input_nodes):
+            raise ValueError(
+                f"Graph expects {len(self.input_nodes)} inputs, got {len(inputs)}"
+            )
+        values: Dict[int, Any] = {
+            id(n): x for n, x in zip(self.input_nodes, inputs)
+        }
+        new_state: Dict[str, Any] = {}
+        for i, node in enumerate(self._order):
+            if node.module is None:
+                if id(node) not in values:
+                    raise ValueError("Unbound Input node in graph")
+                continue
+            args = [values[id(p)] for p in node.inputs]
+            if len(args) > 1:
+                args = [T(*args)]
+            key = self._keys[id(node)]
+            child_vars = {
+                "params": variables["params"][key],
+                "state": variables["state"][key],
+            }
+            out, s = node.module.apply(
+                child_vars, *args, training=training, rng=_fold_rng(rng, i)
+            )
+            values[id(node)] = out
+            new_state[key] = s
+        outs = [values[id(n)] for n in self.output_nodes]
+        return (outs[0] if len(outs) == 1 else T(*outs)), new_state

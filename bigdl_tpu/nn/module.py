@@ -1,0 +1,192 @@
+"""Module abstraction — the functional core of the framework.
+
+Reference parity: nn/abstractnn/AbstractModule.scala#AbstractModule
+(`forward`/`backward` = `updateOutput`/`updateGradInput`/`accGradParameters`,
+`parameters()`, `training`/`evaluate`, `zeroGradParameters`, `clone`) and
+nn/abstractnn/Initializable.scala.
+
+TPU-first redesign
+------------------
+The reference mutates per-layer `output`/`gradInput` buffers and implements
+every backward by hand. Under XLA none of that survives: everything traced
+under `jit` must be pure. So here a Module is a *stateless description*
+(hyper-parameters only — sizes are explicit in constructors, exactly like
+the reference's `Linear(inputSize, outputSize)`), and all data lives in
+pytrees threaded through two pure functions:
+
+    variables = module.init(rng)          # {'params': ..., 'state': ...}
+    y, state  = module.apply(variables, x, training=..., rng=...)
+
+`params` are trainable leaves (jax.grad differentiates w.r.t. them);
+`state` is non-trainable (BatchNorm running stats). Hand-written backwards
+are replaced wholesale by `jax.grad`; `custom_vjp`/Pallas only where
+fusion control demands it (see bigdl_tpu/ops/).
+
+A thin stateful facade (`__call__`, `.forward`, `.variables`) gives the
+reference's eager Torch-style feel for debugging and inference; the
+training path in bigdl_tpu/optim uses only the pure functions.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_id_counter = itertools.count()
+
+
+def _fold_rng(rng: Optional[jax.Array], i: int) -> Optional[jax.Array]:
+    return None if rng is None else jax.random.fold_in(rng, i)
+
+
+class Module:
+    """Base class for all modules.
+
+    Subclasses override:
+      - ``init_params(rng) -> dict``   (trainable leaves; default: none)
+      - ``init_state() -> dict``       (running stats etc.; default: none)
+      - ``apply(variables, *inputs, training=False, rng=None)
+           -> (output, new_state)``    (pure forward)
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or f"{type(self).__name__}_{next(_id_counter)}"
+        # Eager facade storage (not used by the jitted training path).
+        self._variables: Optional[Dict[str, Any]] = None
+        self._training = True
+
+    # ---------------------------------------------------------------- pure
+    def init_params(self, rng: jax.Array) -> Dict[str, Any]:
+        return {}
+
+    def init_state(self) -> Dict[str, Any]:
+        return {}
+
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        """Build the full variable pytree: {'params': ..., 'state': ...}."""
+        return {"params": self.init_params(rng), "state": self.init_state()}
+
+    def apply(
+        self,
+        variables: Dict[str, Any],
+        *inputs,
+        training: bool = False,
+        rng: Optional[jax.Array] = None,
+    ) -> Tuple[Any, Dict[str, Any]]:
+        raise NotImplementedError
+
+    # -------------------------------------------------- reference-API parity
+    def parameters(self, variables: Optional[Dict[str, Any]] = None) -> List[Tuple[str, jax.Array]]:
+        """Flat (qualified-name, array) list of trainable parameters.
+
+        Reference parity: AbstractModule.parameters() /
+        getParametersTable() — there it returns (weights, gradWeights);
+        gradients have no persistent identity under jax.grad, so only the
+        weights are enumerated.
+        """
+        variables = variables if variables is not None else self._variables
+        if variables is None:
+            raise ValueError(f"{self.name}: call init()/build() first")
+        leaves = jax.tree_util.tree_leaves_with_path(variables["params"])
+        out = []
+        for path, leaf in leaves:
+            keys = [getattr(p, "key", getattr(p, "idx", None)) for p in path]
+            out.append((".".join(str(k) for k in keys), leaf))
+        return out
+
+    def get_parameters(self, variables: Optional[Dict[str, Any]] = None) -> jax.Array:
+        """All trainable parameters flattened into one contiguous vector.
+
+        Reference parity: Module.getParameters() — the reference keeps ALL
+        weights in one flat vector so the parameter plane can slice it
+        evenly across partitions (parameters/AllReduceParameter.scala).
+        The same trick drives our ZeRO-1 sharded update
+        (bigdl_tpu/parallel/data_parallel.py).
+        """
+        variables = variables if variables is not None else self._variables
+        leaves = jax.tree_util.tree_leaves(variables["params"])
+        if not leaves:
+            return jnp.zeros((0,), jnp.float32)
+        return jnp.concatenate([jnp.ravel(l) for l in leaves])
+
+    # ------------------------------------------------------- eager facade
+    def build(self, rng: Optional[jax.Array] = None) -> "Module":
+        """Materialize variables on this object for eager use."""
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        self._variables = self.init(rng)
+        return self
+
+    @property
+    def variables(self) -> Dict[str, Any]:
+        if self._variables is None:
+            self.build()
+        return self._variables
+
+    @variables.setter
+    def variables(self, v: Dict[str, Any]) -> None:
+        self._variables = v
+
+    def training(self) -> "Module":
+        """Switch eager facade to training mode (reference: AbstractModule.training)."""
+        self._training = True
+        return self
+
+    def evaluate(self) -> "Module":
+        """Switch eager facade to eval mode (reference: AbstractModule.evaluate)."""
+        self._training = False
+        return self
+
+    def is_training(self) -> bool:
+        return self._training
+
+    def forward(self, *inputs, rng: Optional[jax.Array] = None):
+        """Eager forward using stored variables; updates stored state.
+
+        Reference parity: AbstractModule.forward. NOTE: this is the debug /
+        inference convenience path. Training uses the pure `apply` under
+        `jit` (see bigdl_tpu/optim/local_optimizer.py).
+        """
+        out, new_state = self.apply(
+            self.variables, *inputs, training=self._training, rng=rng
+        )
+        self._variables = {"params": self._variables["params"], "state": new_state}
+        return out
+
+    def __call__(self, *args, **kwargs):
+        """Graph wiring (when called on Node objects) or eager forward."""
+        from bigdl_tpu.nn.graph import Node  # cycle-free: graph imports module
+
+        if args and all(isinstance(a, Node) for a in args):
+            return Node.wire(self, args)
+        return self.forward(*args, **kwargs)
+
+    def set_name(self, name: str) -> "Module":
+        self.name = name
+        return self
+
+    def __repr__(self):
+        return f"{type(self).__name__}(name={self.name!r})"
+
+
+class Criterion:
+    """Loss-function base.
+
+    Reference parity: nn/abstractnn/AbstractCriterion.scala — `forward`
+    (updateOutput) only; `updateGradInput` is subsumed by jax.grad. All
+    criterions are pure and parameter-free: ``loss = crit(input, target)``.
+    """
+
+    size_average: bool = True
+
+    def forward(self, input, target) -> jax.Array:
+        raise NotImplementedError
+
+    def __call__(self, input, target) -> jax.Array:
+        return self.forward(input, target)
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"

@@ -1,0 +1,130 @@
+"""Normalization layers.
+
+Reference parity: nn/BatchNormalization.scala (1-D over (N,C)),
+nn/SpatialBatchNormalization.scala (2-D over feature maps),
+nn/SpatialCrossMapLRN.scala, nn/Normalize.scala.
+
+Running stats live in the `state` pytree (not `params`) so jax.grad never
+touches them; `training=True` returns updated stats functionally (the
+reference mutates `runningMean`/`runningVar` in place).
+
+DP note: per-replica statistics, matching the reference's DistriOptimizer
+behavior (each core-clone/partition keeps its own BN stats; SURVEY.md §7
+"hard parts"). Cross-replica sync is available via `sync=True`, which
+psums stats over the mesh data axis when run inside shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+
+
+class BatchNormalization(Module):
+    """BN over the last axis of (N, C) input (reference: nn/BatchNormalization.scala)."""
+
+    _reduce_axes = (0,)
+
+    def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
+                 affine: bool = True, sync: bool = False,
+                 axis_name: str = "data", name: Optional[str] = None):
+        super().__init__(name=name)
+        self.n_output = n_output
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+        self.sync = sync
+        self.axis_name = axis_name
+
+    def init_params(self, rng):
+        if not self.affine:
+            return {}
+        return {
+            "weight": jnp.ones((self.n_output,), jnp.float32),
+            "bias": jnp.zeros((self.n_output,), jnp.float32),
+        }
+
+    def init_state(self):
+        return {
+            "running_mean": jnp.zeros((self.n_output,), jnp.float32),
+            "running_var": jnp.ones((self.n_output,), jnp.float32),
+        }
+
+    def apply(self, variables, x, training=False, rng=None):
+        state = variables["state"]
+        if training:
+            mean = jnp.mean(x, axis=self._reduce_axes)
+            var = jnp.var(x, axis=self._reduce_axes)
+            if self.sync:
+                mean = lax.pmean(mean, self.axis_name)
+                var = lax.pmean(var, self.axis_name)
+            m = self.momentum
+            new_state = {
+                "running_mean": (1 - m) * state["running_mean"] + m * mean,
+                "running_var": (1 - m) * state["running_var"] + m * var,
+            }
+        else:
+            mean, var = state["running_mean"], state["running_var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.eps)
+        y = (x - mean) * inv
+        if self.affine:
+            y = y * variables["params"]["weight"] + variables["params"]["bias"]
+        return y, new_state
+
+
+class SpatialBatchNormalization(BatchNormalization):
+    """BN over NHWC feature maps — reduce over (N, H, W)
+    (reference: nn/SpatialBatchNormalization.scala)."""
+
+    _reduce_axes = (0, 1, 2)
+
+
+class SpatialCrossMapLRN(Module):
+    """Local response normalization across channels
+    (reference: nn/SpatialCrossMapLRN.scala; AlexNet/Inception era).
+
+    y = x / (k + alpha/size * sum_{local} x^2)^beta over the channel axis.
+    """
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 k: float = 1.0, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+
+    def apply(self, variables, x, training=False, rng=None):
+        # NHWC: window-sum x^2 across C with same-padding
+        sq = x * x
+        half = (self.size - 1) // 2
+        summed = lax.reduce_window(
+            sq, 0.0, lax.add,
+            window_dimensions=(1, 1, 1, self.size),
+            window_strides=(1, 1, 1, 1),
+            padding=[(0, 0), (0, 0), (0, 0), (half, self.size - 1 - half)],
+        )
+        denom = (self.k + (self.alpha / self.size) * summed) ** self.beta
+        return x / denom, variables["state"]
+
+
+class Normalize(Module):
+    """Lp-normalize along the last axis (reference: nn/Normalize.scala)."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.p = p
+        self.eps = eps
+
+    def apply(self, variables, x, training=False, rng=None):
+        if self.p == 2.0:
+            norm = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+        else:
+            norm = jnp.sum(jnp.abs(x) ** self.p, axis=-1, keepdims=True) ** (1.0 / self.p)
+        return x / jnp.maximum(norm, self.eps), variables["state"]

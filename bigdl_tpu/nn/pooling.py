@@ -1,0 +1,105 @@
+"""Spatial pooling.
+
+Reference parity: nn/SpatialMaxPooling.scala, nn/SpatialAveragePooling.scala
+(ceilMode flag, count-include-pad semantics). Lowered to
+`lax.reduce_window`, which XLA:TPU vectorizes on the VPU. NHWC layout.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+
+
+def _pool_padding(pad_h, pad_w, ceil_mode, in_h, in_w, kh, kw, sh, sw):
+    pads = [(0, 0), (pad_h, pad_h), (pad_w, pad_w), (0, 0)]
+    if ceil_mode:
+        # extend right/bottom so the last partial window is included
+        def extra(size, k, s, p):
+            out_ceil = -(-(size + 2 * p - k) // s) + 1
+            needed = (out_ceil - 1) * s + k - (size + 2 * p)
+            return max(0, needed)
+        pads[1] = (pad_h, pad_h + extra(in_h, kh, sh, pad_h))
+        pads[2] = (pad_w, pad_w + extra(in_w, kw, sw, pad_w))
+    return pads
+
+
+class SpatialMaxPooling(Module):
+    """Max pool (reference: nn/SpatialMaxPooling.scala; arg order kW,kH,dW,dH,padW,padH)."""
+
+    def __init__(self, kernel_w: int, kernel_h: Optional[int] = None,
+                 stride_w: Optional[int] = None, stride_h: Optional[int] = None,
+                 pad_w: int = 0, pad_h: Optional[int] = None,
+                 ceil_mode: bool = False, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.kernel_w = kernel_w
+        self.kernel_h = kernel_h if kernel_h is not None else kernel_w
+        self.stride_w = stride_w if stride_w is not None else self.kernel_w
+        self.stride_h = stride_h if stride_h is not None else self.kernel_h
+        self.pad_w = pad_w
+        self.pad_h = pad_h if pad_h is not None else pad_w
+        self.ceil_mode = ceil_mode
+
+    def ceil(self) -> "SpatialMaxPooling":
+        self.ceil_mode = True
+        return self
+
+    def apply(self, variables, x, training=False, rng=None):
+        pads = _pool_padding(self.pad_h, self.pad_w, self.ceil_mode,
+                             x.shape[1], x.shape[2],
+                             self.kernel_h, self.kernel_w,
+                             self.stride_h, self.stride_w)
+        y = lax.reduce_window(
+            x, -jnp.inf, lax.max,
+            window_dimensions=(1, self.kernel_h, self.kernel_w, 1),
+            window_strides=(1, self.stride_h, self.stride_w, 1),
+            padding=pads,
+        )
+        return y, variables["state"]
+
+
+class SpatialAveragePooling(Module):
+    """Average pool (reference: nn/SpatialAveragePooling.scala;
+    count_include_pad matches the reference's default true)."""
+
+    def __init__(self, kernel_w: int, kernel_h: Optional[int] = None,
+                 stride_w: Optional[int] = None, stride_h: Optional[int] = None,
+                 pad_w: int = 0, pad_h: Optional[int] = None,
+                 ceil_mode: bool = False, count_include_pad: bool = True,
+                 divide: bool = True, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.kernel_w = kernel_w
+        self.kernel_h = kernel_h if kernel_h is not None else kernel_w
+        self.stride_w = stride_w if stride_w is not None else self.kernel_w
+        self.stride_h = stride_h if stride_h is not None else self.kernel_h
+        self.pad_w = pad_w
+        self.pad_h = pad_h if pad_h is not None else pad_w
+        self.ceil_mode = ceil_mode
+        self.count_include_pad = count_include_pad
+        self.divide = divide
+
+    def ceil(self) -> "SpatialAveragePooling":
+        self.ceil_mode = True
+        return self
+
+    def apply(self, variables, x, training=False, rng=None):
+        pads = _pool_padding(self.pad_h, self.pad_w, self.ceil_mode,
+                             x.shape[1], x.shape[2],
+                             self.kernel_h, self.kernel_w,
+                             self.stride_h, self.stride_w)
+        dims = (1, self.kernel_h, self.kernel_w, 1)
+        strides = (1, self.stride_h, self.stride_w, 1)
+        s = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+        if not self.divide:
+            return s, variables["state"]
+        if self.count_include_pad:
+            y = s / (self.kernel_h * self.kernel_w)
+        else:
+            ones = jnp.ones_like(x)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+            y = s / jnp.maximum(cnt, 1.0)
+        return y, variables["state"]

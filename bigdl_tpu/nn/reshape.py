@@ -1,0 +1,171 @@
+"""Shape-manipulation and identity layers.
+
+Reference parity: nn/Reshape.scala, nn/View.scala, nn/Squeeze.scala,
+nn/Unsqueeze.scala, nn/Select.scala, nn/Narrow.scala, nn/Transpose.scala,
+nn/Contiguous.scala (no-op under XLA), nn/Identity.scala, nn/Echo.scala,
+nn/Padding.scala / nn/SpatialZeroPadding.scala, nn/Index-style selection.
+
+Dimension arguments are 1-based *excluding* batch where the reference is
+(Reshape/View sizes exclude batch; Select/Squeeze dims are 1-based over the
+full tensor, negative allowed), matching reference conventions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+def _axis(dim: int, ndim: int) -> int:
+    """1-based (possibly negative) reference dim → 0-based axis."""
+    return dim - 1 if dim > 0 else ndim + dim
+
+
+class Reshape(Module):
+    """Reshape non-batch dims (reference: nn/Reshape.scala; `size` excludes
+    batch when batch_mode is None/True, as in the reference)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: Optional[bool] = True,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.size = tuple(int(s) for s in size)
+        self.batch_mode = batch_mode
+
+    def apply(self, variables, x, training=False, rng=None):
+        if self.batch_mode is False:
+            return x.reshape(self.size), variables["state"]
+        return x.reshape((x.shape[0],) + self.size), variables["state"]
+
+
+class View(Reshape):
+    """Alias of Reshape (reference: nn/View.scala; -1 wildcard supported)."""
+
+    def __init__(self, *size, name: Optional[str] = None):
+        if len(size) == 1 and isinstance(size[0], (tuple, list)):
+            size = tuple(size[0])
+        super().__init__(size, batch_mode=True, name=name)
+
+
+class Squeeze(Module):
+    def __init__(self, dim: Optional[int] = None, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.dim = dim
+
+    def apply(self, variables, x, training=False, rng=None):
+        if self.dim is None:
+            return jnp.squeeze(x), variables["state"]
+        return jnp.squeeze(x, axis=_axis(self.dim, x.ndim)), variables["state"]
+
+
+class Unsqueeze(Module):
+    def __init__(self, pos: int, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.pos = pos
+
+    def apply(self, variables, x, training=False, rng=None):
+        return jnp.expand_dims(x, axis=self.pos - 1), variables["state"]
+
+
+class Select(Module):
+    """Select index along a dim, removing it (reference: nn/Select.scala;
+    1-based dim and index, negative allowed)."""
+
+    def __init__(self, dim: int, index: int, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.dim = dim
+        self.index = index
+
+    def apply(self, variables, x, training=False, rng=None):
+        ax = _axis(self.dim, x.ndim)
+        idx = self.index - 1 if self.index > 0 else x.shape[ax] + self.index
+        return jnp.take(x, idx, axis=ax), variables["state"]
+
+
+class Narrow(Module):
+    """Slice `length` elements from `offset` along dim (reference: nn/Narrow.scala)."""
+
+    def __init__(self, dim: int, offset: int, length: int = 1, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.dim, self.offset, self.length = dim, offset, length
+
+    def apply(self, variables, x, training=False, rng=None):
+        ax = _axis(self.dim, x.ndim)
+        start = self.offset - 1
+        length = self.length if self.length > 0 else x.shape[ax] - start + self.length + 1
+        idx = [slice(None)] * x.ndim
+        idx[ax] = slice(start, start + length)
+        return x[tuple(idx)], variables["state"]
+
+
+class Transpose(Module):
+    """Swap listed dim pairs (reference: nn/Transpose.scala; 1-based)."""
+
+    def __init__(self, permutations: Sequence[Sequence[int]], name: Optional[str] = None):
+        super().__init__(name=name)
+        self.permutations = [tuple(p) for p in permutations]
+
+    def apply(self, variables, x, training=False, rng=None):
+        perm = list(range(x.ndim))
+        for d1, d2 in self.permutations:
+            a1, a2 = _axis(d1, x.ndim), _axis(d2, x.ndim)
+            perm[a1], perm[a2] = perm[a2], perm[a1]
+        return jnp.transpose(x, perm), variables["state"]
+
+
+class Contiguous(Module):
+    """No-op: XLA owns memory layout (reference: nn/Contiguous.scala)."""
+
+    def apply(self, variables, x, training=False, rng=None):
+        return x, variables["state"]
+
+
+class Identity(Module):
+    def apply(self, variables, x, training=False, rng=None):
+        return x, variables["state"]
+
+
+class Echo(Module):
+    """Identity that prints its input shape — host-side debug only
+    (reference: nn/Echo.scala)."""
+
+    def apply(self, variables, x, training=False, rng=None):
+        print(f"[{self.name}] shape={getattr(x, 'shape', None)} dtype={getattr(x, 'dtype', None)}")
+        return x, variables["state"]
+
+
+class SpatialZeroPadding(Module):
+    """Zero-pad H/W of NHWC input (reference: nn/SpatialZeroPadding.scala)."""
+
+    def __init__(self, pad_left: int, pad_right: Optional[int] = None,
+                 pad_top: Optional[int] = None, pad_bottom: Optional[int] = None,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.pad_left = pad_left
+        self.pad_right = pad_right if pad_right is not None else pad_left
+        self.pad_top = pad_top if pad_top is not None else pad_left
+        self.pad_bottom = pad_bottom if pad_bottom is not None else pad_left
+
+    def apply(self, variables, x, training=False, rng=None):
+        y = jnp.pad(x, ((0, 0), (self.pad_top, self.pad_bottom),
+                        (self.pad_left, self.pad_right), (0, 0)))
+        return y, variables["state"]
+
+
+class Padding(Module):
+    """Pad `pad` entries along dim (negative → before) (reference: nn/Padding.scala)."""
+
+    def __init__(self, dim: int, pad: int, n_input_dim: int,
+                 value: float = 0.0, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.dim, self.pad, self.n_input_dim, self.value = dim, pad, n_input_dim, value
+
+    def apply(self, variables, x, training=False, rng=None):
+        ax = _axis(self.dim, self.n_input_dim)
+        if x.ndim == self.n_input_dim + 1:  # batched
+            ax += 1
+        pads = [(0, 0)] * x.ndim
+        pads[ax] = (-self.pad, 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(x, pads, constant_values=self.value), variables["state"]

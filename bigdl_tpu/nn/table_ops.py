@@ -1,0 +1,224 @@
+"""Table (multi-activity) arithmetic and routing layers.
+
+Reference parity: nn/CAddTable.scala, nn/CMulTable.scala, nn/CDivTable.scala,
+nn/CSubTable.scala, nn/CMaxTable.scala, nn/CMinTable.scala,
+nn/JoinTable.scala, nn/SplitTable.scala, nn/SelectTable.scala,
+nn/FlattenTable.scala, nn/MM.scala, nn/MV.scala, nn/Cosine /
+nn/CosineDistance.scala, nn/DotProduct.scala, nn/Mean.scala, nn/Sum.scala,
+nn/Max.scala, nn/Min.scala.
+
+A "table" input here is any sequence or Table pytree of arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.table import Table, T
+
+
+def _elems(input):
+    if isinstance(input, dict):
+        return [input[k] for k in sorted(input.keys(), key=repr)]
+    return list(input)
+
+
+class _TableReduce(Module):
+    def _op(self, a, b):
+        raise NotImplementedError
+
+    def apply(self, variables, input, training=False, rng=None):
+        elems = _elems(input)
+        out = elems[0]
+        for e in elems[1:]:
+            out = self._op(out, e)
+        return out, variables["state"]
+
+
+class CAddTable(_TableReduce):
+    def __init__(self, inplace: bool = False, name: Optional[str] = None):
+        super().__init__(name=name)
+
+    def _op(self, a, b):
+        return a + b
+
+
+class CMulTable(_TableReduce):
+    def _op(self, a, b):
+        return a * b
+
+
+class CSubTable(_TableReduce):
+    def _op(self, a, b):
+        return a - b
+
+
+class CDivTable(_TableReduce):
+    def _op(self, a, b):
+        return a / b
+
+
+class CMaxTable(_TableReduce):
+    def _op(self, a, b):
+        return jnp.maximum(a, b)
+
+
+class CMinTable(_TableReduce):
+    def _op(self, a, b):
+        return jnp.minimum(a, b)
+
+
+class JoinTable(Module):
+    """Concatenate table elements along `dimension` (1-based over
+    n_input_dims-ranked elements; batch handled as in the reference)
+    (reference: nn/JoinTable.scala)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def apply(self, variables, input, training=False, rng=None):
+        elems = _elems(input)
+        ax = self.dimension - 1
+        if self.n_input_dims > 0 and elems[0].ndim == self.n_input_dims + 1:
+            ax += 1  # batched input: shift past batch dim
+        return jnp.concatenate(elems, axis=ax), variables["state"]
+
+
+class SplitTable(Module):
+    """Split a tensor along a dim into a table (reference: nn/SplitTable.scala)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def apply(self, variables, x, training=False, rng=None):
+        ax = self.dimension - 1
+        if self.n_input_dims > 0 and x.ndim == self.n_input_dims + 1:
+            ax += 1
+        parts = [jnp.squeeze(p, axis=ax) for p in jnp.split(x, x.shape[ax], axis=ax)]
+        return T(*parts), variables["state"]
+
+
+class SelectTable(Module):
+    """Pick the i-th (1-based) table element (reference: nn/SelectTable.scala)."""
+
+    def __init__(self, index: int, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.index = index
+
+    def apply(self, variables, input, training=False, rng=None):
+        elems = _elems(input)
+        idx = self.index - 1 if self.index > 0 else len(elems) + self.index
+        return elems[idx], variables["state"]
+
+
+class FlattenTable(Module):
+    """Flatten nested tables (reference: nn/FlattenTable.scala)."""
+
+    def apply(self, variables, input, training=False, rng=None):
+        out = Table()
+
+        def rec(v):
+            if isinstance(v, (dict, list, tuple)):
+                for e in _elems(v):
+                    rec(e)
+            else:
+                out.insert(v)
+
+        rec(input)
+        return out, variables["state"]
+
+
+class MM(Module):
+    """Batch matrix-matrix product of a 2-table (reference: nn/MM.scala)."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.trans_a, self.trans_b = trans_a, trans_b
+
+    def apply(self, variables, input, training=False, rng=None):
+        a, b = _elems(input)
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return a @ b, variables["state"]
+
+
+class MV(Module):
+    """Batch matrix-vector product of a 2-table (reference: nn/MV.scala)."""
+
+    def __init__(self, trans: bool = False, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.trans = trans
+
+    def apply(self, variables, input, training=False, rng=None):
+        m, v = _elems(input)
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v), variables["state"]
+
+
+class DotProduct(Module):
+    """Row-wise dot product of a 2-table (reference: nn/DotProduct.scala)."""
+
+    def apply(self, variables, input, training=False, rng=None):
+        a, b = _elems(input)
+        return jnp.sum(a * b, axis=-1), variables["state"]
+
+
+class CosineDistance(Module):
+    """Row-wise cosine similarity of a 2-table (reference: nn/CosineDistance.scala)."""
+
+    def apply(self, variables, input, training=False, rng=None):
+        a, b = _elems(input)
+        na = jnp.maximum(jnp.linalg.norm(a, axis=-1), 1e-12)
+        nb = jnp.maximum(jnp.linalg.norm(b, axis=-1), 1e-12)
+        return jnp.sum(a * b, axis=-1) / (na * nb), variables["state"]
+
+
+class _AxisReduce(Module):
+    _keep = False
+
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1,
+                 squeeze: bool = True, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+        self.squeeze = squeeze
+
+    def _op(self, x, ax, keepdims):
+        raise NotImplementedError
+
+    def apply(self, variables, x, training=False, rng=None):
+        ax = self.dimension - 1 if self.dimension > 0 else x.ndim + self.dimension
+        if self.n_input_dims > 0 and x.ndim == self.n_input_dims + 1:
+            ax += 1
+        return self._op(x, ax, not self.squeeze), variables["state"]
+
+
+class Sum(_AxisReduce):
+    def _op(self, x, ax, keepdims):
+        return jnp.sum(x, axis=ax, keepdims=keepdims)
+
+
+class Mean(_AxisReduce):
+    def _op(self, x, ax, keepdims):
+        return jnp.mean(x, axis=ax, keepdims=keepdims)
+
+
+class Max(_AxisReduce):
+    def _op(self, x, ax, keepdims):
+        return jnp.max(x, axis=ax, keepdims=keepdims)
+
+
+class Min(_AxisReduce):
+    def _op(self, x, ax, keepdims):
+        return jnp.min(x, axis=ax, keepdims=keepdims)

@@ -1,0 +1,7 @@
+"""Cross-cutting utilities (reference: bigdl/utils/)."""
+
+from bigdl_tpu.utils.table import Table, T
+from bigdl_tpu.utils.engine import Engine
+from bigdl_tpu.utils.shape import Shape
+
+__all__ = ["Table", "T", "Engine", "Shape"]

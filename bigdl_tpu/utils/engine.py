@@ -1,0 +1,100 @@
+"""Engine — runtime/topology discovery and global configuration.
+
+Reference parity: utils/Engine.scala (Engine.init, coreNumber, nodeNumber,
+Engine.model/Engine.default thread pools) and utils/ThreadPool.scala.
+
+TPU-first redesign: the reference's Engine discovers Spark executor/core
+topology and builds OpenMP-pinned thread pools; here Engine discovers the
+JAX device/process topology (PJRT) and builds the default
+`jax.sharding.Mesh`. Thread pools are unnecessary — intra-op parallelism
+belongs to XLA — so `core_number` reports host CPUs for the *input
+pipeline* only.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class Engine:
+    """Process-wide runtime info. All methods are class-level, mirroring the
+    reference's singleton `Engine` object."""
+
+    _initialized = False
+    _node_number: int = 1
+    _core_number: int = 1
+
+    @classmethod
+    def init(cls) -> None:
+        """Discover topology. Safe to call repeatedly.
+
+        Reference parity: utils/Engine.scala#Engine.init — there it
+        validates spark conf / executor cores; here it reads the PJRT
+        process group (multi-host via jax.distributed) and host cores.
+        """
+        cls._node_number = jax.process_count()
+        cls._core_number = os.cpu_count() or 1
+        cls._initialized = True
+
+    @classmethod
+    def init_distributed(
+        cls,
+        coordinator_address: Optional[str] = None,
+        num_processes: Optional[int] = None,
+        process_id: Optional[int] = None,
+    ) -> None:
+        """Multi-host bring-up: one process per TPU host (the reference ran
+        one Spark executor per node; utils/Engine.scala#Engine.init).
+
+        Wraps `jax.distributed.initialize`, which wires the PJRT process
+        group over DCN; collectives inside `jit` then span all hosts' chips.
+        """
+        if coordinator_address is not None:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        cls.init()
+
+    @classmethod
+    def node_number(cls) -> int:
+        if not cls._initialized:
+            cls.init()
+        return cls._node_number
+
+    @classmethod
+    def core_number(cls) -> int:
+        if not cls._initialized:
+            cls.init()
+        return cls._core_number
+
+    @classmethod
+    def device_count(cls) -> int:
+        return jax.device_count()
+
+    @classmethod
+    def local_device_count(cls) -> int:
+        return jax.local_device_count()
+
+    @classmethod
+    def default_mesh(cls, axis_names: Sequence[str] = ("data",)) -> jax.sharding.Mesh:
+        """Build the default mesh over all devices.
+
+        With one axis this is pure data parallelism — the direct analogue of
+        the reference's partition-per-executor layout
+        (parameters/AllReduceParameter.scala#AllReduceParameter.init).
+        """
+        devices = np.array(jax.devices())
+        if len(axis_names) == 1:
+            devices = devices.reshape(-1)
+        else:
+            raise ValueError(
+                "default_mesh builds 1-D meshes; build multi-axis meshes via "
+                "bigdl_tpu.parallel.mesh.make_mesh"
+            )
+        return jax.sharding.Mesh(devices, axis_names)

@@ -1,0 +1,50 @@
+"""Table — heterogeneous, 1-indexed activity container.
+
+Reference parity: utils/Table.scala#Table and the `T()` factory. In the
+reference a Table is the `Activity` used for multi-input/multi-output
+modules. Here a Table is a *pytree* (registered with JAX), so tables flow
+through `jit`/`grad`/`vmap` unchanged; plain tuples/lists/dicts are equally
+accepted anywhere an activity is expected.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class Table(dict):
+    """Dict with 1-indexed integer convenience access, registered as a pytree.
+
+    ``T(a, b, c)`` builds ``Table({1: a, 2: b, 3: c})`` mirroring the
+    reference's ``T()`` factory (utils/Table.scala#T.apply).
+    """
+
+    def insert(self, value):
+        self[len(self) + 1] = value
+        return self
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}: {v!r}" for k, v in self.items())
+        return f"Table({inner})"
+
+
+def _table_flatten(t: Table):
+    keys = sorted(t.keys(), key=repr)
+    return [t[k] for k in keys], tuple(keys)
+
+
+def _table_unflatten(keys, values):
+    return Table(zip(keys, values))
+
+
+jax.tree_util.register_pytree_node(Table, _table_flatten, _table_unflatten)
+
+
+def T(*args, **kwargs) -> Table:
+    """Build a Table: positional args become 1-indexed entries."""
+    t = Table()
+    for v in args:
+        t.insert(v)
+    for k, v in kwargs.items():
+        t[k] = v
+    return t

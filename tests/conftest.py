@@ -1,0 +1,33 @@
+"""Test configuration.
+
+Mirrors the reference's test strategy (SURVEY.md §4): the reference tests
+distributed code paths on Spark `local[N]` without a cluster; we test
+multi-chip code paths on a virtual 8-device CPU mesh via
+`--xla_force_host_platform_device_count` — the real sharding/collective
+code runs unchanged.
+
+Environment note: this image boots an `axon` PJRT plugin (remote TPU
+tunnel) via sitecustomize, and initializing it blocks on the tunnel. Tests
+must run CPU-only, so we force the platform to cpu AND drop the axon
+factory from the backend registry before any backend is materialized.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge
+
+    xla_bridge._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+jax.config.update("jax_enable_x64", False)

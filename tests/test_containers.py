@@ -1,0 +1,120 @@
+"""Container and Graph tests (reference: nn/GraphSpec.scala, SequentialSpec)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.table import T
+
+KEY = jax.random.PRNGKey(0)
+
+
+class TestSequential:
+    def test_chained_forward(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2)).build(KEY)
+        out = m.evaluate().forward(jnp.ones((3, 4)))
+        assert out.shape == (3, 2)
+
+    def test_add_api(self):
+        m = nn.Sequential()
+        m.add(nn.Linear(4, 4)).add(nn.Tanh())
+        assert len(m) == 2
+        out = m.build(KEY).evaluate().forward(jnp.ones((1, 4)))
+        assert out.shape == (1, 4)
+
+    def test_params_namespaced(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Linear(2, 2)).build(KEY)
+        names = [n for n, _ in m.parameters()]
+        assert len(names) == 4  # 2 weights + 2 biases
+        assert len(set(names)) == 4
+
+    def test_get_parameters_flat(self):
+        m = nn.Sequential(nn.Linear(2, 3)).build(KEY)
+        flat = m.get_parameters()
+        assert flat.shape == (2 * 3 + 3,)
+
+
+class TestConcatContainers:
+    def test_concat_table(self):
+        m = nn.ConcatTable(nn.Identity(), nn.Identity()).build(KEY)
+        out = m.evaluate().forward(jnp.ones(3))
+        assert len(out) == 2
+
+    def test_parallel_table(self):
+        m = nn.ParallelTable(nn.Linear(2, 3), nn.Linear(4, 5)).build(KEY)
+        out = m.evaluate().forward(T(jnp.ones((1, 2)), jnp.ones((1, 4))))
+        assert out[1].shape == (1, 3)
+        assert out[2].shape == (1, 5)
+
+    def test_concat_dim(self):
+        m = nn.Concat(2, nn.Linear(3, 2), nn.Linear(3, 4)).build(KEY)
+        out = m.evaluate().forward(jnp.ones((5, 3)))
+        assert out.shape == (5, 6)
+
+    def test_residual_block_pattern(self):
+        # ConcatTable + CAddTable = residual connection, the reference's
+        # ResNet idiom (models/resnet/ResNet.scala)
+        block = nn.Sequential(
+            nn.ConcatTable(nn.Linear(4, 4), nn.Identity()),
+            nn.CAddTable(),
+        ).build(KEY)
+        out = block.evaluate().forward(jnp.ones((2, 4)))
+        assert out.shape == (2, 4)
+
+
+class TestGraph:
+    def test_linear_graph(self):
+        x = nn.Input()
+        h = nn.Linear(4, 8)(x)
+        r = nn.ReLU()(h)
+        y = nn.Linear(8, 2)(r)
+        g = nn.Graph(x, y).build(KEY)
+        out = g.evaluate().forward(jnp.ones((3, 4)))
+        assert out.shape == (3, 2)
+
+    def test_diamond_graph(self):
+        x = nn.Input()
+        a = nn.Linear(4, 4)(x)
+        b1 = nn.ReLU()(a)
+        b2 = nn.Tanh()(a)
+        merged = nn.CAddTable()(b1, b2)
+        g = nn.Graph(x, merged).build(KEY)
+        out = g.evaluate().forward(jnp.ones((2, 4)))
+        assert out.shape == (2, 4)
+
+    def test_multi_input_output(self):
+        x1, x2 = nn.Input(), nn.Input()
+        h1 = nn.Linear(2, 3)(x1)
+        h2 = nn.Linear(2, 3)(x2)
+        s = nn.CAddTable()(h1, h2)
+        g = nn.Graph([x1, x2], [s, h1]).build(KEY)
+        out = g.evaluate().forward(jnp.ones((1, 2)), jnp.ones((1, 2)))
+        assert len(out) == 2
+        assert out[1].shape == (1, 3)
+
+    def test_grad_through_graph(self):
+        x = nn.Input()
+        y = nn.Linear(3, 1)(nn.Tanh()(nn.Linear(3, 3)(x)))
+        g = nn.Graph(x, y)
+        variables = g.init(KEY)
+
+        def loss(params):
+            out, _ = g.apply({"params": params, "state": variables["state"]},
+                             jnp.ones((4, 3)))
+            return jnp.sum(out)
+
+        grads = jax.grad(loss)(variables["params"])
+        total = sum(float(np.abs(np.asarray(l)).sum())
+                    for l in jax.tree_util.tree_leaves(grads))
+        assert total > 0
+
+    def test_jit_apply(self):
+        m = nn.Sequential(nn.Linear(4, 4), nn.ReLU()).build(KEY)
+
+        @jax.jit
+        def f(variables, x):
+            return m.apply(variables, x)[0]
+
+        out = f(m.variables, jnp.ones((2, 4)))
+        assert out.shape == (2, 4)

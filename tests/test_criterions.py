@@ -1,0 +1,105 @@
+"""Criterion tests vs torch oracle (reference: nn/*CriterionSpec.scala)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.table import T
+
+
+class TestClassNLL:
+    def test_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        logits = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+        target = np.array([0, 1, 2, 3, 1])
+        logp = jax.nn.log_softmax(jnp.asarray(logits), -1)
+        ours = nn.ClassNLLCriterion()(logp, jnp.asarray(target))
+        ref = torch.nn.functional.nll_loss(
+            torch.log_softmax(torch.tensor(logits), -1), torch.tensor(target))
+        np.testing.assert_allclose(float(ours), float(ref), rtol=1e-5)
+
+    def test_weighted(self):
+        w = jnp.asarray([1.0, 2.0])
+        logp = jnp.log(jnp.asarray([[0.9, 0.1], [0.2, 0.8]]))
+        tgt = jnp.asarray([0, 1])
+        ours = float(nn.ClassNLLCriterion(weights=w)(logp, tgt))
+        expect = -(1.0 * np.log(0.9) + 2.0 * np.log(0.8)) / 3.0
+        np.testing.assert_allclose(ours, expect, rtol=1e-5)
+
+
+class TestCrossEntropy:
+    def test_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        logits = np.random.RandomState(1).randn(6, 3).astype(np.float32)
+        target = np.array([0, 1, 2, 0, 1, 2])
+        ours = nn.CrossEntropyCriterion()(jnp.asarray(logits), jnp.asarray(target))
+        ref = torch.nn.functional.cross_entropy(
+            torch.tensor(logits), torch.tensor(target))
+        np.testing.assert_allclose(float(ours), float(ref), rtol=1e-5)
+
+    def test_grad(self):
+        g = jax.grad(lambda x: nn.CrossEntropyCriterion()(x, jnp.asarray([1])))(
+            jnp.asarray([[1.0, 2.0, 3.0]]))
+        p = jax.nn.softmax(jnp.asarray([1.0, 2.0, 3.0]))
+        np.testing.assert_allclose(g[0], p - jnp.asarray([0, 1.0, 0]), rtol=1e-5)
+
+
+class TestRegression:
+    def test_mse(self):
+        ours = nn.MSECriterion()(jnp.asarray([1.0, 2.0]), jnp.asarray([0.0, 0.0]))
+        np.testing.assert_allclose(float(ours), 2.5)
+
+    def test_mse_sum(self):
+        c = nn.MSECriterion(size_average=False)
+        np.testing.assert_allclose(
+            float(c(jnp.asarray([1.0, 2.0]), jnp.zeros(2))), 5.0)
+
+    def test_abs(self):
+        np.testing.assert_allclose(
+            float(nn.AbsCriterion()(jnp.asarray([1.0, -3.0]), jnp.zeros(2))), 2.0)
+
+    def test_smooth_l1_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.RandomState(2).randn(10).astype(np.float32) * 2
+        ours = nn.SmoothL1Criterion()(jnp.asarray(x), jnp.zeros(10))
+        ref = torch.nn.functional.smooth_l1_loss(
+            torch.tensor(x), torch.zeros(10))
+        np.testing.assert_allclose(float(ours), float(ref), rtol=1e-5)
+
+
+class TestBCE:
+    def test_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        p = np.random.RandomState(3).rand(8).astype(np.float32)
+        t = (np.random.RandomState(4).rand(8) > 0.5).astype(np.float32)
+        ours = nn.BCECriterion()(jnp.asarray(p), jnp.asarray(t))
+        ref = torch.nn.functional.binary_cross_entropy(
+            torch.tensor(p), torch.tensor(t))
+        np.testing.assert_allclose(float(ours), float(ref), rtol=1e-4)
+
+
+class TestComposite:
+    def test_parallel_criterion(self):
+        pc = (nn.ParallelCriterion()
+              .add(nn.MSECriterion(), 0.5)
+              .add(nn.AbsCriterion(), 2.0))
+        loss = pc(T(jnp.asarray([2.0]), jnp.asarray([1.0])),
+                  T(jnp.asarray([0.0]), jnp.asarray([0.0])))
+        np.testing.assert_allclose(float(loss), 0.5 * 4.0 + 2.0 * 1.0)
+
+    def test_multi_criterion(self):
+        mc = nn.MultiCriterion().add(nn.MSECriterion()).add(nn.AbsCriterion())
+        loss = mc(jnp.asarray([2.0]), jnp.asarray([0.0]))
+        np.testing.assert_allclose(float(loss), 4.0 + 2.0)
+
+    def test_time_distributed(self):
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), size_average=True)
+        logp = jnp.log(jnp.full((2, 3, 4), 0.25))
+        tgt = jnp.zeros((2, 3), jnp.int32)
+        np.testing.assert_allclose(float(crit(logp, tgt)), -np.log(0.25), rtol=1e-6)
+
+    def test_kld(self):
+        loss = nn.KLDCriterion()(T(jnp.zeros((2, 3)), jnp.zeros((2, 3))), None)
+        np.testing.assert_allclose(float(loss), 0.0, atol=1e-6)

@@ -1,0 +1,273 @@
+"""Layer unit tests — numpy/torch-oracle style.
+
+Mirrors the reference's per-layer `XxxSpec.scala` strategy (SURVEY.md §4):
+fixed-seed forward checks against hand-computed or torch (CPU) oracle
+values, plus shape/edge cases. torch plays the role the reference gave
+Torch7 (`torch/TH.scala` golden tests).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+
+KEY = jax.random.PRNGKey(0)
+
+
+def eager(mod, x, training=False, rng=None):
+    mod.build(KEY)
+    if training:
+        mod.training()
+    else:
+        mod.evaluate()
+    return np.asarray(mod.forward(x, rng=rng))
+
+
+class TestLinear:
+    def test_forward_matches_manual(self):
+        m = nn.Linear(3, 2).build(KEY)
+        w = m.variables["params"]["weight"]
+        b = m.variables["params"]["bias"]
+        x = jnp.asarray([[1.0, 2.0, 3.0]])
+        out = m.forward(x)
+        np.testing.assert_allclose(out, x @ w + b, rtol=1e-6)
+
+    def test_no_bias(self):
+        m = nn.Linear(3, 2, with_bias=False).build(KEY)
+        assert "bias" not in m.variables["params"]
+
+    def test_xavier_bounds(self):
+        m = nn.Linear(100, 100).build(KEY)
+        w = m.variables["params"]["weight"]
+        bound = np.sqrt(6.0 / 200)
+        assert np.abs(w).max() <= bound + 1e-6
+
+    def test_grad_flows(self):
+        m = nn.Linear(4, 2)
+        variables = m.init(KEY)
+
+        def loss(params):
+            out, _ = m.apply({"params": params, "state": {}}, jnp.ones((5, 4)))
+            return jnp.sum(out ** 2)
+
+        g = jax.grad(loss)(variables["params"])
+        assert g["weight"].shape == (4, 2)
+        assert np.abs(np.asarray(g["weight"])).sum() > 0
+
+
+class TestConv:
+    def test_shape_basic(self):
+        m = nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1)
+        x = jnp.ones((2, 16, 16, 3))
+        assert eager(m, x).shape == (2, 16, 16, 8)
+
+    def test_stride_pad(self):
+        m = nn.SpatialConvolution(1, 4, 5, 5, 2, 2, 0, 0)
+        x = jnp.ones((1, 28, 28, 1))
+        assert eager(m, x).shape == (1, 12, 12, 4)
+
+    def test_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        m = nn.SpatialConvolution(2, 3, 3, 3, 1, 1, 1, 1).build(KEY)
+        w = np.asarray(m.variables["params"]["weight"])  # HWIO
+        b = np.asarray(m.variables["params"]["bias"])
+        x = np.random.RandomState(0).randn(2, 5, 5, 2).astype(np.float32)
+        ours = np.asarray(m.evaluate().forward(jnp.asarray(x)))
+        tw = torch.tensor(w.transpose(3, 2, 0, 1))  # HWIO->OIHW
+        tx = torch.tensor(x.transpose(0, 3, 1, 2))  # NHWC->NCHW
+        ref = torch.nn.functional.conv2d(tx, tw, torch.tensor(b), padding=1)
+        np.testing.assert_allclose(
+            ours, ref.numpy().transpose(0, 2, 3, 1), rtol=1e-4, atol=1e-5)
+
+    def test_grouped(self):
+        m = nn.SpatialConvolution(4, 8, 3, 3, 1, 1, 1, 1, n_group=2)
+        x = jnp.ones((1, 8, 8, 4))
+        assert eager(m, x).shape == (1, 8, 8, 8)
+
+    def test_dilated(self):
+        m = nn.SpatialDilatedConvolution(1, 1, 3, 3, 1, 1, 2, 2, dilation_w=2)
+        x = jnp.ones((1, 9, 9, 1))
+        assert eager(m, x).shape == (1, 9, 9, 1)
+
+    def test_transposed_upsamples(self):
+        m = nn.SpatialFullConvolution(2, 3, 4, 4, 2, 2, 1, 1)
+        x = jnp.ones((1, 8, 8, 2))
+        # out = (in-1)*stride - 2*pad + kernel = 7*2 - 2 + 4 = 16
+        assert eager(m, x).shape == (1, 16, 16, 3)
+
+
+class TestPooling:
+    def test_max_pool(self):
+        m = nn.SpatialMaxPooling(2, 2, 2, 2)
+        x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+        out = eager(m, x)
+        np.testing.assert_allclose(out[0, :, :, 0], [[5, 7], [13, 15]])
+
+    def test_avg_pool(self):
+        m = nn.SpatialAveragePooling(2, 2, 2, 2)
+        x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+        out = eager(m, x)
+        np.testing.assert_allclose(out[0, :, :, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+    def test_ceil_mode(self):
+        # 6x6, k=3, s=2: floor -> (6-3)//2+1 = 2; ceil -> ceil(1.5)+1 = 3
+        x = jnp.ones((1, 6, 6, 1))
+        m = nn.SpatialMaxPooling(3, 3, 2, 2).ceil()
+        assert eager(m, x).shape == (1, 3, 3, 1)
+        m2 = nn.SpatialMaxPooling(3, 3, 2, 2)
+        assert eager(m2, x).shape == (1, 2, 2, 1)
+
+
+class TestBatchNorm:
+    def test_train_normalizes(self):
+        m = nn.BatchNormalization(4).build(KEY).training()
+        x = jax.random.normal(KEY, (100, 4)) * 5 + 3
+        out = m.forward(x)
+        np.testing.assert_allclose(np.asarray(out).mean(0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(out).std(0), 1.0, atol=1e-2)
+
+    def test_running_stats_update(self):
+        m = nn.BatchNormalization(2, momentum=0.5).build(KEY).training()
+        x = jnp.ones((10, 2)) * 4
+        m.forward(x)
+        np.testing.assert_allclose(
+            m.variables["state"]["running_mean"], [2.0, 2.0], atol=1e-6)
+
+    def test_eval_uses_running_stats(self):
+        m = nn.BatchNormalization(2, affine=False).build(KEY).evaluate()
+        x = jnp.asarray([[1.0, 2.0]])
+        out = m.forward(x)  # running mean 0, var 1
+        np.testing.assert_allclose(out, x, atol=1e-4)
+
+    def test_spatial_bn_shape(self):
+        m = nn.SpatialBatchNormalization(3)
+        x = jnp.ones((2, 4, 4, 3))
+        assert eager(m, x, training=True).shape == (2, 4, 4, 3)
+
+
+class TestActivations:
+    def test_relu(self):
+        out = eager(nn.ReLU(), jnp.asarray([-1.0, 2.0]))
+        np.testing.assert_allclose(out, [0.0, 2.0])
+
+    def test_logsoftmax_sums_to_one(self):
+        out = eager(nn.LogSoftMax(), jnp.asarray([[1.0, 2.0, 3.0]]))
+        np.testing.assert_allclose(np.exp(out).sum(), 1.0, rtol=1e-6)
+
+    def test_prelu_learnable(self):
+        m = nn.PReLU().build(KEY)
+        out = m.forward(jnp.asarray([-4.0, 4.0]))
+        np.testing.assert_allclose(out, [-1.0, 4.0])
+
+    def test_hardtanh(self):
+        out = eager(nn.HardTanh(-2, 2), jnp.asarray([-5.0, 0.5, 5.0]))
+        np.testing.assert_allclose(out, [-2.0, 0.5, 2.0])
+
+    def test_relu6(self):
+        out = eager(nn.ReLU6(), jnp.asarray([-1.0, 3.0, 9.0]))
+        np.testing.assert_allclose(out, [0.0, 3.0, 6.0])
+
+
+class TestDropout:
+    def test_eval_is_identity(self):
+        m = nn.Dropout(0.5)
+        x = jnp.ones((10, 10))
+        np.testing.assert_allclose(eager(m, x), x)
+
+    def test_train_masks_and_scales(self):
+        m = nn.Dropout(0.5).build(KEY).training()
+        x = jnp.ones((100, 100))
+        out = np.asarray(m.forward(x, rng=jax.random.PRNGKey(1)))
+        vals = np.unique(out)
+        assert set(np.round(vals, 4)) <= {0.0, 2.0}
+        assert abs((out == 0).mean() - 0.5) < 0.05
+
+    def test_train_without_rng_raises(self):
+        m = nn.Dropout(0.5).build(KEY).training()
+        with pytest.raises(ValueError):
+            m.forward(jnp.ones((2, 2)))
+
+
+class TestShapeOps:
+    def test_reshape(self):
+        out = eager(nn.Reshape([4]), jnp.ones((2, 2, 2)))
+        assert out.shape == (2, 4)
+
+    def test_view_wildcard(self):
+        out = eager(nn.View(-1), jnp.ones((3, 2, 5)))
+        assert out.shape == (3, 10)
+
+    def test_select(self):
+        x = jnp.arange(12.0).reshape(3, 4)
+        out = eager(nn.Select(1, 2), x)  # second row (1-based)
+        np.testing.assert_allclose(out, [4, 5, 6, 7])
+
+    def test_transpose(self):
+        out = eager(nn.Transpose([(1, 2)]), jnp.ones((3, 4)))
+        assert out.shape == (4, 3)
+
+    def test_narrow(self):
+        x = jnp.arange(10.0)[None, :].repeat(2, 0)
+        out = eager(nn.Narrow(2, 3, 4), x)
+        assert out.shape == (2, 4)
+        np.testing.assert_allclose(out[0], [2, 3, 4, 5])
+
+    def test_zero_padding(self):
+        out = eager(nn.SpatialZeroPadding(1), jnp.ones((1, 4, 4, 1)))
+        assert out.shape == (1, 6, 6, 1)
+        assert out[0, 0, 0, 0] == 0
+
+
+class TestTableOps:
+    def test_cadd_table(self):
+        out = eager(nn.CAddTable(), (jnp.ones(3), jnp.ones(3) * 2))
+        np.testing.assert_allclose(out, [3.0, 3.0, 3.0])
+
+    def test_join_table(self):
+        a, b = jnp.ones((2, 3)), jnp.zeros((2, 3))
+        out = eager(nn.JoinTable(1, n_input_dims=1), (a, b))
+        assert out.shape == (2, 6)
+
+    def test_split_select(self):
+        x = jnp.arange(6.0).reshape(2, 3)
+        m = nn.SplitTable(2).build(KEY).evaluate()  # 1-based dim over full tensor
+        table = m.forward(x)
+        assert len(table) == 3
+        np.testing.assert_allclose(table[1], [0.0, 3.0])
+        out = eager(nn.SelectTable(2), table)
+        np.testing.assert_allclose(out, [1.0, 4.0])
+
+    def test_mm(self):
+        a = jnp.ones((2, 3, 4))
+        b = jnp.ones((2, 4, 5))
+        out = eager(nn.MM(), (a, b))
+        assert out.shape == (2, 3, 5)
+        np.testing.assert_allclose(out[0, 0, 0], 4.0)
+
+
+class TestLookupTable:
+    def test_gather(self):
+        m = nn.LookupTable(10, 4).build(KEY)
+        out = m.forward(jnp.asarray([[0, 3], [9, 1]]))
+        assert out.shape == (2, 2, 4)
+        w = np.asarray(m.variables["params"]["weight"])
+        np.testing.assert_allclose(out[0, 1], w[3], rtol=1e-6)
+
+    def test_padding_value_zeros(self):
+        m = nn.LookupTable(10, 4, padding_value=0).build(KEY)
+        out = m.forward(jnp.asarray([0, 1]))
+        np.testing.assert_allclose(np.asarray(out)[0], 0.0)
+
+
+class TestLRN:
+    def test_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        m = nn.SpatialCrossMapLRN(5, 1.0, 0.75, 1.0)
+        x = np.random.RandomState(1).rand(2, 4, 4, 8).astype(np.float32)
+        ours = eager(m, jnp.asarray(x))
+        ref = torch.nn.functional.local_response_norm(
+            torch.tensor(x.transpose(0, 3, 1, 2)), 5, alpha=1.0, beta=0.75, k=1.0)
+        np.testing.assert_allclose(
+            ours, ref.numpy().transpose(0, 2, 3, 1), rtol=1e-4, atol=1e-5)
