@@ -1,0 +1,10 @@
+"""bigdl_tpu.dataset — host-side data plane (reference: bigdl/dataset/)."""
+
+from bigdl_tpu.dataset.sample import Sample, MiniBatch
+from bigdl_tpu.dataset.transformer import (
+    Transformer, ChainedTransformer, chain, MapTransformer, SampleToMiniBatch,
+)
+from bigdl_tpu.dataset.dataset import (
+    AbstractDataSet, LocalDataSet, ShardedDataSet, TransformedDataSet, DataSet,
+)
+from bigdl_tpu.dataset import image, text, mnist, cifar

@@ -1,0 +1,50 @@
+"""CIFAR-10 loader.
+
+Reference parity: models/resnet/Utils.scala `loadTrain`/`loadTest` (the
+python-pickle-free binary version: each record is 1 label byte + 3072
+pixel bytes, data_batch_{1..5}.bin / test_batch.bin) and the
+reference's CIFAR normalization constants.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+
+# reference models/resnet/Utils.scala: trainMean/trainStd (RGB order)
+TRAIN_MEAN = np.asarray([125.30691805, 122.95039414, 113.86538318], np.float32)
+TRAIN_STD = np.asarray([62.99321928, 62.08870764, 66.70489964], np.float32)
+
+
+def _read_bin(path: str):
+    raw = np.fromfile(path, np.uint8).reshape(-1, 3073)
+    labels = raw[:, 0].astype(np.int32)
+    # stored CHW planes; convert to HWC (TPU-first channels-last)
+    imgs = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return imgs, labels
+
+
+def load_cifar10(folder: str, train: bool = True) -> List[Sample]:
+    files = ([f"data_batch_{i}.bin" for i in range(1, 6)] if train
+             else ["test_batch.bin"])
+    samples: List[Sample] = []
+    for fname in files:
+        imgs, labels = _read_bin(os.path.join(folder, fname))
+        feats = (imgs.astype(np.float32) - TRAIN_MEAN) / TRAIN_STD
+        samples.extend(Sample(feats[i], labels[i]) for i in range(len(labels)))
+    return samples
+
+
+def synthetic_cifar10(n: int = 256, seed: int = 0) -> List[Sample]:
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        label = rng.randint(0, 10)
+        img = rng.randn(32, 32, 3).astype(np.float32) * 0.3
+        img[:, :, label % 3] += 0.5 + 0.2 * label
+        out.append(Sample(img, np.int32(label)))
+    return out

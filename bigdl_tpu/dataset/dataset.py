@@ -1,0 +1,144 @@
+"""DataSet abstractions.
+
+Reference parity: dataset/DataSet.scala — `LocalDataSet` (in-memory array,
+`data(train=)` iterator contract, per-epoch shuffle), `DataSet.array(...)`
+factories; `CachedDistriDataSet`'s role (partitioned, cached, per-partition
+shuffle) maps to `ShardedDataSet`: deterministic per-host sharding for
+multi-host TPU training — each process owns `indices[process_id::count]`,
+mirroring "Spark only partitions data" (SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import MiniBatch, Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+
+class AbstractDataSet:
+    """`data(train)` iterator + `size()` (reference: dataset/DataSet.scala)."""
+
+    def data(self, train: bool) -> Iterator:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self) -> None:
+        pass
+
+    def transform(self, transformer: Transformer) -> "TransformedDataSet":
+        """Attach a transformer chain (the reference's `dataset -> transformer`)."""
+        return TransformedDataSet(self, transformer)
+
+    def __rshift__(self, transformer: Transformer) -> "TransformedDataSet":
+        return self.transform(transformer)
+
+
+class LocalDataSet(AbstractDataSet):
+    """In-memory dataset (reference: dataset/LocalArrayDataSet).
+
+    train=True iterates forever over reshuffled epochs (the reference's
+    looped iterator contract); train=False iterates once in order.
+    """
+
+    def __init__(self, elements: Sequence, seed: int = 1):
+        self.elements = list(elements)
+        self._rng = np.random.RandomState(seed)
+        self._perm = np.arange(len(self.elements))
+
+    def size(self) -> int:
+        return len(self.elements)
+
+    def shuffle(self) -> None:
+        self._rng.shuffle(self._perm)
+
+    def data(self, train: bool) -> Iterator:
+        if not train:
+            yield from self.elements
+            return
+        while True:
+            self.shuffle()
+            for i in self._perm:
+                yield self.elements[i]
+
+
+class ShardedDataSet(AbstractDataSet):
+    """Deterministic per-process shard of a dataset for multi-host training.
+
+    Reference parity: dataset/DataSet.scala#CachedDistriDataSet — there
+    Spark partitions the RDD and each executor iterates its cached
+    partition with a local shuffle. Here each TPU host process takes the
+    strided shard `indices[pid::nproc]` of a common permutation derived
+    from a shared seed + epoch, so hosts stay in lockstep without any
+    coordination traffic.
+    """
+
+    def __init__(self, elements: Sequence, process_id: Optional[int] = None,
+                 process_count: Optional[int] = None, seed: int = 1):
+        import jax
+
+        self.elements = list(elements)
+        self.pid = jax.process_index() if process_id is None else process_id
+        self.nproc = jax.process_count() if process_count is None else process_count
+        self.seed = seed
+        self.epoch = 0
+
+    def size(self) -> int:
+        # per-shard size (the reference reports partition-local counts too)
+        return len(range(self.pid, len(self.elements), self.nproc))
+
+    def total_size(self) -> int:
+        return len(self.elements)
+
+    def data(self, train: bool) -> Iterator:
+        if not train:
+            for i in range(self.pid, len(self.elements), self.nproc):
+                yield self.elements[i]
+            return
+        while True:
+            # same permutation on every host: seed ⊕ epoch
+            perm = np.random.RandomState(self.seed + self.epoch).permutation(
+                len(self.elements))
+            shard = perm[self.pid::self.nproc]
+            for i in shard:
+                yield self.elements[i]
+            self.epoch += 1
+
+
+class TransformedDataSet(AbstractDataSet):
+    """A dataset with a transformer chain attached."""
+
+    def __init__(self, base: AbstractDataSet, transformer: Transformer):
+        self.base = base
+        self.transformer = transformer
+
+    def size(self) -> int:
+        return self.base.size()
+
+    def shuffle(self) -> None:
+        self.base.shuffle()
+
+    def transform(self, transformer: Transformer) -> "TransformedDataSet":
+        from bigdl_tpu.dataset.transformer import ChainedTransformer
+
+        return TransformedDataSet(
+            self.base, ChainedTransformer(self.transformer, transformer))
+
+    def data(self, train: bool) -> Iterator:
+        return self.transformer(self.base.data(train))
+
+
+class DataSet:
+    """Factory namespace (reference: dataset/DataSet object)."""
+
+    @staticmethod
+    def array(elements: Sequence, seed: int = 1) -> LocalDataSet:
+        return LocalDataSet(elements, seed=seed)
+
+    @staticmethod
+    def sharded(elements: Sequence, **kw) -> ShardedDataSet:
+        return ShardedDataSet(elements, **kw)

@@ -1,0 +1,82 @@
+"""MNIST loader.
+
+Reference parity: models/lenet/Utils.scala `load` (IDX ubyte format:
+big-endian magic 2051/2049, train-images-idx3-ubyte etc.) and the
+`BytesToGreyImg >> GreyImgNormalizer >> GreyImgToSample` chain
+(models/lenet/Train.scala).
+
+`load_mnist(path)` reads the standard IDX files if present; tests and the
+perf harness use `synthetic_mnist` (no network in this environment).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import List, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+
+TRAIN_MEAN = 0.13066047740239436 * 255
+TRAIN_STD = 0.3081078 * 255
+TEST_MEAN = 0.13251460696903547 * 255
+TEST_STD = 0.31048024 * 255
+
+
+def _open(path):
+    return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+
+def read_idx_images(path: str) -> np.ndarray:
+    with _open(path) as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"{path}: bad magic {magic} (want 2051)")
+        data = np.frombuffer(f.read(n * rows * cols), np.uint8)
+    return data.reshape(n, rows, cols)
+
+
+def read_idx_labels(path: str) -> np.ndarray:
+    with _open(path) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"{path}: bad magic {magic} (want 2049)")
+        return np.frombuffer(f.read(n), np.uint8)
+
+
+def _find(folder: str, stem: str) -> str:
+    for suffix in ("", ".gz"):
+        p = os.path.join(folder, stem + suffix)
+        if os.path.exists(p):
+            return p
+    raise FileNotFoundError(f"{stem} not found under {folder}")
+
+
+def load_mnist(folder: str, train: bool = True) -> List[Sample]:
+    """Load IDX MNIST into normalized HWC float Samples with int labels."""
+    stem = "train" if train else "t10k"
+    images = read_idx_images(_find(folder, f"{stem}-images-idx3-ubyte"))
+    labels = read_idx_labels(_find(folder, f"{stem}-labels-idx1-ubyte"))
+    mean, std = (TRAIN_MEAN, TRAIN_STD) if train else (TEST_MEAN, TEST_STD)
+    feats = (images.astype(np.float32) - mean) / std
+    return [Sample(feats[i][..., None], np.int32(labels[i]))
+            for i in range(len(labels))]
+
+
+def synthetic_mnist(n: int = 512, seed: int = 0,
+                    separable: bool = True) -> List[Sample]:
+    """Synthetic stand-in with class-dependent structure so models can
+    actually learn (each class gets a distinct bright patch pattern)."""
+    rng = np.random.RandomState(seed)
+    samples = []
+    for _ in range(n):
+        label = rng.randint(0, 10)
+        img = rng.randn(28, 28).astype(np.float32) * 0.25
+        if separable:
+            r, c = divmod(label, 4)
+            img[4 + r * 7:11 + r * 7, 2 + c * 6:9 + c * 6] += 2.0
+        samples.append(Sample(img[..., None], np.int32(label)))
+    return samples

@@ -1,0 +1,104 @@
+"""Sample and MiniBatch.
+
+Reference parity: dataset/Sample.scala (feature+label tensor pair),
+dataset/MiniBatch.scala (batched samples; `slice` for per-thread splits),
+dataset/SampleToMiniBatch (the batcher lives in transformer.py).
+
+Host-side data is numpy (cheap mutation, no device traffic); conversion to
+device arrays happens once per step at the jit boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class Sample:
+    """One training example: feature(s) + label(s)
+    (reference: dataset/Sample.scala#Sample)."""
+
+    __slots__ = ("feature", "label")
+
+    def __init__(self, feature, label=None):
+        self.feature = np.asarray(feature) if not isinstance(feature, (tuple, list)) \
+            else tuple(np.asarray(f) for f in feature)
+        if label is None:
+            self.label = None
+        elif isinstance(label, (tuple, list)):
+            self.label = tuple(np.asarray(l) for l in label)
+        else:
+            self.label = np.asarray(label)
+
+    def feature_size(self):
+        if isinstance(self.feature, tuple):
+            return tuple(f.shape for f in self.feature)
+        return self.feature.shape
+
+    def label_size(self):
+        if self.label is None:
+            return None
+        if isinstance(self.label, tuple):
+            return tuple(l.shape for l in self.label)
+        return self.label.shape
+
+    def __repr__(self):
+        return f"Sample(feature={self.feature_size()}, label={self.label_size()})"
+
+
+class MiniBatch:
+    """A batch of stacked samples (reference: dataset/MiniBatch.scala).
+
+    `input`/`target` are numpy arrays (or tuples of arrays for multi-IO).
+    `slice(offset, length)` mirrors the reference's per-thread split API.
+    """
+
+    def __init__(self, input, target=None):
+        self.input = input
+        self.target = target
+
+    @staticmethod
+    def from_samples(samples: Sequence[Sample],
+                     pad_to: Optional[int] = None) -> "MiniBatch":
+        """Stack samples; optionally right-pad the batch dim to `pad_to` by
+        repeating the last sample (keeps jit shapes static for the final
+        partial batch — the reference instead drops or shrinks)."""
+        n = len(samples)
+        if pad_to is not None and n < pad_to:
+            samples = list(samples) + [samples[-1]] * (pad_to - n)
+
+        def stack(get):
+            first = get(samples[0])
+            if first is None:
+                return None
+            if isinstance(first, tuple):
+                return tuple(np.stack([get(s)[i] for s in samples])
+                             for i in range(len(first)))
+            return np.stack([get(s) for s in samples])
+
+        mb = MiniBatch(stack(lambda s: s.feature), stack(lambda s: s.label))
+        mb.real_size = n
+        return mb
+
+    @property
+    def size(self) -> int:
+        first = self.input[0] if isinstance(self.input, tuple) else self.input
+        return first.shape[0]
+
+    def slice(self, offset: int, length: int) -> "MiniBatch":
+        """0-based slice along batch (reference MiniBatch.slice is 1-based)."""
+
+        def cut(x):
+            if x is None:
+                return None
+            if isinstance(x, tuple):
+                return tuple(e[offset:offset + length] for e in x)
+            return x[offset:offset + length]
+
+        return MiniBatch(cut(self.input), cut(self.target))
+
+    def __repr__(self):
+        shp = (tuple(i.shape for i in self.input)
+               if isinstance(self.input, tuple) else self.input.shape)
+        return f"MiniBatch(input={shp}, size={self.size})"

@@ -1,0 +1,86 @@
+"""Transformer — composable preprocessing over iterators.
+
+Reference parity: dataset/Transformer.scala (`Transformer[A,B]` applied to
+an Iterator, chained with `->`) and dataset/SampleToMiniBatch.scala.
+
+Python has no `->` operator; chaining uses `>>` (and `chain(a, b, c)`).
+Each transformer is `Iterator[A] -> Iterator[B]`, exactly the reference's
+contract, so transforms stay streaming and O(1) in memory.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import MiniBatch, Sample
+
+
+class Transformer:
+    """Iterator→iterator transform (reference: dataset/Transformer.scala)."""
+
+    def apply(self, it: Iterator) -> Iterator:
+        raise NotImplementedError
+
+    def __call__(self, it: Iterable) -> Iterator:
+        return self.apply(iter(it))
+
+    def __rshift__(self, other: "Transformer") -> "ChainedTransformer":
+        """`a >> b` — the reference's `a -> b`."""
+        return ChainedTransformer(self, other)
+
+
+class ChainedTransformer(Transformer):
+    def __init__(self, *stages: Transformer):
+        flat: List[Transformer] = []
+        for s in stages:
+            if isinstance(s, ChainedTransformer):
+                flat.extend(s.stages)
+            else:
+                flat.append(s)
+        self.stages = flat
+
+    def apply(self, it: Iterator) -> Iterator:
+        for s in self.stages:
+            it = s.apply(it)
+        return it
+
+
+def chain(*stages: Transformer) -> ChainedTransformer:
+    return ChainedTransformer(*stages)
+
+
+class MapTransformer(Transformer):
+    """Lift a per-element function (helper; reference builds these ad hoc)."""
+
+    def __init__(self, fn: Callable[[Any], Any]):
+        self.fn = fn
+
+    def apply(self, it):
+        return map(self.fn, it)
+
+
+class SampleToMiniBatch(Transformer):
+    """Group Samples into MiniBatches
+    (reference: dataset/SampleToMiniBatch.scala).
+
+    partial="pad" keeps the trailing partial batch, padded to full size
+    with `real_size` recorded (static shapes under jit);
+    partial="drop" mirrors dropping it.
+    """
+
+    def __init__(self, batch_size: int, partial: str = "pad"):
+        assert partial in ("pad", "drop")
+        self.batch_size = batch_size
+        self.partial = partial
+
+    def apply(self, it):
+        while True:
+            group = list(itertools.islice(it, self.batch_size))
+            if not group:
+                return
+            if len(group) < self.batch_size and self.partial == "drop":
+                return
+            yield MiniBatch.from_samples(group, pad_to=self.batch_size)

@@ -40,6 +40,10 @@ from bigdl_tpu.nn.table_ops import (
     CosineDistance, Sum, Mean, Max, Min,
 )
 from bigdl_tpu.nn.embedding import LookupTable
+from bigdl_tpu.nn.recurrent import (
+    Cell, RnnCell, LSTM, LSTMPeephole, GRU, Recurrent, BiRecurrent,
+    TimeDistributed,
+)
 from bigdl_tpu.nn.criterion import (
     ClassNLLCriterion, CrossEntropyCriterion, MSECriterion, AbsCriterion,
     BCECriterion, SmoothL1Criterion, MarginCriterion, MultiLabelMarginCriterion,

@@ -30,7 +30,7 @@ class Container(Module):
             self.add(m)
 
     def add(self, module: Module) -> "Container":
-        key = f"{len(self.modules)}_{module.name}"
+        key = f"{len(self.modules)}_{module.key_name()}"
         self.modules.append(module)
         self._keys.append(key)
         return self

@@ -210,13 +210,8 @@ class ClassSimplexCriterion(Criterion):
 
     @staticmethod
     def _build_simplex(n):
+        # closed form: vertices of a regular simplex in R^n, row-normalized
         import numpy as np
-        mat = np.zeros((n, n), dtype=np.float32)
-        mat[0, 0] = 1.0
-        for k in range(1, n - 1):
-            s = float(np.dot(mat[k - 1, :k], mat[k, :k])) if k > 0 else 0.0
-            # regular simplex construction (Gram-Schmidt style)
-        # closed form: vertices of regular simplex in R^n
         a = (1.0 - np.sqrt(1.0 + n)) / n
         mat = np.eye(n, dtype=np.float32) + a / np.sqrt(n) * np.ones((n, n), np.float32)
         mat = mat / np.linalg.norm(mat, axis=1, keepdims=True)
@@ -288,8 +283,12 @@ class TimeDistributedCriterion(Criterion):
         flat_in = input.reshape((n * t,) + input.shape[2:])
         flat_tgt = target.reshape((n * t,) + target.shape[2:])
         loss = self.criterion.forward(flat_in, flat_tgt)
-        # inner criterion with size_average=True already averages over N*T;
-        # reference semantics: size_average=False → divide by N only
-        if not self.size_average and getattr(self.criterion, "size_average", True):
+        # Reference semantics: loss = sum_t inner(input_t, target_t), then
+        # / T when size_average. An inner mean over N*T equals that sum/T
+        # when the inner criterion itself size-averages; correct each combo:
+        inner_avg = getattr(self.criterion, "size_average", True)
+        if inner_avg and not self.size_average:
             loss = loss * t
+        elif not inner_avg and self.size_average:
+            loss = loss / t
         return loss

@@ -61,7 +61,7 @@ class Graph(Module):
         self._keys: Dict[int, str] = {}
         for i, node in enumerate(self._order):
             if node.module is not None:
-                self._keys[id(node)] = f"{i}_{node.module.name}"
+                self._keys[id(node)] = f"{i}_{node.module.key_name()}"
 
     def _topo_sort(self) -> List[Node]:
         order, seen, stack = [], set(), []

@@ -53,6 +53,7 @@ class Module:
     """
 
     def __init__(self, name: Optional[str] = None):
+        self._explicit_name = name is not None
         self.name = name or f"{type(self).__name__}_{next(_id_counter)}"
         # Eager facade storage (not used by the jitted training path).
         self._variables: Optional[Dict[str, Any]] = None
@@ -166,7 +167,15 @@ class Module:
 
     def set_name(self, name: str) -> "Module":
         self.name = name
+        self._explicit_name = True
         return self
+
+    def key_name(self) -> str:
+        """Deterministic name for variable-pytree keys: the user-set name if
+        any, else the bare class name. Auto-generated `name`s carry a
+        process-global counter and MUST NOT enter checkpoints — two builds
+        of the same architecture have to produce identical pytree keys."""
+        return self.name if self._explicit_name else type(self).__name__
 
     def __repr__(self):
         return f"{type(self).__name__}(name={self.name!r})"

@@ -22,7 +22,9 @@ from bigdl_tpu.utils.table import Table, T
 
 def _elems(input):
     if isinstance(input, dict):
-        return [input[k] for k in sorted(input.keys(), key=repr)]
+        from bigdl_tpu.utils.table import sort_key
+
+        return [input[k] for k in sorted(input.keys(), key=sort_key)]
     return list(input)
 
 

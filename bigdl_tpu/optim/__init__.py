@@ -1,0 +1,17 @@
+"""bigdl_tpu.optim — training orchestration (reference: bigdl/optim/)."""
+
+from bigdl_tpu.optim.optim_method import (
+    OptimMethod, SGD, Adam, Adagrad, Adamax, RMSprop, AdaDelta, Ftrl,
+)
+from bigdl_tpu.optim.lr_schedule import (
+    LearningRateSchedule, Default, Step, MultiStep, EpochStep, EpochDecay,
+    Poly, Exponential, NaturalExp, Warmup, Plateau, SequentialSchedule,
+)
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.validation import (
+    ValidationMethod, ValidationResult, Top1Accuracy, Top5Accuracy, Loss,
+    TreeNNAccuracy, HitRatio, NDCG,
+)
+from bigdl_tpu.optim.metrics import Metrics, Timer
+from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer
+from bigdl_tpu.optim.evaluator import Evaluator, Predictor, LocalPredictor

@@ -1,0 +1,83 @@
+"""Distributed-style evaluation and batch prediction.
+
+Reference parity: optim/Evaluator.scala (broadcast model, mapPartitions
+forward, reduce ValidationResults), optim/Predictor.scala /
+LocalPredictor.scala. Here "broadcast" is free (SPMD replication) and the
+reduce is the same associative `+` on ValidationResult.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.optim.optimizer import _batch_iterator, _to_device
+from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+
+
+class Evaluator:
+    """(reference: optim/Evaluator.scala#Evaluator.test)"""
+
+    def __init__(self, model: Module):
+        self.model = model
+
+    def test(self, dataset: AbstractDataSet,
+             methods: Sequence[ValidationMethod],
+             batch_size: int = 32) -> Dict[str, ValidationResult]:
+        model = self.model
+        variables = model.variables
+
+        @jax.jit
+        def fwd(params, state, bx):
+            out, _ = model.apply({"params": params, "state": state}, bx,
+                                 training=False)
+            return out
+
+        results = [ValidationResult(0.0, 0.0, m.name) for m in methods]
+        for mb in _batch_iterator(dataset, False, batch_size):
+            real = getattr(mb, "real_size", mb.size)
+            out = fwd(variables["params"], variables["state"], _to_device(mb.input))
+            tgt = _to_device(mb.target)
+            for i, m in enumerate(methods):
+                s, c = m.stats(out, tgt, real)
+                results[i] = results[i] + ValidationResult(float(s), float(c))
+        return {m.name: r for m, r in zip(methods, results)}
+
+
+class Predictor:
+    """Batch inference (reference: optim/Predictor.scala). `predict` yields
+    per-sample outputs; `predict_class` yields argmax ids."""
+
+    def __init__(self, model: Module, batch_size: int = 32):
+        self.model = model
+        self.batch_size = batch_size
+
+    def predict(self, dataset: AbstractDataSet) -> np.ndarray:
+        model = self.model
+        variables = model.variables
+
+        @jax.jit
+        def fwd(params, state, bx):
+            out, _ = model.apply({"params": params, "state": state}, bx,
+                                 training=False)
+            return out
+
+        outs: List[np.ndarray] = []
+        for mb in _batch_iterator(dataset, False, self.batch_size):
+            real = getattr(mb, "real_size", mb.size)
+            out = np.asarray(fwd(variables["params"], variables["state"],
+                                 _to_device(mb.input)))
+            outs.append(out[:real])
+        return np.concatenate(outs, axis=0)
+
+    def predict_class(self, dataset: AbstractDataSet) -> np.ndarray:
+        return np.argmax(self.predict(dataset), axis=-1)
+
+
+LocalPredictor = Predictor

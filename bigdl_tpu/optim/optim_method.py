@@ -1,0 +1,268 @@
+"""Optimization methods.
+
+Reference parity: optim/SGD.scala, optim/Adam.scala, optim/Adagrad.scala,
+optim/Adamax.scala, optim/RMSprop.scala, optim/Ftrl.scala,
+optim/AdaDelta.scala, optim/LBFGS.scala (LBFGS lives in lbfgs.py).
+
+TPU-first design: each method is a pure pytree transform
+
+    slots = method.init_slots(params)
+    new_params, new_slots = method.update(grads, params, slots, lr, step)
+
+fully jit-traceable; `lr` and `step` arrive as traced scalars from the
+host-side schedule (see lr_schedule.py). Because update is leaf-wise over
+an arbitrary pytree, the SAME code updates a full replica or a ZeRO-1
+shard of the flat parameter vector (bigdl_tpu/parallel/data_parallel.py)
+— mirroring how the reference runs its optim method per parameter slice
+(optim/DistriOptimizer.scala aggregate step).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.optim.lr_schedule import Default, LearningRateSchedule
+
+
+def _tree_map(fn, *trees):
+    return jax.tree_util.tree_map(fn, *trees)
+
+
+class OptimMethod:
+    """Base optimizer (reference: optim/OptimMethod.scala)."""
+
+    def __init__(self, learningrate: float = 1e-3,
+                 learningrate_schedule: Optional[LearningRateSchedule] = None,
+                 weightdecay: float = 0.0):
+        self.learningrate = learningrate
+        self.schedule = learningrate_schedule or Default()
+        self.schedule.base_lr = learningrate
+        self.weightdecay = weightdecay
+
+    # -------- host side
+    def current_rate(self, state: Dict) -> float:
+        """Host-side schedule evaluation (reference: updateHyperParameter)."""
+        self.schedule.base_lr = self.learningrate
+        return float(self.schedule.rate(state))
+
+    # -------- device side (pure)
+    def init_slots(self, params) -> Any:
+        return {}
+
+    def update(self, grads, params, slots, lr, step):
+        raise NotImplementedError
+
+    def _decay(self, grads, params):
+        if self.weightdecay:
+            wd = self.weightdecay
+            return _tree_map(lambda g, p: g + wd * p, grads, params)
+        return grads
+
+
+class SGD(OptimMethod):
+    """SGD with momentum/dampening/nesterov (reference: optim/SGD.scala)."""
+
+    def __init__(self, learningrate: float = 1e-3,
+                 learningrate_decay: float = 0.0,
+                 weightdecay: float = 0.0,
+                 momentum: float = 0.0,
+                 dampening: Optional[float] = None,
+                 nesterov: bool = False,
+                 learningrate_schedule: Optional[LearningRateSchedule] = None):
+        sched = learningrate_schedule or Default(learningrate_decay)
+        super().__init__(learningrate, sched, weightdecay)
+        self.momentum = momentum
+        self.dampening = momentum if dampening is None else dampening
+        self.nesterov = nesterov
+        if nesterov and (momentum <= 0 or self.dampening != 0):
+            raise ValueError("nesterov requires momentum > 0 and dampening = 0")
+
+    def init_slots(self, params):
+        if self.momentum:
+            return {"velocity": _tree_map(jnp.zeros_like, params)}
+        return {}
+
+    def update(self, grads, params, slots, lr, step):
+        grads = self._decay(grads, params)
+        if self.momentum:
+            mu, damp = self.momentum, self.dampening
+            vel = _tree_map(lambda v, g: mu * v + (1 - damp) * g,
+                            slots["velocity"], grads)
+            if self.nesterov:
+                eff = _tree_map(lambda g, v: g + mu * v, grads, vel)
+            else:
+                eff = vel
+            new_params = _tree_map(lambda p, d: p - lr * d, params, eff)
+            return new_params, {"velocity": vel}
+        new_params = _tree_map(lambda p, g: p - lr * g, params, grads)
+        return new_params, slots
+
+
+class Adam(OptimMethod):
+    """Adam (reference: optim/Adam.scala)."""
+
+    def __init__(self, learningrate: float = 1e-3,
+                 learningrate_decay: float = 0.0,
+                 beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8,
+                 weightdecay: float = 0.0,
+                 learningrate_schedule: Optional[LearningRateSchedule] = None):
+        super().__init__(learningrate,
+                         learningrate_schedule or Default(learningrate_decay),
+                         weightdecay)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_slots(self, params):
+        return {"m": _tree_map(jnp.zeros_like, params),
+                "v": _tree_map(jnp.zeros_like, params)}
+
+    def update(self, grads, params, slots, lr, step):
+        grads = self._decay(grads, params)
+        t = step + 1
+        b1, b2 = self.beta1, self.beta2
+        m = _tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, slots["m"], grads)
+        v = _tree_map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, slots["v"], grads)
+        bc1 = 1 - b1 ** t
+        bc2 = 1 - b2 ** t
+        new_params = _tree_map(
+            lambda p, m_, v_: p - lr * (m_ / bc1) / (jnp.sqrt(v_ / bc2) + self.epsilon),
+            params, m, v)
+        return new_params, {"m": m, "v": v}
+
+
+class Adagrad(OptimMethod):
+    """Adagrad (reference: optim/Adagrad.scala)."""
+
+    def __init__(self, learningrate: float = 1e-3,
+                 learningrate_decay: float = 0.0,
+                 weightdecay: float = 0.0):
+        super().__init__(learningrate, Default(learningrate_decay), weightdecay)
+
+    def init_slots(self, params):
+        return {"accum": _tree_map(jnp.zeros_like, params)}
+
+    def update(self, grads, params, slots, lr, step):
+        grads = self._decay(grads, params)
+        accum = _tree_map(lambda a, g: a + g * g, slots["accum"], grads)
+        new_params = _tree_map(
+            lambda p, g, a: p - lr * g / (jnp.sqrt(a) + 1e-10), params, grads, accum)
+        return new_params, {"accum": accum}
+
+
+class Adamax(OptimMethod):
+    """Adamax (reference: optim/Adamax.scala)."""
+
+    def __init__(self, learningrate: float = 2e-3,
+                 beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-38):
+        super().__init__(learningrate)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_slots(self, params):
+        return {"m": _tree_map(jnp.zeros_like, params),
+                "u": _tree_map(jnp.zeros_like, params)}
+
+    def update(self, grads, params, slots, lr, step):
+        t = step + 1
+        b1 = self.beta1
+        m = _tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, slots["m"], grads)
+        u = _tree_map(lambda u_, g: jnp.maximum(self.beta2 * u_, jnp.abs(g) + self.epsilon),
+                      slots["u"], grads)
+        bc = 1 - b1 ** t
+        new_params = _tree_map(lambda p, m_, u_: p - (lr / bc) * m_ / u_, params, m, u)
+        return new_params, {"m": m, "u": u}
+
+
+class RMSprop(OptimMethod):
+    """RMSprop (reference: optim/RMSprop.scala)."""
+
+    def __init__(self, learningrate: float = 1e-2,
+                 learningrate_decay: float = 0.0,
+                 decayrate: float = 0.99, epsilon: float = 1e-8):
+        super().__init__(learningrate, Default(learningrate_decay))
+        self.decayrate = decayrate
+        self.epsilon = epsilon
+
+    def init_slots(self, params):
+        return {"ms": _tree_map(jnp.zeros_like, params)}
+
+    def update(self, grads, params, slots, lr, step):
+        dr = self.decayrate
+        ms = _tree_map(lambda s, g: dr * s + (1 - dr) * g * g, slots["ms"], grads)
+        new_params = _tree_map(
+            lambda p, g, s: p - lr * g / (jnp.sqrt(s) + self.epsilon), params, grads, ms)
+        return new_params, {"ms": ms}
+
+
+class AdaDelta(OptimMethod):
+    """AdaDelta (reference: optim/Adadelta.scala)."""
+
+    def __init__(self, decayrate: float = 0.9, epsilon: float = 1e-6):
+        super().__init__(learningrate=1.0)
+        self.rho = decayrate
+        self.epsilon = epsilon
+
+    def init_slots(self, params):
+        return {"accum": _tree_map(jnp.zeros_like, params),
+                "accum_update": _tree_map(jnp.zeros_like, params)}
+
+    def update(self, grads, params, slots, lr, step):
+        rho, eps = self.rho, self.epsilon
+        accum = _tree_map(lambda a, g: rho * a + (1 - rho) * g * g,
+                          slots["accum"], grads)
+        delta = _tree_map(
+            lambda au, a, g: jnp.sqrt(au + eps) / jnp.sqrt(a + eps) * g,
+            slots["accum_update"], accum, grads)
+        accum_update = _tree_map(lambda au, d: rho * au + (1 - rho) * d * d,
+                                 slots["accum_update"], delta)
+        new_params = _tree_map(lambda p, d: p - lr * d, params, delta)
+        return new_params, {"accum": accum, "accum_update": accum_update}
+
+
+class Ftrl(OptimMethod):
+    """FTRL-proximal (reference: optim/Ftrl.scala)."""
+
+    def __init__(self, learningrate: float = 1e-3,
+                 learningrate_power: float = -0.5,
+                 initial_accumulator_value: float = 0.1,
+                 l1_regularization_strength: float = 0.0,
+                 l2_regularization_strength: float = 0.0):
+        super().__init__(learningrate)
+        self.lr_power = learningrate_power
+        self.init_accum = initial_accumulator_value
+        self.l1 = l1_regularization_strength
+        self.l2 = l2_regularization_strength
+
+    def init_slots(self, params):
+        return {
+            "accum": _tree_map(
+                lambda p: jnp.full_like(p, self.init_accum), params),
+            "linear": _tree_map(jnp.zeros_like, params),
+        }
+
+    def update(self, grads, params, slots, lr, step):
+        lp = self.lr_power
+
+        def upd(p, g, a, l):
+            new_a = a + g * g
+            sigma = (new_a ** -lp - a ** -lp) / lr
+            new_l = l + g - sigma * p
+            quad = new_a ** -lp / lr + 2 * self.l2
+            pre = jnp.clip(new_l, -self.l1, self.l1) - new_l
+            new_p = pre / quad
+            return new_p, new_a, new_l
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = jax.tree_util.tree_leaves(grads)
+        flat_a = jax.tree_util.tree_leaves(slots["accum"])
+        flat_l = jax.tree_util.tree_leaves(slots["linear"])
+        out_p, out_a, out_l = [], [], []
+        for p, g, a, l in zip(flat_p, flat_g, flat_a, flat_l):
+            np_, na, nl = upd(p, g, a, l)
+            out_p.append(np_)
+            out_a.append(na)
+            out_l.append(nl)
+        unf = jax.tree_util.tree_unflatten
+        return unf(treedef, out_p), {"accum": unf(treedef, out_a),
+                                     "linear": unf(treedef, out_l)}

@@ -1,0 +1,304 @@
+"""Optimizer front-end and single-host training loop.
+
+Reference parity: optim/Optimizer.scala (builder surface: `setOptimMethod`,
+`setEndWhen`, `setValidation`, `setCheckpoint`, `setTrainSummary`,
+`optimize`, dispatch Local vs Distri) and optim/LocalOptimizer.scala.
+
+TPU-first redesign: the reference's LocalOptimizer clones the model across
+cores and hand-splits each MiniBatch; here intra-chip parallelism belongs
+to XLA — ONE jitted train step owns the whole batch. The step is pure:
+
+    (params, mod_state, slots, batch, lr, step#, rng)
+        -> (params', mod_state', slots', loss)
+
+Distributed training subclasses this loop and swaps the step function for
+the mesh-sharded one (bigdl_tpu/parallel/distri_optimizer.py), exactly
+the Local/Distri split the reference has.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.dataset.sample import MiniBatch, Sample
+from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+from bigdl_tpu.nn.module import Criterion, Module
+from bigdl_tpu.optim.metrics import Metrics, Timer
+from bigdl_tpu.optim.optim_method import OptimMethod, SGD
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+from bigdl_tpu.serialization.checkpoint import Checkpoint
+
+logger = logging.getLogger("bigdl_tpu.optim")
+
+
+def _batch_iterator(dataset: AbstractDataSet, train: bool, batch_size: Optional[int]):
+    """Yield MiniBatch from a dataset that may produce Samples or MiniBatches."""
+    it = dataset.data(train=train)
+    first = next(it, None)
+    if first is None:
+        return iter(())
+    import itertools
+
+    chained = itertools.chain([first], it)
+    if isinstance(first, MiniBatch):
+        return chained
+    if batch_size is None:
+        raise ValueError("dataset yields Samples; batch_size is required")
+    return SampleToMiniBatch(batch_size)(chained)
+
+
+def _to_device(x):
+    if x is None:
+        return None
+    if isinstance(x, tuple):
+        return tuple(jnp.asarray(e) for e in x)
+    return jnp.asarray(x)
+
+
+class Optimizer:
+    """Builder facade (reference: optim/Optimizer.scala#Optimizer.apply)."""
+
+    def __init__(self, model: Module, dataset: AbstractDataSet,
+                 criterion: Criterion, batch_size: Optional[int] = None,
+                 seed: int = 42):
+        self.model = model
+        self.dataset = dataset
+        self.criterion = criterion
+        self.batch_size = batch_size
+        self.seed = seed
+        self.optim_method: OptimMethod = SGD(learningrate=1e-2)
+        self.end_when: Trigger = Trigger.max_epoch(1)
+        self.validation_trigger: Optional[Trigger] = None
+        self.validation_dataset: Optional[AbstractDataSet] = None
+        self.validation_methods: List[ValidationMethod] = []
+        self.validation_batch_size: Optional[int] = None
+        self.checkpoint: Optional[Checkpoint] = None
+        self.checkpoint_trigger: Optional[Trigger] = None
+        self.train_summary = None
+        self.validation_summary = None
+        self.grad_clip_const: Optional[float] = None
+        self.grad_clip_norm: Optional[float] = None
+        self.log_every = 1
+        self._resume = False
+
+    # ------------------------------------------------------- builder surface
+    def set_optim_method(self, method: OptimMethod) -> "Optimizer":
+        self.optim_method = method
+        return self
+
+    def set_end_when(self, trigger: Trigger) -> "Optimizer":
+        self.end_when = trigger
+        return self
+
+    def set_validation(self, trigger: Trigger, dataset: AbstractDataSet,
+                       methods: Sequence[ValidationMethod],
+                       batch_size: Optional[int] = None) -> "Optimizer":
+        self.validation_trigger = trigger
+        self.validation_dataset = dataset
+        self.validation_methods = list(methods)
+        self.validation_batch_size = batch_size or self.batch_size
+        return self
+
+    def set_checkpoint(self, path: str, trigger: Trigger) -> "Optimizer":
+        self.checkpoint = Checkpoint(path)
+        self.checkpoint_trigger = trigger
+        return self
+
+    def resume_from_checkpoint(self) -> "Optimizer":
+        """Continue from the latest checkpoint under the checkpoint path
+        (reference: Optimizer resume + DistriOptimizer retry recovery)."""
+        self._resume = True
+        return self
+
+    def set_train_summary(self, summary) -> "Optimizer":
+        self.train_summary = summary
+        return self
+
+    def set_validation_summary(self, summary) -> "Optimizer":
+        self.validation_summary = summary
+        return self
+
+    def set_constant_gradient_clipping(self, min_v: float, max_v: float) -> "Optimizer":
+        self.grad_clip_const = (min_v, max_v)
+        return self
+
+    def set_gradient_clipping_by_l2_norm(self, clip_norm: float) -> "Optimizer":
+        self.grad_clip_norm = clip_norm
+        return self
+
+    # ------------------------------------------------------------- dispatch
+    def optimize(self) -> Module:
+        return LocalOptimizer(self).run()
+
+
+class LocalOptimizer:
+    """Single-host jitted training loop (reference: optim/LocalOptimizer.scala).
+
+    Also the base for DistriOptimizer: subclasses override `_make_step`
+    and `_make_eval` to insert mesh sharding/collectives.
+    """
+
+    def __init__(self, opt: Optimizer):
+        self.o = opt
+        self.metrics = Metrics()
+
+    # --------------------------------------------------------- step builders
+    def _make_step(self) -> Callable:
+        model, criterion, method = self.o.model, self.o.criterion, self.o.optim_method
+        clip_const, clip_norm = self.o.grad_clip_const, self.o.grad_clip_norm
+
+        def step(params, mod_state, slots, bx, by, lr, stepno, rng):
+            def loss_fn(p):
+                out, new_state = model.apply(
+                    {"params": p, "state": mod_state}, bx,
+                    training=True, rng=rng)
+                return criterion(out, by), new_state
+
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            if clip_const is not None:
+                lo, hi = clip_const
+                grads = jax.tree_util.tree_map(
+                    lambda g: jnp.clip(g, lo, hi), grads)
+            if clip_norm is not None:
+                gnorm = jnp.sqrt(sum(jnp.sum(g * g)
+                                     for g in jax.tree_util.tree_leaves(grads)))
+                scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gnorm, 1e-12))
+                grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+            new_params, new_slots = method.update(grads, params, slots, lr, stepno)
+            return new_params, new_state, new_slots, loss
+
+        return jax.jit(step, donate_argnums=(0, 2))
+
+    def _make_eval(self) -> Callable:
+        model, methods = self.o.model, self.o.validation_methods
+
+        def eval_step(params, mod_state, bx, by, real_size):
+            out, _ = model.apply({"params": params, "state": mod_state}, bx,
+                                 training=False)
+            return [m.stats(out, by, real_size) for m in methods]
+
+        return jax.jit(eval_step, static_argnums=(4,))
+
+    # ------------------------------------------------------------ validation
+    def _validate(self, variables) -> Dict[str, ValidationResult]:
+        o = self.o
+        eval_step = self._eval_step
+        results = [ValidationResult(0.0, 0.0, m.name) for m in o.validation_methods]
+        for mb in _batch_iterator(o.validation_dataset, False,
+                                  o.validation_batch_size):
+            real = getattr(mb, "real_size", mb.size)
+            stats = eval_step(variables["params"], variables["state"],
+                              _to_device(mb.input), _to_device(mb.target), real)
+            for i, (s, c) in enumerate(stats):
+                results[i] = results[i] + ValidationResult(float(s), float(c))
+        return {m.name: r for m, r in zip(o.validation_methods, results)}
+
+    # ------------------------------------------------------------------ run
+    def run(self) -> Module:
+        o = self.o
+        rng = jax.random.PRNGKey(o.seed)
+        variables = dict(o.model.variables)  # uses existing build or default init
+        slots = o.optim_method.init_slots(variables["params"])
+        train_state: Dict[str, Any] = {"epoch": 1, "neval": 0,
+                                       "records": 0, "loss": None, "score": None}
+
+        if o._resume and o.checkpoint is not None and o.checkpoint.latest():
+            variables, slots, saved = o.checkpoint.load()
+            train_state.update(saved)
+            logger.info("resumed from %s at %s", o.checkpoint.latest(), saved)
+
+        self._step = self._make_step()
+        if o.validation_methods:
+            self._eval_step = self._make_eval()
+
+        dataset_size = o.dataset.size()
+        batches = _batch_iterator(o.dataset, True, o.batch_size)
+        epoch_start = time.perf_counter()
+        iter_start = time.perf_counter()
+
+        while not o.end_when(train_state):
+            with Timer(self.metrics, "data_fetch_s"):
+                mb = next(batches)
+            step_rng = jax.random.fold_in(rng, train_state["neval"])
+            lr = o.optim_method.current_rate(train_state)
+            with Timer(self.metrics, "dispatch_s"):
+                variables["params"], variables["state"], slots, loss = self._step(
+                    variables["params"], variables["state"], slots,
+                    _to_device(mb.input), _to_device(mb.target),
+                    jnp.asarray(lr, jnp.float32),
+                    jnp.asarray(train_state["neval"], jnp.int32),
+                    step_rng)
+            # NOTE: `loss` stays a device array — converting here would
+            # block the host on every step and kill async dispatch
+            # pipelining; it is materialized only on log/summary paths.
+            real = getattr(mb, "real_size", mb.size)
+            train_state["neval"] += 1
+            train_state["records"] += real
+            train_state["loss"] = loss
+            now = time.perf_counter()
+            iter_wall = now - iter_start
+            iter_start = now
+            self.metrics.add("iter_s", iter_wall)
+            throughput = real / max(iter_wall, 1e-9)
+
+            if o.train_summary is not None:
+                s = o.train_summary
+                s.add_scalar("Loss", float(loss), train_state["neval"])
+                s.add_scalar("Throughput", throughput, train_state["neval"])
+                s.add_scalar("LearningRate", lr, train_state["neval"])
+                pt = s.get_summary_trigger("Parameters")
+                if pt is not None and pt(train_state):
+                    for name, leaf in o.model.parameters(variables):
+                        s.add_histogram(name, np.asarray(leaf), train_state["neval"])
+
+            if train_state["neval"] % self.o.log_every == 0:
+                logger.info(
+                    "epoch %d iter %d loss %.6f lr %.5g %.1f rec/s [%s]",
+                    train_state["epoch"], train_state["neval"], float(loss), lr,
+                    throughput, self.metrics.summary())
+
+            # ---- epoch rollover (the reference counts records vs dataset size)
+            if train_state["records"] >= dataset_size:
+                train_state["epoch"] += 1
+                train_state["records"] = 0
+                logger.info("epoch %d done in %.1fs",
+                            train_state["epoch"] - 1,
+                            time.perf_counter() - epoch_start)
+                epoch_start = time.perf_counter()
+
+            # ---- validation
+            if (o.validation_trigger is not None
+                    and o.validation_trigger(train_state)):
+                res = self._validate(variables)
+                for name, r in res.items():
+                    v, n = r.result()
+                    logger.info("validation %s = %.6f (%d)", name, v, n)
+                    if o.validation_summary is not None:
+                        o.validation_summary.add_scalar(name, v, train_state["neval"])
+                first = next(iter(res.values()), None)
+                if first is not None:
+                    train_state["score"] = first.result()[0]
+                    sched = o.optim_method.schedule
+                    if hasattr(sched, "on_metric"):
+                        sched.on_metric(train_state["score"])
+
+            # ---- checkpoint
+            if (o.checkpoint is not None and o.checkpoint_trigger is not None
+                    and o.checkpoint_trigger(train_state)):
+                path = o.checkpoint.save(train_state["neval"], variables, slots,
+                                         {k: train_state[k] for k in
+                                          ("epoch", "neval", "records")})
+                logger.info("checkpoint -> %s", path)
+
+        o.model.variables = variables
+        return o.model

@@ -1,0 +1,152 @@
+"""Validation methods and results.
+
+Reference parity: optim/ValidationMethod.scala — `Top1Accuracy`,
+`Top5Accuracy`, `Loss`, `TreeNNAccuracy`, `HitRatio`, `NDCG`;
+optim/ValidationResult.scala — `AccuracyResult`, `LossResult` with `+`
+merge for distributed reduction.
+
+Each method has a jit-friendly core: `stats(output, target) -> (sum, count)`
+as device scalars; results merge associatively so partial results from
+shards/hosts reduce exactly like the reference's RDD `reduce(_ + _)`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ValidationResult:
+    """Additive (value-sum, count) pair (reference: optim/ValidationResult.scala)."""
+
+    def __init__(self, total: float, count: float, fmt: str = "Accuracy"):
+        self.total = float(total)
+        self.count = float(count)
+        self.fmt = fmt
+
+    def result(self) -> Tuple[float, int]:
+        return (self.total / max(self.count, 1.0), int(self.count))
+
+    def __add__(self, other: "ValidationResult") -> "ValidationResult":
+        return ValidationResult(self.total + other.total,
+                                self.count + other.count, self.fmt)
+
+    def __repr__(self):
+        v, n = self.result()
+        return f"{self.fmt}: {v:.6f} (count {n})"
+
+
+class ValidationMethod:
+    name = "ValidationMethod"
+
+    def stats(self, output, target, real_size: Optional[int] = None):
+        """Return (metric_sum, count) as scalars. `real_size` masks padded
+        tail rows in the final partial batch."""
+        raise NotImplementedError
+
+    def apply(self, output, target, real_size: Optional[int] = None) -> ValidationResult:
+        s, c = self.stats(output, target, real_size)
+        return ValidationResult(float(s), float(c), self.name)
+
+    def __repr__(self):
+        return self.name
+
+
+def _row_mask(n_rows: int, real_size: Optional[int]):
+    if real_size is None:
+        return jnp.ones((n_rows,), jnp.float32)
+    return (jnp.arange(n_rows) < real_size).astype(jnp.float32)
+
+
+class Top1Accuracy(ValidationMethod):
+    name = "Top1Accuracy"
+
+    def stats(self, output, target, real_size=None):
+        pred = jnp.argmax(output, axis=-1)
+        correct = (pred == target.astype(pred.dtype)).astype(jnp.float32)
+        mask = _row_mask(correct.shape[0], real_size)
+        return jnp.sum(correct * mask), jnp.sum(mask)
+
+
+class Top5Accuracy(ValidationMethod):
+    name = "Top5Accuracy"
+
+    def stats(self, output, target, real_size=None):
+        top5 = jnp.argsort(output, axis=-1)[..., -5:]
+        hit = jnp.any(top5 == target[..., None].astype(top5.dtype), axis=-1)
+        hit = hit.astype(jnp.float32)
+        mask = _row_mask(hit.shape[0], real_size)
+        return jnp.sum(hit * mask), jnp.sum(mask)
+
+
+class Loss(ValidationMethod):
+    """Criterion value as a validation metric (reference: ValidationMethod.Loss)."""
+
+    name = "Loss"
+
+    def __init__(self, criterion):
+        self.criterion = criterion
+
+    def stats(self, output, target, real_size=None):
+        # padded rows share the batch mean; mask exactly by recomputing sums
+        n = output.shape[0]
+        if real_size is not None and real_size != n:
+            output = output[:real_size]
+            target = target[:real_size]
+            n = real_size
+        return self.criterion(output, target) * n, jnp.asarray(float(n))
+
+
+class TreeNNAccuracy(ValidationMethod):
+    """Accuracy on the root prediction of tree outputs
+    (reference: optim/ValidationMethod.scala#TreeNNAccuracy).
+    Output (N, T, C): scores per node, root is node 0."""
+
+    name = "TreeNNAccuracy"
+
+    def stats(self, output, target, real_size=None):
+        root_out = output[:, 0, :] if output.ndim == 3 else output
+        root_tgt = target[:, 0] if target.ndim == 2 else target
+        pred = jnp.argmax(root_out, axis=-1)
+        correct = (pred == root_tgt.astype(pred.dtype)).astype(jnp.float32)
+        mask = _row_mask(correct.shape[0], real_size)
+        return jnp.sum(correct * mask), jnp.sum(mask)
+
+
+class HitRatio(ValidationMethod):
+    """HR@k for recommendation (reference: optim/ValidationMethod.scala#HitRatio).
+    output: (N, C) scores; target: (N,) index of the positive item."""
+
+    name = "HitRatio"
+
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        self.k = k
+        self.name = f"HitRatio@{k}"
+
+    def stats(self, output, target, real_size=None):
+        topk = jnp.argsort(output, axis=-1)[..., -self.k:]
+        hit = jnp.any(topk == target[..., None].astype(topk.dtype), axis=-1)
+        hit = hit.astype(jnp.float32)
+        mask = _row_mask(hit.shape[0], real_size)
+        return jnp.sum(hit * mask), jnp.sum(mask)
+
+
+class NDCG(ValidationMethod):
+    """NDCG@k with a single positive item (reference: ValidationMethod.scala#NDCG)."""
+
+    name = "NDCG"
+
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        self.k = k
+        self.name = f"NDCG@{k}"
+
+    def stats(self, output, target, real_size=None):
+        order = jnp.argsort(output, axis=-1)[..., ::-1][..., :self.k]
+        pos = order == target[..., None].astype(order.dtype)
+        ranks = jnp.argmax(pos, axis=-1)  # rank of hit if any
+        has_hit = jnp.any(pos, axis=-1)
+        gain = jnp.where(has_hit, 1.0 / jnp.log2(ranks.astype(jnp.float32) + 2.0), 0.0)
+        mask = _row_mask(gain.shape[0], real_size)
+        return jnp.sum(gain * mask), jnp.sum(mask)

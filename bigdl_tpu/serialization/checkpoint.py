@@ -1,0 +1,156 @@
+"""Checkpoint save/load for parameter/optimizer pytrees.
+
+Reference parity: the reference checkpoints model (protobuf
+`Module.saveModule`, utils/serializer/ModuleSerializer.scala) and optim
+state (`OptimMethod.save` with epoch/neval/momentum buffers) at trigger
+time, and `Optimizer` resumes from the latest pair (SURVEY.md §5.4).
+
+Format (self-contained, no orbax/tensorstore dependency):
+    <dir>/<name>.npz        — leaves keyed by escaped pytree path
+    <dir>/<name>.json       — manifest: tree structure + metadata
+A pytree is reconstructed exactly (dicts/lists/tuples/Tables, scalar
+leaves re-materialized as jnp arrays).
+
+Multi-host: each host saves only under `host{process_index}` when the
+tree is process-local; for fully-replicated trees host 0 writes
+(`save_pytree(..., only_host0=True)`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_SEP = "/"
+
+
+def _flatten(tree, prefix=""):
+    """Flatten to {path: leaf}; records structure for exact rebuild."""
+    leaves: Dict[str, Any] = {}
+
+    def rec(node, path):
+        if isinstance(node, dict):
+            from bigdl_tpu.utils.table import sort_key
+
+            struct = {"__kind__": "dict",
+                      "keys": sorted(node.keys(), key=sort_key),
+                      "table": type(node).__name__ == "Table"}
+            struct["children"] = [
+                rec(node[k], path + [str(k)]) for k in struct["keys"]]
+            struct["key_types"] = [type(k).__name__ for k in struct["keys"]]
+            return struct
+        if isinstance(node, (list, tuple)):
+            struct = {"__kind__": "list" if isinstance(node, list) else "tuple",
+                      "children": [rec(v, path + [str(i)])
+                                   for i, v in enumerate(node)]}
+            return struct
+        if node is None:
+            return {"__kind__": "none"}
+        arr = np.asarray(node)
+        key = _SEP.join(path) or "__root__"
+        leaves[key] = arr
+        return {"__kind__": "leaf", "key": key, "dtype": str(arr.dtype)}
+
+    structure = rec(tree, [])
+    return leaves, structure
+
+
+def _unflatten(structure, leaves, as_jax: bool = True):
+    import jax.numpy as jnp
+
+    from bigdl_tpu.utils.table import Table
+
+    def rec(s):
+        kind = s["__kind__"]
+        if kind == "none":
+            return None
+        if kind == "leaf":
+            arr = leaves[s["key"]]
+            return jnp.asarray(arr) if as_jax else arr
+        if kind in ("list", "tuple"):
+            vals = [rec(c) for c in s["children"]]
+            return vals if kind == "list" else tuple(vals)
+        # dict
+        keys = []
+        for k, t in zip(s["keys"], s.get("key_types", ["str"] * len(s["keys"]))):
+            keys.append(int(k) if t == "int" else k)
+        d = Table() if s.get("table") else {}
+        for k, c in zip(keys, s["children"]):
+            d[k] = rec(c)
+        return d
+
+    return rec(structure)
+
+
+def save_pytree(directory: str, name: str, tree: Any,
+                metadata: Optional[Dict] = None,
+                only_host0: bool = False) -> str:
+    import jax
+
+    if only_host0 and jax.process_index() != 0:
+        return os.path.join(directory, name)
+    os.makedirs(directory, exist_ok=True)
+    host_tree = jax.tree_util.tree_map(np.asarray, tree)
+    leaves, structure = _flatten(host_tree)
+    npz_path = os.path.join(directory, f"{name}.npz")
+    json_path = os.path.join(directory, f"{name}.json")
+    np.savez(npz_path, **leaves)
+    with open(json_path, "w") as f:
+        json.dump({"structure": structure, "metadata": metadata or {},
+                   "saved_at": time.time()}, f)
+    return os.path.join(directory, name)
+
+
+def load_pytree(directory: str, name: str, as_jax: bool = True
+                ) -> Tuple[Any, Dict]:
+    npz_path = os.path.join(directory, f"{name}.npz")
+    json_path = os.path.join(directory, f"{name}.json")
+    with open(json_path) as f:
+        manifest = json.load(f)
+    with np.load(npz_path) as z:
+        leaves = {k: z[k] for k in z.files}
+    tree = _unflatten(manifest["structure"], leaves, as_jax=as_jax)
+    return tree, manifest.get("metadata", {})
+
+
+class Checkpoint:
+    """Numbered training checkpoints with latest-discovery
+    (reference: DistriOptimizer's checkpointPath + getLatestFile)."""
+
+    MODEL = "model"
+    OPTIM = "optim"
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def save(self, step: int, model_variables: Any, optim_state: Any,
+             train_state: Optional[Dict] = None) -> str:
+        d = os.path.join(self.path, f"checkpoint-{step}")
+        save_pytree(d, self.MODEL, model_variables,
+                    metadata={"train_state": train_state or {}})
+        save_pytree(d, self.OPTIM, optim_state)
+        return d
+
+    def latest(self) -> Optional[str]:
+        if not os.path.isdir(self.path):
+            return None
+        best, best_step = None, -1
+        for entry in os.listdir(self.path):
+            m = re.fullmatch(r"checkpoint-(\d+)", entry)
+            if m and int(m.group(1)) > best_step:
+                best, best_step = entry, int(m.group(1))
+        return os.path.join(self.path, best) if best else None
+
+    def load(self, directory: Optional[str] = None):
+        d = directory or self.latest()
+        if d is None:
+            raise FileNotFoundError(f"no checkpoint under {self.path}")
+        model_variables, meta = load_pytree(d, self.MODEL)
+        optim_state, _ = load_pytree(d, self.OPTIM)
+        return model_variables, optim_state, meta.get("train_state", {})

@@ -28,8 +28,14 @@ class Table(dict):
         return f"Table({inner})"
 
 
+def sort_key(k):
+    """Order dict keys numerically first, then strings — `repr` ordering
+    would put 10 before 2 and permute tables with >= 10 entries."""
+    return (isinstance(k, str), k)
+
+
 def _table_flatten(t: Table):
-    keys = sorted(t.keys(), key=repr)
+    keys = sorted(t.keys(), key=sort_key)
     return [t[k] for k in keys], tuple(keys)
 
 

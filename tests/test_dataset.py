@@ -1,0 +1,148 @@
+"""Data plane tests (reference: dataset/DataSetSpec, transformer specs)."""
+
+import numpy as np
+
+from bigdl_tpu.dataset import (
+    DataSet, MiniBatch, Sample, SampleToMiniBatch, chain,
+)
+from bigdl_tpu.dataset.image import (
+    BGRImgNormalizer, CenterCrop, GreyImgNormalizer, HFlip, RandomCrop,
+    RandomResizedCrop, ColorJitter, Lighting,
+)
+from bigdl_tpu.dataset.mnist import synthetic_mnist
+from bigdl_tpu.dataset.text import (
+    Dictionary, LabeledSentenceToSample, SentenceBiPadding, SentenceTokenizer,
+    TextToLabeledSentence,
+)
+
+
+class TestSampleMiniBatch:
+    def test_stack(self):
+        samples = [Sample(np.ones((4, 4, 1)) * i, np.int32(i)) for i in range(3)]
+        mb = MiniBatch.from_samples(samples)
+        assert mb.input.shape == (3, 4, 4, 1)
+        assert mb.target.shape == (3,)
+        assert mb.target[2] == 2
+
+    def test_pad_to(self):
+        samples = [Sample(np.zeros(2), np.int32(0))] * 3
+        mb = MiniBatch.from_samples(samples, pad_to=8)
+        assert mb.input.shape == (8, 2)
+        assert mb.real_size == 3
+
+    def test_slice(self):
+        mb = MiniBatch(np.arange(10)[:, None], np.arange(10))
+        s = mb.slice(4, 3)
+        np.testing.assert_array_equal(s.input[:, 0], [4, 5, 6])
+
+
+class TestDataSet:
+    def test_eval_iterates_once(self):
+        ds = DataSet.array(list(range(5)))
+        assert list(ds.data(train=False)) == [0, 1, 2, 3, 4]
+
+    def test_train_loops_and_shuffles(self):
+        ds = DataSet.array(list(range(10)), seed=3)
+        it = ds.data(train=True)
+        first_epoch = [next(it) for _ in range(10)]
+        second_epoch = [next(it) for _ in range(10)]
+        assert sorted(first_epoch) == list(range(10))
+        assert sorted(second_epoch) == list(range(10))
+        assert first_epoch != list(range(10)) or second_epoch != first_epoch
+
+    def test_sharded_partition(self):
+        ds0 = DataSet.sharded(list(range(10)), process_id=0, process_count=2)
+        ds1 = DataSet.sharded(list(range(10)), process_id=1, process_count=2)
+        e0 = list(ds0.data(train=False))
+        e1 = list(ds1.data(train=False))
+        assert sorted(e0 + e1) == list(range(10))
+        assert not set(e0) & set(e1)
+
+    def test_sharded_train_covers_all_in_lockstep(self):
+        shards = [DataSet.sharded(list(range(8)), process_id=p, process_count=2,
+                                  seed=7) for p in range(2)]
+        its = [s.data(train=True) for s in shards]
+        epoch = [next(it) for it in its for _ in range(4)]
+        assert sorted(epoch) == list(range(8))
+
+    def test_transform_chain(self):
+        samples = [Sample(np.full((4, 4, 1), 10.0), np.int32(1))] * 4
+        ds = DataSet.array(samples) >> GreyImgNormalizer(10.0, 2.0) \
+            >> SampleToMiniBatch(2)
+        batches = list(ds.data(train=False))
+        assert len(batches) == 2
+        np.testing.assert_allclose(batches[0].input, 0.0)
+
+
+class TestBatcher:
+    def test_drop_partial(self):
+        samples = [Sample(np.zeros(1), np.int32(0))] * 5
+        t = SampleToMiniBatch(2, partial="drop")
+        assert len(list(t(iter(samples)))) == 2
+
+    def test_pad_partial(self):
+        samples = [Sample(np.zeros(1), np.int32(0))] * 5
+        batches = list(SampleToMiniBatch(2)(iter(samples)))
+        assert len(batches) == 3
+        assert batches[-1].real_size == 1
+        assert batches[-1].size == 2
+
+
+class TestImageTransforms:
+    def _img_samples(self, n=4, h=8, w=8, c=3):
+        rng = np.random.RandomState(0)
+        return [Sample(rng.rand(h, w, c).astype(np.float32), np.int32(0))
+                for _ in range(n)]
+
+    def test_bgr_normalizer(self):
+        out = list(BGRImgNormalizer([0.5] * 3, [0.25] * 3)(self._img_samples(1)))
+        assert out[0].feature.shape == (8, 8, 3)
+
+    def test_center_crop(self):
+        out = list(CenterCrop(4, 4)(self._img_samples(1)))
+        assert out[0].feature.shape == (4, 4, 3)
+
+    def test_random_crop_with_padding(self):
+        out = list(RandomCrop(8, 8, padding=2)(self._img_samples(1)))
+        assert out[0].feature.shape == (8, 8, 3)
+
+    def test_random_resized_crop(self):
+        out = list(RandomResizedCrop(5)(self._img_samples(2)))
+        assert all(s.feature.shape == (5, 5, 3) for s in out)
+
+    def test_hflip_deterministic_seed(self):
+        a = list(HFlip(0.5, seed=1)(self._img_samples(4)))
+        b = list(HFlip(0.5, seed=1)(self._img_samples(4)))
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x.feature, y.feature)
+
+    def test_colorjitter_lighting_run(self):
+        out = list(chain(ColorJitter(), Lighting())(self._img_samples(2)))
+        assert out[0].feature.shape == (8, 8, 3)
+
+
+class TestTextPipeline:
+    def test_tokenize_and_dictionary(self):
+        sents = list(SentenceTokenizer()(["Hello world", "hello there"]))
+        d = Dictionary(sents)
+        assert d.index("hello") != d.index("world")
+        assert d.index("zzz") == d.unk_index
+
+    def test_lm_pipeline(self):
+        texts = ["the cat sat", "the dog ran"]
+        tok = SentenceTokenizer()
+        sents = list(chain(tok, SentenceBiPadding())(texts))
+        d = Dictionary(sents)
+        pipeline = chain(tok, SentenceBiPadding(), TextToLabeledSentence(d),
+                         LabeledSentenceToSample(fixed_length=6))
+        samples = list(pipeline(texts))
+        assert len(samples) == 2
+        assert samples[0].feature.shape == (6,)
+        assert samples[0].label.shape == (6,)
+        # next-word property: label[t] == feature[t+1] inside the sentence
+        assert samples[0].label[0] == samples[0].feature[1]
+
+    def test_synthetic_mnist_shapes(self):
+        s = synthetic_mnist(8)
+        assert s[0].feature.shape == (28, 28, 1)
+        assert 0 <= int(s[0].label) < 10

@@ -1,0 +1,211 @@
+"""Optim method / schedule / trigger tests
+(reference: optim/SGDSpec, AdamSpec, TriggerSpec)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.optim import (
+    SGD, Adam, Adagrad, Adamax, RMSprop, AdaDelta, Ftrl,
+    Default, Step, MultiStep, Poly, Warmup, SequentialSchedule, Plateau,
+    Trigger, Top1Accuracy, Top5Accuracy, ValidationResult,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def rosenbrock_like_quadratic(params):
+    # f(w) = sum((w - 3)^2); minimum at w = 3
+    return jnp.sum((params["w"] - 3.0) ** 2)
+
+
+def converges(method, iters=600, tol=1e-2):
+    params = {"w": jnp.zeros(4)}
+    slots = method.init_slots(params)
+    grad_fn = jax.jit(jax.grad(rosenbrock_like_quadratic))
+    state = {"epoch": 1, "neval": 0}
+    for i in range(iters):
+        g = grad_fn(params)
+        lr = method.current_rate(state)
+        params, slots = method.update(g, params, slots,
+                                      jnp.asarray(lr), jnp.asarray(i))
+        state["neval"] += 1
+    return float(jnp.max(jnp.abs(params["w"] - 3.0))) < tol
+
+
+class TestMethodsConverge:
+    def test_sgd(self):
+        assert converges(SGD(learningrate=0.1))
+
+    def test_sgd_momentum_nesterov(self):
+        assert converges(SGD(learningrate=0.05, momentum=0.9, dampening=0.0,
+                             nesterov=True))
+
+    def test_adam(self):
+        assert converges(Adam(learningrate=0.1))
+
+    def test_adagrad(self):
+        assert converges(Adagrad(learningrate=1.0))
+
+    def test_adamax(self):
+        assert converges(Adamax(learningrate=0.5))
+
+    def test_rmsprop(self):
+        assert converges(RMSprop(learningrate=0.1))
+
+    def test_adadelta_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        w0 = np.asarray([1.0, -2.0], np.float32)
+        grads_seq = [np.asarray([0.5, -0.25], np.float32) * (i + 1)
+                     for i in range(6)]
+        method = AdaDelta(decayrate=0.9, epsilon=1e-6)
+        params = {"w": jnp.asarray(w0)}
+        slots = method.init_slots(params)
+        for i, g in enumerate(grads_seq):
+            params, slots = method.update({"w": jnp.asarray(g)}, params, slots,
+                                          jnp.asarray(1.0), jnp.asarray(i))
+        tw = torch.tensor(w0.copy(), requires_grad=True)
+        opt = torch.optim.Adadelta([tw], lr=1.0, rho=0.9, eps=1e-6)
+        for g in grads_seq:
+            opt.zero_grad()
+            tw.grad = torch.tensor(g)
+            opt.step()
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   tw.detach().numpy(), rtol=1e-5)
+
+    def test_ftrl(self):
+        assert converges(Ftrl(learningrate=1.0))
+
+
+class TestSGDvsTorch:
+    def test_momentum_trajectory_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        w0 = np.asarray([1.0, -2.0, 0.5], np.float32)
+        grads_seq = [np.asarray([0.1, -0.2, 0.3], np.float32) * (i + 1)
+                     for i in range(5)]
+
+        method = SGD(learningrate=0.1, momentum=0.9, dampening=0.0,
+                     weightdecay=0.01)
+        params = {"w": jnp.asarray(w0)}
+        slots = method.init_slots(params)
+        for i, g in enumerate(grads_seq):
+            params, slots = method.update({"w": jnp.asarray(g)}, params, slots,
+                                          jnp.asarray(0.1), jnp.asarray(i))
+
+        tw = torch.tensor(w0.copy(), requires_grad=True)
+        opt = torch.optim.SGD([tw], lr=0.1, momentum=0.9, weight_decay=0.01)
+        for g in grads_seq:
+            opt.zero_grad()
+            tw.grad = torch.tensor(g)
+            opt.step()
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   tw.detach().numpy(), rtol=1e-5)
+
+    def test_adam_trajectory_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        w0 = np.asarray([1.0, -1.0], np.float32)
+        grads_seq = [np.asarray([0.5, -0.3], np.float32)] * 4
+        method = Adam(learningrate=0.01)
+        params = {"w": jnp.asarray(w0)}
+        slots = method.init_slots(params)
+        for i, g in enumerate(grads_seq):
+            params, slots = method.update({"w": jnp.asarray(g)}, params, slots,
+                                          jnp.asarray(0.01), jnp.asarray(i))
+        tw = torch.tensor(w0.copy(), requires_grad=True)
+        opt = torch.optim.Adam([tw], lr=0.01)
+        for g in grads_seq:
+            opt.zero_grad()
+            tw.grad = torch.tensor(g)
+            opt.step()
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   tw.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+
+class TestSchedules:
+    def _state(self, neval, epoch=1):
+        return {"neval": neval, "epoch": epoch}
+
+    def test_default_decay(self):
+        m = SGD(learningrate=1.0, learningrate_decay=0.1)
+        assert m.current_rate(self._state(0)) == 1.0
+        np.testing.assert_allclose(m.current_rate(self._state(10)), 0.5)
+
+    def test_step(self):
+        m = SGD(learningrate=1.0, learningrate_schedule=Step(10, 0.5))
+        assert m.current_rate(self._state(9)) == 1.0
+        assert m.current_rate(self._state(10)) == 0.5
+        assert m.current_rate(self._state(25)) == 0.25
+
+    def test_multistep(self):
+        m = SGD(learningrate=1.0, learningrate_schedule=MultiStep([5, 8], 0.1))
+        assert m.current_rate(self._state(4)) == 1.0
+        np.testing.assert_allclose(m.current_rate(self._state(6)), 0.1)
+        np.testing.assert_allclose(m.current_rate(self._state(9)), 0.01)
+
+    def test_poly(self):
+        m = SGD(learningrate=1.0, learningrate_schedule=Poly(2.0, 100))
+        np.testing.assert_allclose(m.current_rate(self._state(50)), 0.25)
+
+    def test_warmup_sequential(self):
+        seq = SequentialSchedule().add(Warmup(5), 5).add(Default(), 1000)
+        m = SGD(learningrate=1.0, learningrate_schedule=seq)
+        np.testing.assert_allclose(m.current_rate(self._state(0)), 0.2)
+        np.testing.assert_allclose(m.current_rate(self._state(4)), 1.0)
+        np.testing.assert_allclose(m.current_rate(self._state(100)), 1.0)
+
+    def test_plateau(self):
+        p = Plateau(factor=0.5, patience=2, mode="max")
+        m = SGD(learningrate=1.0, learningrate_schedule=p)
+        for score in [0.5, 0.5, 0.5]:
+            p.on_metric(score)
+        np.testing.assert_allclose(m.current_rate(self._state(0)), 0.5)
+
+
+class TestTriggers:
+    def test_max_epoch(self):
+        t = Trigger.max_epoch(3)
+        assert not t({"epoch": 3, "neval": 100})
+        assert t({"epoch": 4, "neval": 100})
+
+    def test_every_epoch_fires_on_transition(self):
+        t = Trigger.every_epoch()
+        assert not t({"epoch": 1, "neval": 5})
+        assert t({"epoch": 2, "neval": 10})
+        assert not t({"epoch": 2, "neval": 11})
+        assert t({"epoch": 3, "neval": 20})
+
+    def test_several_iteration(self):
+        t = Trigger.several_iteration(5)
+        assert not t({"epoch": 1, "neval": 4})
+        assert t({"epoch": 1, "neval": 5})
+
+    def test_combinators(self):
+        t = Trigger.and_(Trigger.max_epoch(2), Trigger.max_iteration(10))
+        assert not t({"epoch": 3, "neval": 5})
+        assert t({"epoch": 3, "neval": 10})
+
+
+class TestValidationMethods:
+    def test_top1(self):
+        out = jnp.asarray([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+        tgt = jnp.asarray([1, 0, 0])
+        r = Top1Accuracy().apply(out, tgt)
+        np.testing.assert_allclose(r.result()[0], 2.0 / 3.0)
+
+    def test_top5(self):
+        out = jnp.eye(8)[:3] * 0.1 + jnp.arange(8) * 0.01
+        tgt = jnp.asarray([7, 6, 5])
+        r = Top5Accuracy().apply(out, tgt)
+        assert r.result()[0] == 1.0
+
+    def test_masked_padding(self):
+        out = jnp.asarray([[0.9, 0.1], [0.9, 0.1], [0.9, 0.1], [0.9, 0.1]])
+        tgt = jnp.asarray([0, 0, 1, 1])
+        r = Top1Accuracy().apply(out, tgt, real_size=2)
+        assert r.result() == (1.0, 2)
+
+    def test_result_merge(self):
+        a = ValidationResult(3, 4)
+        b = ValidationResult(1, 4)
+        assert (a + b).result() == (0.5, 8)

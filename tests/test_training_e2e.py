@@ -1,0 +1,104 @@
+"""End-to-end training — the ★ minimum slice of SURVEY.md §7.3:
+LeNet-5 on (synthetic) MNIST, jitted, converging, with checkpoint + TB
+summaries (reference: models/lenet/Train.scala PR1 config)."""
+
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DataSet
+from bigdl_tpu.dataset.mnist import synthetic_mnist
+from bigdl_tpu.models import lenet
+from bigdl_tpu.optim import (
+    Adam, SGD, Optimizer, Trigger, Top1Accuracy, Loss, Evaluator, Predictor,
+)
+from bigdl_tpu.serialization.checkpoint import Checkpoint
+from bigdl_tpu.visualization import TrainSummary, ValidationSummary
+
+logging.basicConfig(level=logging.INFO)
+
+
+@pytest.fixture(scope="module")
+def mnist_data():
+    return synthetic_mnist(512, seed=0), synthetic_mnist(128, seed=9)
+
+
+class TestLeNetEndToEnd:
+    def test_lenet_converges(self, mnist_data, tmp_path_factory):
+        train, test = mnist_data
+        tmp = tmp_path_factory.mktemp("lenet")
+        model = lenet.build(10).build(jax.random.PRNGKey(7))
+        train_summary = TrainSummary(str(tmp / "logs"), "lenet")
+        val_summary = ValidationSummary(str(tmp / "logs"), "lenet")
+
+        opt = (Optimizer(model, DataSet.array(train), nn.ClassNLLCriterion(),
+                         batch_size=64)
+               .set_optim_method(Adam(learningrate=2e-3))
+               .set_end_when(Trigger.max_epoch(3))
+               .set_validation(Trigger.every_epoch(), DataSet.array(test),
+                               [Top1Accuracy()], 64)
+               .set_checkpoint(str(tmp / "ckpt"), Trigger.every_epoch())
+               .set_train_summary(train_summary)
+               .set_validation_summary(val_summary))
+        trained = opt.optimize()
+
+        acc = Evaluator(trained).test(DataSet.array(test), [Top1Accuracy()], 64)
+        top1 = acc["Top1Accuracy"].result()[0]
+        assert top1 > 0.9, f"LeNet failed to learn synthetic MNIST: {top1}"
+
+        # checkpoint exists and loads
+        ck = Checkpoint(str(tmp / "ckpt"))
+        variables, slots, train_state = ck.load()
+        assert train_state["epoch"] >= 2
+
+        # TB summaries readable
+        losses = train_summary.read_scalar("Loss")
+        assert len(losses) >= 10
+        assert losses[-1][1] < losses[0][1]  # loss went down
+
+    def test_predictor(self, mnist_data):
+        train, test = mnist_data
+        model = lenet.build(10).build(jax.random.PRNGKey(0))
+        preds = Predictor(model, batch_size=32).predict_class(
+            DataSet.array(test[:50]))
+        assert preds.shape == (50,)
+        assert preds.dtype in (np.int32, np.int64)
+
+    def test_checkpoint_resume(self, mnist_data, tmp_path):
+        train, _ = mnist_data
+        model = lenet.build(10).build(jax.random.PRNGKey(1))
+        opt = (Optimizer(model, DataSet.array(train[:128]),
+                         nn.ClassNLLCriterion(), batch_size=64)
+               .set_optim_method(SGD(learningrate=0.05))
+               .set_end_when(Trigger.max_iteration(4))
+               .set_checkpoint(str(tmp_path), Trigger.several_iteration(2)))
+        opt.optimize()
+
+        model2 = lenet.build(10).build(jax.random.PRNGKey(2))
+        opt2 = (Optimizer(model2, DataSet.array(train[:128]),
+                          nn.ClassNLLCriterion(), batch_size=64)
+                .set_optim_method(SGD(learningrate=0.05))
+                .set_end_when(Trigger.max_iteration(8))
+                .set_checkpoint(str(tmp_path), Trigger.several_iteration(2))
+                .resume_from_checkpoint())
+        trained = opt2.optimize()
+        # resumed run continued counting from the saved neval
+        ck = Checkpoint(str(tmp_path))
+        _, _, ts = ck.load()
+        assert ts["neval"] == 8
+
+    def test_graph_lenet_trains(self, mnist_data):
+        train, _ = mnist_data
+        model = lenet.graph(10).build(jax.random.PRNGKey(3))
+        opt = (Optimizer(model, DataSet.array(train[:128]),
+                         nn.ClassNLLCriterion(), batch_size=32)
+               .set_optim_method(Adam(learningrate=1e-3))
+               .set_end_when(Trigger.max_iteration(3)))
+        trained = opt.optimize()
+        out = trained.evaluate().forward(jnp.ones((2, 28, 28, 1)))
+        assert out.shape == (2, 10)
