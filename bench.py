@@ -1,0 +1,79 @@
+"""Benchmark — ResNet-50 synthetic-data training throughput, single chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+
+Reference parity: models/utils/LocalOptimizerPerf.scala — the reference's
+synthetic-throughput harness (SURVEY.md §5.1). The reference publishes no
+absolute numbers (BASELINE.md); vs_baseline is computed against
+REF_THROUGHPUT below — the reference-era BigDL CPU figure for ResNet-50
+training (~10 img/s on a 2-socket Xeon node, from the qualitative record
+in the BigDL paper line of work; see BASELINE.md provenance).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+REF_THROUGHPUT = 10.0  # images/sec — reference CPU-node ballpark (BASELINE.md)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.models import resnet
+    from bigdl_tpu.optim import SGD
+
+    platform = jax.devices()[0].platform
+    batch = 64 if platform == "tpu" else 8
+    model = resnet.build_imagenet(50, 1000)
+    variables = model.init(jax.random.PRNGKey(0))
+    method = SGD(learningrate=0.1, momentum=0.9, dampening=0.0)
+    criterion = nn.ClassNLLCriterion()
+    slots = method.init_slots(variables["params"])
+
+    @jax.jit
+    def train_step(params, state, slots, bx, by):
+        def loss_fn(p):
+            out, new_state = model.apply({"params": p, "state": state}, bx,
+                                         training=True)
+            return criterion(out, by), new_state
+
+        (loss, new_state), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params, new_slots = method.update(
+            grads, params, slots, jnp.asarray(0.1), jnp.asarray(0))
+        return new_params, new_state, new_slots, loss
+
+    rng = np.random.RandomState(0)
+    bx = jnp.asarray(rng.rand(batch, 224, 224, 3).astype(np.float32))
+    by = jnp.asarray(rng.randint(0, 1000, batch).astype(np.int32))
+
+    params, state = variables["params"], variables["state"]
+    # warmup/compile
+    params, state, slots, loss = train_step(params, state, slots, bx, by)
+    jax.block_until_ready(loss)
+
+    n_iters = 20 if platform == "tpu" else 3
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        params, state, slots, loss = train_step(params, state, slots, bx, by)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    value = n_iters * batch / dt
+    print(json.dumps({
+        "metric": f"resnet50_train_images_per_sec_per_chip[{platform}]",
+        "value": round(value, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(value / REF_THROUGHPUT, 2),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,5 @@
+"""Model zoo (reference: bigdl/models/)."""
+
+from bigdl_tpu.models import (
+    alexnet, autoencoder, inception, lenet, resnet, rnn, vgg,
+)

@@ -1,0 +1,78 @@
+"""Inception v1 (GoogLeNet).
+
+Reference parity: models/inception/Inception_v1.scala —
+`Inception_Layer_v1` (4-branch module: 1x1 / 1x1→3x3 / 1x1→5x5 /
+pool→1x1, concat over channels) and the full `Inception_v1_NoAuxClassifier`
+graph; config tables match the reference's channel numbers.
+
+TPU note: the 4 branches are independent convs XLA schedules in parallel
+on the MXU; `nn.Concat` along the channel axis is the NHWC-native concat.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.initialization import Xavier
+
+
+def _conv(n_in, n_out, k, stride=1, pad=0, name=""):
+    return nn.Sequential(
+        nn.SpatialConvolution(n_in, n_out, k, k, stride, stride, pad, pad,
+                              w_init=Xavier()).set_name(name + f"conv{k}x{k}"),
+        nn.ReLU(),
+    )
+
+
+def inception_layer_v1(n_in, config, prefix=""):
+    """(reference: Inception_v1.scala#Inception_Layer_v1)
+    config = ((c1,), (c3r, c3), (c5r, c5), (pp,))"""
+    (c1,), (c3r, c3), (c5r, c5), (pp,) = config
+    return nn.Concat(
+        4,  # channel axis in NHWC (1-based dim 4)
+        _conv(n_in, c1, 1, name=prefix + "1x1/"),
+        nn.Sequential(
+            _conv(n_in, c3r, 1, name=prefix + "3x3r/"),
+            _conv(c3r, c3, 3, pad=1, name=prefix + "3x3/")),
+        nn.Sequential(
+            _conv(n_in, c5r, 1, name=prefix + "5x5r/"),
+            _conv(c5r, c5, 5, pad=2, name=prefix + "5x5/")),
+        nn.Sequential(
+            nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil(),
+            _conv(n_in, pp, 1, name=prefix + "pool/")),
+    )
+
+
+def build(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
+    """(reference: Inception_v1.scala#Inception_v1_NoAuxClassifier)"""
+    m = nn.Sequential(
+        nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3,
+                              w_init=Xavier()).set_name("conv1/7x7_s2"),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+        nn.SpatialCrossMapLRN(5, 0.0001, 0.75),
+        _conv(64, 64, 1, name="conv2/3x3_reduce/"),
+        _conv(64, 192, 3, pad=1, name="conv2/3x3/"),
+        nn.SpatialCrossMapLRN(5, 0.0001, 0.75),
+        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+        inception_layer_v1(192, ((64,), (96, 128), (16, 32), (32,)), "3a/"),
+        inception_layer_v1(256, ((128,), (128, 192), (32, 96), (64,)), "3b/"),
+        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+        inception_layer_v1(480, ((192,), (96, 208), (16, 48), (64,)), "4a/"),
+        inception_layer_v1(512, ((160,), (112, 224), (24, 64), (64,)), "4b/"),
+        inception_layer_v1(512, ((128,), (128, 256), (24, 64), (64,)), "4c/"),
+        inception_layer_v1(512, ((112,), (144, 288), (32, 64), (64,)), "4d/"),
+        inception_layer_v1(528, ((256,), (160, 320), (32, 128), (128,)), "4e/"),
+        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+        inception_layer_v1(832, ((256,), (160, 320), (32, 128), (128,)), "5a/"),
+        inception_layer_v1(832, ((384,), (192, 384), (48, 128), (128,)), "5b/"),
+        nn.SpatialAveragePooling(7, 7, 1, 1),
+    )
+    if has_dropout:
+        m.add(nn.Dropout(0.4))
+    m.add(nn.Reshape([1024]))
+    m.add(nn.Linear(1024, class_num).set_name("loss3/classifier"))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+Inception_v1 = build

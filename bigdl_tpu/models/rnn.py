@@ -1,0 +1,62 @@
+"""Recurrent models: PTB-style language model and BiLSTM sentiment.
+
+Reference parity: models/rnn/SimpleRNN.scala (LookupTable→Recurrent(RnnCell)
+→TimeDistributed(Linear)→LogSoftMax over time) and the BiLSTM sentiment
+configuration from the reference's example/ (BiRecurrent(LSTM) → pooled
+classifier), trained with TimeDistributedCriterion(ClassNLLCriterion) /
+CrossEntropy respectively (SURVEY.md §2.5 model zoo, BASELINE.md config 4).
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu import nn
+
+
+def simple_rnn(vocab_size: int, hidden_size: int = 40,
+               output_size: int = None, embed_dim: int = None) -> nn.Sequential:
+    """(reference: models/rnn/SimpleRNN.scala) word-level LM."""
+    output_size = output_size or vocab_size
+    embed_dim = embed_dim or hidden_size
+    return nn.Sequential(
+        nn.LookupTable(vocab_size, embed_dim).set_name("embedding"),
+        nn.Recurrent(nn.RnnCell(embed_dim, hidden_size)).set_name("rnn"),
+        nn.TimeDistributed(nn.Linear(hidden_size, output_size)).set_name("proj"),
+        nn.TimeDistributed(nn.LogSoftMax()),
+    )
+
+
+def lstm_lm(vocab_size: int, embed_dim: int = 128, hidden_size: int = 128,
+            num_layers: int = 1, dropout: float = 0.0) -> nn.Sequential:
+    """LSTM language model (reference: example/languagemodel PTB config)."""
+    m = nn.Sequential(nn.LookupTable(vocab_size, embed_dim).set_name("embedding"))
+    in_size = embed_dim
+    for i in range(num_layers):
+        m.add(nn.Recurrent(nn.LSTM(in_size, hidden_size)).set_name(f"lstm{i}"))
+        if dropout > 0:
+            m.add(nn.Dropout(dropout))
+        in_size = hidden_size
+    m.add(nn.TimeDistributed(nn.Linear(hidden_size, vocab_size)).set_name("proj"))
+    m.add(nn.TimeDistributed(nn.LogSoftMax()))
+    return m
+
+
+class _MeanOverTime(nn.Module):
+    """Mean-pool over the time axis of (N, T, D)."""
+
+    def apply(self, variables, x, training=False, rng=None):
+        import jax.numpy as jnp
+
+        return jnp.mean(x, axis=1), variables["state"]
+
+
+def bilstm_sentiment(vocab_size: int, embed_dim: int = 128,
+                     hidden_size: int = 128, class_num: int = 2) -> nn.Sequential:
+    """BiLSTM text classifier (reference: example/ sentiment BiRecurrent
+    config; BASELINE.md config 4)."""
+    return nn.Sequential(
+        nn.LookupTable(vocab_size, embed_dim).set_name("embedding"),
+        nn.BiRecurrent(nn.LSTM(embed_dim, hidden_size)).set_name("bilstm"),
+        _MeanOverTime(),
+        nn.Linear(2 * hidden_size, class_num).set_name("cls"),
+        nn.LogSoftMax(),
+    )

@@ -85,10 +85,12 @@ class Optimizer:
         self.checkpoint_trigger: Optional[Trigger] = None
         self.train_summary = None
         self.validation_summary = None
-        self.grad_clip_const: Optional[float] = None
+        self.grad_clip_const: Optional[tuple] = None
         self.grad_clip_norm: Optional[float] = None
         self.log_every = 1
         self._resume = False
+        self.mesh = None
+        self.mesh_axis = "data"
 
     # ------------------------------------------------------- builder surface
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
@@ -135,8 +137,20 @@ class Optimizer:
         self.grad_clip_norm = clip_norm
         return self
 
+    def set_mesh(self, mesh, axis: str = "data") -> "Optimizer":
+        """Train data-parallel over a device mesh — switches dispatch to
+        DistriOptimizer (the reference dispatches Local vs Distri on the
+        dataset type; here the mesh is the explicit signal)."""
+        self.mesh = mesh
+        self.mesh_axis = axis
+        return self
+
     # ------------------------------------------------------------- dispatch
     def optimize(self) -> Module:
+        if self.mesh is not None:
+            from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
+
+            return DistriOptimizer(self, self.mesh, self.mesh_axis).run()
         return LocalOptimizer(self).run()
 
 
@@ -213,7 +227,17 @@ class LocalOptimizer:
                                        "records": 0, "loss": None, "score": None}
 
         if o._resume and o.checkpoint is not None and o.checkpoint.latest():
-            variables, slots, saved = o.checkpoint.load()
+            variables, slots, saved, optim_meta = o.checkpoint.load(
+                with_optim_meta=True)
+            if (optim_meta or {}).get("layout") == "zero1_flat":
+                # checkpoint written by DistriOptimizer: each slot is a flat
+                # (padded,) vector over the whole parameter set — unflatten
+                # back to the params-pytree layout this loop uses
+                from bigdl_tpu.parallel.data_parallel import FlatParamSpec
+
+                spec = FlatParamSpec(variables["params"],
+                                     optim_meta["num_shards"])
+                slots = jax.tree_util.tree_map(spec.unflatten, slots)
             train_state.update(saved)
             logger.info("resumed from %s at %s", o.checkpoint.latest(), saved)
 

@@ -54,10 +54,15 @@ class ValidationMethod:
         return self.name
 
 
-def _row_mask(n_rows: int, real_size: Optional[int]):
+def _row_mask(n_rows: int, real_size):
+    """real_size: None (no padding), an int prefix length, or an explicit
+    per-row 0/1 mask array (needed when the batch is sharded over a mesh
+    and padded rows are not a prefix of each shard)."""
     if real_size is None:
         return jnp.ones((n_rows,), jnp.float32)
-    return (jnp.arange(n_rows) < real_size).astype(jnp.float32)
+    if isinstance(real_size, (int, np.integer)):
+        return (jnp.arange(n_rows) < real_size).astype(jnp.float32)
+    return jnp.asarray(real_size, jnp.float32)
 
 
 class Top1Accuracy(ValidationMethod):
@@ -90,13 +95,23 @@ class Loss(ValidationMethod):
         self.criterion = criterion
 
     def stats(self, output, target, real_size=None):
-        # padded rows share the batch mean; mask exactly by recomputing sums
         n = output.shape[0]
-        if real_size is not None and real_size != n:
-            output = output[:real_size]
-            target = target[:real_size]
-            n = real_size
-        return self.criterion(output, target) * n, jnp.asarray(float(n))
+        if real_size is None:
+            return self.criterion(output, target) * n, jnp.asarray(float(n))
+        if isinstance(real_size, (int, np.integer)):
+            if real_size != n:
+                output = output[:real_size]
+                target = target[:real_size]
+            return (self.criterion(output, target) * real_size,
+                    jnp.asarray(float(real_size)))
+        # Mask-array case (sharded eval): padded rows repeat real samples,
+        # so scaling the full-batch mean by the real count biases the total
+        # by at most (padded/batch) of one batch's loss. NOTE this value
+        # also feeds Plateau via train_state['score'] when Loss is the
+        # first validation method — keep Loss exact (unsharded) if driving
+        # an LR schedule from it at small validation sizes.
+        cnt = jnp.sum(jnp.asarray(real_size, jnp.float32))
+        return self.criterion(output, target) * cnt, cnt
 
 
 class TreeNNAccuracy(ValidationMethod):

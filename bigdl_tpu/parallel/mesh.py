@@ -1,0 +1,67 @@
+"""Device-mesh construction.
+
+Reference parity: the reference's "cluster topology" is Spark executors
+discovered by utils/Engine.scala; its parameter plane assumes one
+partition per executor (parameters/AllReduceParameter.scala#init). Here
+topology is a `jax.sharding.Mesh` over PJRT devices; axes are named for
+the parallelism they carry:
+
+    data   — data parallelism (the reference's only strategy)
+    model  — tensor parallelism (post-parity extension)
+    seq    — sequence/context parallelism (ring attention)
+    expert — expert parallelism (MoE)
+    pipe   — pipeline stages
+
+On real hardware, axis order maps onto the physical ICI torus: keep the
+fastest-communicating axis (model/seq) innermost so its collectives ride
+neighboring chips.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a mesh from {axis_name: size}; sizes must multiply to the
+    device count (one axis may be -1 to absorb the rest)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not axes:
+        axes = {"data": n}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} != {n} devices")
+    arr = np.array(devices).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def sharded(mesh: Mesh, *axis_names: Optional[str]) -> NamedSharding:
+    return NamedSharding(mesh, P(*axis_names))
+
+
+def host_to_global(mesh: Mesh, spec: P, array: np.ndarray) -> jax.Array:
+    """Build a global device array from per-host data.
+
+    Reference parity: the reference's data plane keeps partitions
+    executor-local and Spark never gathers them (SURVEY.md §5.8 "Spark
+    only partitions data"); likewise each host here contributes only its
+    local shard — on one process this is a plain sharded device_put.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(array, NamedSharding(mesh, spec))
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), array)

@@ -130,11 +130,12 @@ class Checkpoint:
         os.makedirs(path, exist_ok=True)
 
     def save(self, step: int, model_variables: Any, optim_state: Any,
-             train_state: Optional[Dict] = None) -> str:
+             train_state: Optional[Dict] = None,
+             optim_meta: Optional[Dict] = None) -> str:
         d = os.path.join(self.path, f"checkpoint-{step}")
         save_pytree(d, self.MODEL, model_variables,
                     metadata={"train_state": train_state or {}})
-        save_pytree(d, self.OPTIM, optim_state)
+        save_pytree(d, self.OPTIM, optim_state, metadata=optim_meta)
         return d
 
     def latest(self) -> Optional[str]:
@@ -147,10 +148,13 @@ class Checkpoint:
                 best, best_step = entry, int(m.group(1))
         return os.path.join(self.path, best) if best else None
 
-    def load(self, directory: Optional[str] = None):
+    def load(self, directory: Optional[str] = None, with_optim_meta: bool = False):
         d = directory or self.latest()
         if d is None:
             raise FileNotFoundError(f"no checkpoint under {self.path}")
         model_variables, meta = load_pytree(d, self.MODEL)
-        optim_state, _ = load_pytree(d, self.OPTIM)
+        optim_state, optim_meta = load_pytree(d, self.OPTIM)
+        if with_optim_meta:
+            return (model_variables, optim_state, meta.get("train_state", {}),
+                    optim_meta)
         return model_variables, optim_state, meta.get("train_state", {})

@@ -1,0 +1,59 @@
+"""Cross-layout checkpoint resume: Local (pytree slots) <-> Distri
+(ZeRO-1 flat slots) in both directions, and across mesh sizes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DataSet
+from bigdl_tpu.dataset.mnist import synthetic_mnist
+from bigdl_tpu.models import lenet
+from bigdl_tpu.optim import Optimizer, SGD, Trigger
+from bigdl_tpu.parallel import make_mesh
+from bigdl_tpu.serialization.checkpoint import Checkpoint
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _train(model, mesh, path, end_iter, resume=False, n_data=128):
+    opt = (Optimizer(model, DataSet.array(synthetic_mnist(n_data)),
+                     nn.ClassNLLCriterion(), batch_size=64)
+           .set_optim_method(SGD(learningrate=0.05, momentum=0.9, dampening=0.0))
+           .set_end_when(Trigger.max_iteration(end_iter))
+           .set_checkpoint(str(path), Trigger.several_iteration(2)))
+    if mesh is not None:
+        opt.set_mesh(mesh)
+    if resume:
+        opt.resume_from_checkpoint()
+    opt.log_every = 100
+    return opt.optimize()
+
+
+def test_distri_to_local_resume(tmp_path):
+    mesh = make_mesh({"data": 8})
+    _train(lenet.build(10).build(KEY), mesh, tmp_path, 4)
+    # resume the distri checkpoint in a LOCAL optimizer
+    _train(lenet.build(10).build(jax.random.PRNGKey(1)), None, tmp_path, 8,
+           resume=True)
+    _, _, ts = Checkpoint(str(tmp_path)).load()
+    assert ts["neval"] == 8
+
+
+def test_local_to_distri_resume(tmp_path):
+    _train(lenet.build(10).build(KEY), None, tmp_path, 4)
+    mesh = make_mesh({"data": 8})
+    _train(lenet.build(10).build(jax.random.PRNGKey(1)), mesh, tmp_path, 8,
+           resume=True)
+    _, _, ts = Checkpoint(str(tmp_path)).load()
+    assert ts["neval"] == 8
+
+
+def test_distri_mesh_size_change(tmp_path):
+    _train(lenet.build(10).build(KEY), make_mesh({"data": 8}), tmp_path, 4)
+    # resume on a 4-device mesh (different padded size)
+    mesh4 = make_mesh({"data": 4}, devices=jax.devices()[:4])
+    _train(lenet.build(10).build(jax.random.PRNGKey(1)), mesh4, tmp_path, 8,
+           resume=True)
+    _, _, ts = Checkpoint(str(tmp_path)).load()
+    assert ts["neval"] == 8

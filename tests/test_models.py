@@ -1,0 +1,145 @@
+"""Model zoo shape/grad tests (reference: models/*/...Spec.scala)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.models import alexnet, autoencoder, inception, lenet, resnet, rnn
+
+KEY = jax.random.PRNGKey(0)
+
+
+def n_params(model):
+    return sum(int(np.prod(np.shape(p))) for _, p in model.parameters())
+
+
+class TestLeNet:
+    def test_output_shape(self):
+        m = lenet.build(10).build(KEY).evaluate()
+        out = m.forward(jnp.ones((2, 28, 28, 1)))
+        assert out.shape == (2, 10)
+        np.testing.assert_allclose(np.exp(np.asarray(out)).sum(-1), 1.0, rtol=1e-5)
+
+
+class TestResNet:
+    def test_cifar_resnet20_shape(self):
+        m = resnet.build_cifar(20, 10).build(KEY).evaluate()
+        out = m.forward(jnp.ones((2, 32, 32, 3)))
+        assert out.shape == (2, 10)
+
+    def test_cifar_param_count(self):
+        # canonical resnet-20 cifar: ~0.27M params
+        m = resnet.build_cifar(20, 10).build(KEY)
+        assert 0.25e6 < n_params(m) < 0.30e6
+
+    def test_resnet50_param_count(self):
+        m = resnet.build_imagenet(50, 1000).build(KEY)
+        # canonical resnet-50: 25.56M
+        assert 25.0e6 < n_params(m) < 26.1e6
+
+    def test_resnet50_forward(self):
+        m = resnet.build_imagenet(50, 1000).build(KEY).evaluate()
+        out = m.forward(jnp.ones((1, 224, 224, 3)))
+        assert out.shape == (1, 1000)
+
+    def test_resnet18_forward(self):
+        m = resnet.build_imagenet(18, 1000).build(KEY).evaluate()
+        out = m.forward(jnp.ones((1, 224, 224, 3)))
+        assert out.shape == (1, 1000)
+
+    def test_shortcut_type_a_pads_channels(self):
+        m = resnet.build_cifar(20, 10, shortcut_type="A").build(KEY)
+        # type A adds no conv params in shortcuts: fewer params than B
+        mb = resnet.build_cifar(20, 10, shortcut_type="B").build(KEY)
+        assert n_params(m) < n_params(mb)
+
+    def test_cifar_grad_flows(self):
+        m = resnet.build_cifar(8, 10)
+        variables = m.init(KEY)
+
+        def loss(p):
+            out, _ = m.apply({"params": p, "state": variables["state"]},
+                             jnp.ones((2, 32, 32, 3)), training=True)
+            return jnp.sum(out)
+
+        g = jax.grad(loss)(variables["params"])
+        total = sum(float(jnp.abs(l).sum()) for l in jax.tree_util.tree_leaves(g))
+        assert np.isfinite(total) and total > 0
+
+
+class TestInception:
+    def test_inception_v1_shapes(self):
+        m = inception.build(1000).build(KEY).evaluate()
+        out = m.forward(jnp.ones((1, 224, 224, 3)))
+        assert out.shape == (1, 1000)
+
+    def test_param_count(self):
+        # canonical googlenet (no aux): ~6.6M-7M params
+        m = inception.build(1000, has_dropout=False).build(KEY)
+        assert 5.5e6 < n_params(m) < 7.5e6
+
+
+class TestAlexNetVgg:
+    def test_alexnet(self):
+        m = alexnet.build(1000).build(KEY).evaluate()
+        out = m.forward(jnp.ones((1, 224, 224, 3)))
+        assert out.shape == (1, 1000)
+
+    def test_vgg_cifar(self):
+        from bigdl_tpu.models import vgg
+
+        m = vgg.build_cifar(10).build(KEY).evaluate()
+        out = m.forward(jnp.ones((1, 32, 32, 3)))
+        assert out.shape == (1, 10)
+
+
+class TestAutoencoder:
+    def test_reconstruction_shape(self):
+        m = autoencoder.build(32).build(KEY).evaluate()
+        out = m.forward(jnp.ones((4, 28, 28, 1)))
+        assert out.shape == (4, 784)
+
+    def test_trains(self):
+        from bigdl_tpu.dataset import DataSet, Sample
+        from bigdl_tpu.optim import Adam, Optimizer, Trigger
+
+        rng = np.random.RandomState(0)
+        imgs = rng.rand(64, 28, 28, 1).astype(np.float32)
+        data = [Sample(imgs[i], imgs[i].reshape(-1)) for i in range(64)]
+        m = autoencoder.build(32).build(KEY)
+        opt = (Optimizer(m, DataSet.array(data), nn.MSECriterion(), batch_size=32)
+               .set_optim_method(Adam(1e-3))
+               .set_end_when(Trigger.max_iteration(5)))
+        opt.log_every = 100
+        opt.optimize()
+
+
+class TestRNNModels:
+    def test_simple_rnn_lm(self):
+        m = rnn.simple_rnn(vocab_size=50, hidden_size=16).build(KEY).evaluate()
+        out = m.forward(jnp.zeros((2, 7), jnp.int32))
+        assert out.shape == (2, 7, 50)
+
+    def test_lstm_lm_trains(self):
+        from bigdl_tpu.dataset import DataSet, Sample
+        from bigdl_tpu.optim import Adam, Optimizer, Trigger
+
+        rng = np.random.RandomState(0)
+        data = [Sample(rng.randint(0, 20, 9).astype(np.int32),
+                       rng.randint(0, 20, 9).astype(np.int32))
+                for _ in range(32)]
+        m = rnn.lstm_lm(vocab_size=20, embed_dim=16, hidden_size=16).build(KEY)
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
+        opt = (Optimizer(m, DataSet.array(data), crit, batch_size=16)
+               .set_optim_method(Adam(1e-2))
+               .set_end_when(Trigger.max_iteration(4)))
+        opt.log_every = 100
+        opt.optimize()
+
+    def test_bilstm_sentiment(self):
+        m = rnn.bilstm_sentiment(vocab_size=100, embed_dim=8, hidden_size=8,
+                                 class_num=2).build(KEY).evaluate()
+        out = m.forward(jnp.zeros((3, 12), jnp.int32))
+        assert out.shape == (3, 2)
