@@ -1,0 +1,5 @@
+"""ML pipeline API (reference: org.apache.spark.ml.DL* inside the dl tree)."""
+
+from bigdl_tpu.ml.estimator import (
+    DLEstimator, DLModel, DLClassifier, DLClassifierModel,
+)

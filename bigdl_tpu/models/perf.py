@@ -1,0 +1,153 @@
+"""Synthetic-data throughput harness.
+
+Reference parity: models/utils/LocalOptimizerPerf.scala and
+DistriOptimizerPerf.scala — per-model synthetic benchmark binaries
+(SURVEY.md §5.1). CLI:
+
+    python -m bigdl_tpu.models.perf --model resnet50 -b 64 -i 20
+    python -m bigdl_tpu.models.perf --model lenet --mesh data=8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Optional
+
+import numpy as np
+
+
+def _build_model(name: str, class_num: int):
+    from bigdl_tpu.models import alexnet, inception, lenet, resnet, vgg
+
+    name = name.lower()
+    table = {
+        "lenet": (lambda: lenet.build(10), (28, 28, 1), 10),
+        "resnet50": (lambda: resnet.build_imagenet(50, class_num), (224, 224, 3), class_num),
+        "resnet18": (lambda: resnet.build_imagenet(18, class_num), (224, 224, 3), class_num),
+        "resnet20-cifar": (lambda: resnet.build_cifar(20, 10), (32, 32, 3), 10),
+        "inception-v1": (lambda: inception.build(class_num), (224, 224, 3), class_num),
+        "vgg16": (lambda: vgg.build(16, class_num), (224, 224, 3), class_num),
+        "alexnet": (lambda: alexnet.build(class_num), (224, 224, 3), class_num),
+    }
+    if name not in table:
+        raise SystemExit(f"unknown model {name!r}; choices: {sorted(table)}")
+    build, shape, classes = table[name]
+    return build(), shape, classes
+
+
+def run_perf(model_name: str = "resnet50", batch_size: int = 32,
+             iterations: int = 10, mesh_axes: Optional[str] = None,
+             optimizer: str = "sgd", class_num: int = 1000) -> dict:
+    """Steady-state throughput of the jitted train step: one warmup step
+    (compile), then `iterations` timed steps fenced with
+    block_until_ready (the jax.profiler-compatible timing discipline —
+    SURVEY.md §5.1)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import Adam, SGD
+
+    model, shape, classes = _build_model(model_name, class_num)
+    variables = model.init(jax.random.PRNGKey(0))
+    method = (SGD(learningrate=0.01, momentum=0.9, dampening=0.0)
+              if optimizer == "sgd" else Adam(1e-3))
+    criterion = nn.ClassNLLCriterion()
+    rng = np.random.RandomState(0)
+    bx_np = rng.rand(batch_size, *shape).astype(np.float32)
+    by_np = rng.randint(0, classes, batch_size).astype(np.int32)
+
+    if mesh_axes:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from bigdl_tpu.parallel import (
+            FlatParamSpec, make_dp_train_step, make_mesh,
+        )
+
+        axes = {k: int(v) for k, v in
+                (p.split("=") for p in mesh_axes.split(","))}
+        mesh = make_mesh(axes)
+        n = mesh.shape["data"]
+        spec = FlatParamSpec(variables["params"], n)
+        step = make_dp_train_step(model, criterion, method, mesh, spec)
+        repl = NamedSharding(mesh, P())
+        w = jax.device_put(spec.flatten(variables["params"]), repl)
+        slots = jax.tree_util.tree_map(
+            lambda s: jax.device_put(s, NamedSharding(mesh, P("data"))),
+            method.init_slots(jnp.zeros((spec.padded,), jnp.float32)))
+        state = jax.device_put(variables["state"], repl)
+        bx = jax.device_put(bx_np, NamedSharding(
+            mesh, P("data", *([None] * len(shape)))))
+        by = jax.device_put(by_np, NamedSharding(mesh, P("data")))
+        args = lambda i: (w, slots, state, bx, by,
+                          jnp.asarray(0.01, jnp.float32),
+                          jnp.asarray(i, jnp.int32), jax.random.PRNGKey(0))
+
+        def run_one(i):
+            nonlocal w, slots, state
+            w, slots, state, loss = step(*args(i))
+            return loss
+    else:
+        slots = method.init_slots(variables["params"])
+        params, state = variables["params"], variables["state"]
+        bx, by = jnp.asarray(bx_np), jnp.asarray(by_np)
+
+        @jax.jit
+        def step(params, state, slots, i):
+            def loss_fn(p):
+                out, new_state = model.apply({"params": p, "state": state},
+                                             bx, training=True)
+                return criterion(out, by), new_state
+
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_slots = method.update(
+                grads, params, slots, jnp.asarray(0.01), i)
+            return new_params, new_state, new_slots, loss
+
+        def run_one(i):
+            nonlocal params, state, slots
+            params, state, slots, loss = step(params, state, slots,
+                                              jnp.asarray(i, jnp.int32))
+            return loss
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(run_one(0))  # warmup + compile
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    loss = None
+    for i in range(1, iterations + 1):
+        loss = run_one(i)
+    jax.block_until_ready(loss)
+    steady = time.perf_counter() - t0
+
+    return {
+        "model": model_name,
+        "batch_size": batch_size,
+        "iterations": iterations,
+        "compile_s": round(compile_s, 3),
+        "steady_wall_s": round(steady, 3),
+        "images_per_sec": round(iterations * batch_size / steady, 2),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("-b", "--batch-size", type=int, default=32)
+    ap.add_argument("-i", "--iterations", type=int, default=10)
+    ap.add_argument("--mesh", default=None,
+                    help="e.g. data=8 to benchmark the DP path")
+    ap.add_argument("--optimizer", default="sgd", choices=["sgd", "adam"])
+    ap.add_argument("--class-num", type=int, default=1000)
+    args = ap.parse_args(argv)
+    result = run_perf(args.model, args.batch_size, args.iterations,
+                      args.mesh, args.optimizer, args.class_num)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

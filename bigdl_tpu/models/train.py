@@ -1,0 +1,115 @@
+"""Unified training CLI for the model zoo.
+
+Reference parity: the per-model `Train.scala`/`Test.scala`/`Utils.scala`
+scopt CLIs (models/lenet/Train.scala, models/resnet/Train.scala, ...).
+One CLI covers the zoo; flags mirror the reference's option names
+(-f dataFolder, -b batchSize, --learningRate, --maxEpoch, --checkpoint).
+
+    python -m bigdl_tpu.models.train --model lenet -f /data/mnist -b 128 \
+        --maxEpoch 5 --checkpoint /tmp/ck --mesh data=8
+    python -m bigdl_tpu.models.train --model resnet20-cifar -f /data/cifar \
+        --synthetic  # no dataset on disk: synthetic stand-in
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="lenet",
+                    help="lenet | resnet20-cifar | resnet50 | resnet18 | "
+                         "inception-v1 | vgg16 | alexnet")
+    ap.add_argument("-f", "--dataFolder", default=None)
+    ap.add_argument("-b", "--batchSize", type=int, default=128)
+    ap.add_argument("--learningRate", type=float, default=0.01)
+    ap.add_argument("--maxEpoch", type=int, default=5)
+    ap.add_argument("--optimizer", default="sgd", choices=["sgd", "adam"])
+    ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--weightDecay", type=float, default=0.0)
+    ap.add_argument("--checkpoint", default=None)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--summary", default=None, help="TensorBoard log dir")
+    ap.add_argument("--mesh", default=None, help="e.g. data=8")
+    ap.add_argument("--synthetic", action="store_true",
+                    help="use synthetic data (no dataset folder needed)")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+
+    import jax
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.optim import (
+        Adam, Optimizer, SGD, Top1Accuracy, Trigger,
+    )
+    from bigdl_tpu.visualization import TrainSummary, ValidationSummary
+
+    # ---- data + model
+    if args.model == "lenet":
+        from bigdl_tpu.dataset.mnist import load_mnist, synthetic_mnist
+        from bigdl_tpu.models import lenet
+
+        model = lenet.build(10)
+        if args.synthetic or not args.dataFolder:
+            train, val = synthetic_mnist(4096), synthetic_mnist(512, seed=9)
+        else:
+            train = load_mnist(args.dataFolder, train=True)
+            val = load_mnist(args.dataFolder, train=False)
+    elif args.model == "resnet20-cifar":
+        from bigdl_tpu.dataset.cifar import load_cifar10, synthetic_cifar10
+        from bigdl_tpu.models import resnet
+
+        model = resnet.build_cifar(20, 10)
+        if args.synthetic or not args.dataFolder:
+            train, val = synthetic_cifar10(2048), synthetic_cifar10(256, seed=9)
+        else:
+            train = load_cifar10(args.dataFolder, train=True)
+            val = load_cifar10(args.dataFolder, train=False)
+    else:
+        from bigdl_tpu.models.perf import _build_model
+        import numpy as np
+        from bigdl_tpu.dataset import Sample
+
+        model, shape, classes = _build_model(args.model, 1000)
+        rng = np.random.RandomState(0)
+        train = [Sample(rng.rand(*shape).astype(np.float32),
+                        np.int32(rng.randint(classes)))
+                 for _ in range(args.batchSize * 4)]
+        val = train[:args.batchSize]
+
+    model.build(jax.random.PRNGKey(42))
+
+    method = (SGD(learningrate=args.learningRate, momentum=args.momentum,
+                  dampening=0.0, weightdecay=args.weightDecay)
+              if args.optimizer == "sgd" else Adam(args.learningRate))
+
+    opt = (Optimizer(model, DataSet.array(train), nn.ClassNLLCriterion(),
+                     batch_size=args.batchSize)
+           .set_optim_method(method)
+           .set_end_when(Trigger.max_epoch(args.maxEpoch))
+           .set_validation(Trigger.every_epoch(), DataSet.array(val),
+                           [Top1Accuracy()], args.batchSize))
+    if args.checkpoint:
+        opt.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+        if args.resume:
+            opt.resume_from_checkpoint()
+    if args.summary:
+        opt.set_train_summary(TrainSummary(args.summary, args.model))
+        opt.set_validation_summary(ValidationSummary(args.summary, args.model))
+    if args.mesh:
+        from bigdl_tpu.parallel import make_mesh
+
+        axes = {k: int(v) for k, v in
+                (p.split("=") for p in args.mesh.split(","))}
+        opt.set_mesh(make_mesh(axes))
+
+    opt.optimize()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,188 @@
+"""TreeLSTM for sentiment over constituency trees.
+
+Reference parity: the reference's BinaryTreeLSTM (example/treeLSTM /
+nn/BinaryTreeLSTM.scala): child-sum/binary tree LSTM over SST-style
+binary parse trees, per-node sentiment classification, evaluated with
+TreeNNAccuracy on the root.
+
+TPU-first redesign (SURVEY.md §7 "hard parts"): the reference recurses
+per-sample over dynamic tree topologies — impossible under jit. Trees are
+LINEARIZED to fixed-length post-order schedules:
+
+    for each node slot t in post-order:
+        h_t = leaf_cell(x_t)                     if leaf
+        h_t = compose(h[left_t], h[right_t])     if internal
+        (masked select; padded slots are no-ops)
+
+and the whole schedule runs as ONE `lax.scan` over node slots with
+`dynamic_index` gathers into the node-state buffer — static shapes,
+batched across trees, MXU-friendly fused gate matmuls.
+
+Tree encoding per sample (all int32 arrays of length `max_nodes`):
+    word    — token id for leaves, 0 for internal/pad
+    left    — post-order index of left child (internal), -1 otherwise
+    right   — likewise for the right child
+    is_leaf — 1/0/;  mask — 1 for real nodes, 0 for padding
+Root is the LAST real node in post-order.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.module import Module
+
+
+class BinaryTreeLSTM(Module):
+    """(reference: nn/BinaryTreeLSTM.scala — binary composer variant)"""
+
+    def __init__(self, vocab_size: int, embed_dim: int, hidden_size: int,
+                 class_num: int, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.vocab_size = vocab_size
+        self.embed_dim = embed_dim
+        self.hidden_size = hidden_size
+        self.class_num = class_num
+
+    def init_params(self, rng):
+        ks = jax.random.split(rng, 4)
+        h, d = self.hidden_size, self.embed_dim
+        lim_e = 0.5
+
+        def dense(k, i, o):
+            lim = (6.0 / (i + o)) ** 0.5  # Xavier, the reference default
+            return {"weight": jax.random.uniform(k, (i, o), minval=-lim, maxval=lim),
+                    "bias": jnp.zeros((o,))}
+
+        return {
+            "embedding": jax.random.uniform(ks[0], (self.vocab_size, d),
+                                            minval=-lim_e, maxval=lim_e),
+            # leaf: x -> (i, o, u) gates (no forget at leaves)
+            "leaf": dense(ks[1], d, 3 * h),
+            # composer: [h_l, h_r] -> (i, fl, fr, o, u)
+            "compose": dense(ks[2], 2 * h, 5 * h),
+            "cls": dense(ks[3], h, self.class_num),
+        }
+
+    def _leaf_step(self, p, x_emb):
+        z = x_emb @ p["leaf"]["weight"] + p["leaf"]["bias"]
+        i, o, u = jnp.split(z, 3, axis=-1)
+        c = jax.nn.sigmoid(i) * jnp.tanh(u)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return h, c
+
+    def _compose_step(self, p, hl, cl, hr, cr):
+        z = jnp.concatenate([hl, hr], -1) @ p["compose"]["weight"] \
+            + p["compose"]["bias"]
+        i, fl, fr, o, u = jnp.split(z, 5, axis=-1)
+        c = (jax.nn.sigmoid(fl) * cl + jax.nn.sigmoid(fr) * cr
+             + jax.nn.sigmoid(i) * jnp.tanh(u))
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return h, c
+
+    def apply(self, variables, inputs, training=False, rng=None):
+        """inputs: dict/Table with word (N,T), left (N,T), right (N,T),
+        is_leaf (N,T), mask (N,T) — or the same five arrays as a tuple in
+        that order. Returns per-node log-probs (N, T, C) in ROOT-FIRST
+        order: node 0 is the tree root (TreeNNAccuracy's convention),
+        node t is the t-th node of REVERSED post-order; padding at the
+        end. Targets must use the same order (see roots_first)."""
+        p = variables["params"]
+        if isinstance(inputs, dict):
+            word = inputs["word"]
+            left = inputs["left"]
+            right = inputs["right"]
+            is_leaf = inputs["is_leaf"]
+            mask = inputs["mask"]
+        else:
+            word, left, right, is_leaf, mask = inputs
+        n_batch, t_nodes = word.shape
+        h_dim = self.hidden_size
+
+        emb = jnp.take(p["embedding"], word.astype(jnp.int32), axis=0)
+        batch_idx = jnp.arange(n_batch)
+
+        def body(carry, t):
+            h_buf, c_buf = carry  # (N, T, H) node-state buffers
+            x_t = emb[:, t]
+            leaf_h, leaf_c = self._leaf_step(p, x_t)
+            li = jnp.clip(left[:, t], 0, t_nodes - 1).astype(jnp.int32)
+            ri = jnp.clip(right[:, t], 0, t_nodes - 1).astype(jnp.int32)
+            hl, cl = h_buf[batch_idx, li], c_buf[batch_idx, li]
+            hr, cr = h_buf[batch_idx, ri], c_buf[batch_idx, ri]
+            comp_h, comp_c = self._compose_step(p, hl, cl, hr, cr)
+            leaf_flag = is_leaf[:, t][:, None].astype(jnp.float32)
+            h_t = leaf_flag * leaf_h + (1 - leaf_flag) * comp_h
+            c_t = leaf_flag * leaf_c + (1 - leaf_flag) * comp_c
+            m = mask[:, t][:, None].astype(jnp.float32)
+            h_t, c_t = h_t * m, c_t * m
+            h_buf = h_buf.at[:, t].set(h_t)
+            c_buf = c_buf.at[:, t].set(c_t)
+            return (h_buf, c_buf), None
+
+        h0 = jnp.zeros((n_batch, t_nodes, h_dim))
+        (h_buf, _), _ = lax.scan(body, (h0, h0), jnp.arange(t_nodes))
+
+        # reorder to root-first (reversed post-order, padding at the end):
+        # node 0 of the output is the root, matching TreeNNAccuracy
+        n_nodes = jnp.sum(mask.astype(jnp.int32), axis=1)  # (N,)
+        t_range = jnp.arange(t_nodes)[None, :]
+        gather_idx = jnp.clip(n_nodes[:, None] - 1 - t_range, 0, t_nodes - 1)
+        h_out = h_buf[batch_idx[:, None], gather_idx]
+        out_mask = (t_range < n_nodes[:, None]).astype(jnp.float32)[..., None]
+        h_out = h_out * out_mask
+
+        logits = h_out @ p["cls"]["weight"] + p["cls"]["bias"]
+        return jax.nn.log_softmax(logits, axis=-1), variables["state"]
+
+
+# ----------------------------------------------------------- tree encoding
+def roots_first(per_node: np.ndarray, n_nodes: int, pad=0) -> np.ndarray:
+    """Reorder a post-order per-node array (e.g. labels) into the
+    root-first order BinaryTreeLSTM emits its outputs in."""
+    out = np.full_like(per_node, pad)
+    out[:n_nodes] = per_node[:n_nodes][::-1]
+    return out
+
+
+def encode_from_nested(tree, max_nodes: int, word2id=None):
+    """Encode a nested-list binary tree, e.g. ((("a", "b"), "c")) where
+    leaves are tokens (str or int). Returns dict of int32 arrays of length
+    max_nodes: word/left/right/is_leaf/mask, plus n_nodes."""
+    word, left, right, is_leaf = [], [], [], []
+
+    def rec(node):
+        if not isinstance(node, (tuple, list)):
+            tok = word2id(node) if word2id else int(node)
+            word.append(tok)
+            left.append(-1)
+            right.append(-1)
+            is_leaf.append(1)
+            return len(word) - 1
+        l_idx = rec(node[0])
+        r_idx = rec(node[1])
+        word.append(0)
+        left.append(l_idx)
+        right.append(r_idx)
+        is_leaf.append(0)
+        return len(word) - 1
+
+    rec(tree)
+    n = len(word)
+    if n > max_nodes:
+        raise ValueError(f"tree has {n} nodes > max_nodes {max_nodes}")
+
+    def pad(a, v=0):
+        return np.asarray(a + [v] * (max_nodes - n), np.int32)
+
+    return {
+        "word": pad(word), "left": pad(left, -1), "right": pad(right, -1),
+        "is_leaf": pad(is_leaf), "mask": pad([1] * n),
+        "n_nodes": n,
+    }

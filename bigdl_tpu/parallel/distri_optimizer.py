@@ -55,8 +55,12 @@ class DistriOptimizer(LocalOptimizer):
         return P(self.axis, *([None] * (x.ndim - 1)))
 
     def _global(self, x):
-        return host_to_global(self.mesh, self._batch_spec(np.asarray(x)),
-                              np.asarray(x))
+        """Place a host batch (array or tuple of arrays for multi-input
+        models) on the mesh, sharded over the data axis."""
+        if isinstance(x, tuple):
+            return tuple(self._global(e) for e in x)
+        arr = np.asarray(x)
+        return host_to_global(self.mesh, self._batch_spec(arr), arr)
 
     def _place_sharded_slots(self, slots):
         shard = NamedSharding(self.mesh, P(self.axis))

@@ -1,0 +1,112 @@
+"""TreeLSTM tests — linearized post-order scan over binary trees
+(reference: nn/BinaryTreeLSTM + example/treeLSTM, TreeNNAccuracy)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.models.treelstm import BinaryTreeLSTM, encode_from_nested
+from bigdl_tpu.optim.validation import TreeNNAccuracy
+
+KEY = jax.random.PRNGKey(0)
+
+
+def batch_trees(trees, max_nodes):
+    encs = [encode_from_nested(t, max_nodes) for t in trees]
+    stack = lambda k: np.stack([e[k] for e in encs])
+    return (stack("word"), stack("left"), stack("right"),
+            stack("is_leaf"), stack("mask")), [e["n_nodes"] for e in encs]
+
+
+class TestEncoding:
+    def test_simple_tree(self):
+        # (1, (2, 3)): post-order = 1, 2, 3, (2,3), (1, .)
+        enc = encode_from_nested((1, (2, 3)), max_nodes=8)
+        assert enc["n_nodes"] == 5
+        np.testing.assert_array_equal(enc["word"][:5], [1, 2, 3, 0, 0])
+        np.testing.assert_array_equal(enc["is_leaf"][:5], [1, 1, 1, 0, 0])
+        assert enc["left"][3] == 1 and enc["right"][3] == 2
+        assert enc["left"][4] == 0 and enc["right"][4] == 3
+
+    def test_too_big_raises(self):
+        with pytest.raises(ValueError, match="max_nodes"):
+            encode_from_nested((1, (2, (3, 4))), max_nodes=3)
+
+
+class TestBinaryTreeLSTM:
+    def test_forward_shapes(self):
+        m = BinaryTreeLSTM(vocab_size=20, embed_dim=8, hidden_size=8,
+                           class_num=3).build(KEY).evaluate()
+        inputs, _ = batch_trees([(1, (2, 3)), ((4, 5), 6)], max_nodes=8)
+        out = m.forward(tuple(jnp.asarray(a) for a in inputs))
+        assert out.shape == (2, 8, 3)
+        np.testing.assert_allclose(np.exp(np.asarray(out)).sum(-1), 1.0,
+                                   rtol=1e-5)
+
+    def test_composition_uses_children(self):
+        """Swapping leaves must change the root representation.
+        Output is root-first: node 0 IS the root."""
+        m = BinaryTreeLSTM(20, 8, 8, 3).build(KEY).evaluate()
+        (w, l, r, lf, mk), nn_ = batch_trees([(1, 2), (2, 1)], max_nodes=4)
+        out = np.asarray(m.forward((jnp.asarray(w), jnp.asarray(l),
+                                    jnp.asarray(r), jnp.asarray(lf),
+                                    jnp.asarray(mk))))
+        assert not np.allclose(out[0, 0], out[1, 0], atol=1e-6)
+
+    def test_dict_input_matches_tuple(self):
+        m = BinaryTreeLSTM(20, 8, 8, 3).build(KEY).evaluate()
+        (w, l, r, lf, mk), _ = batch_trees([(1, (2, 3))], max_nodes=8)
+        arrays = tuple(jnp.asarray(a) for a in (w, l, r, lf, mk))
+        out_tuple = np.asarray(m.forward(arrays))
+        out_dict = np.asarray(m.forward({
+            "word": arrays[0], "left": arrays[1], "right": arrays[2],
+            "is_leaf": arrays[3], "mask": arrays[4]}))
+        np.testing.assert_allclose(out_tuple, out_dict, rtol=1e-6)
+
+    def test_learns_toy_sentiment(self):
+        """Root label = which of tokens {1,2} appears — learnable."""
+        m = BinaryTreeLSTM(10, 16, 16, 2).build(KEY)
+        trees = [((1, 3), (3, 3)), ((3, 2), (3, 3)),
+                 ((3, 3), (1, 3)), ((3, 3), (3, 2)),
+                 ((1, 1), (3, 3)), ((3, 3), (2, 2))]
+        labels_root = [0, 1, 0, 1, 0, 1]
+        (w, l, r, lf, mk), n_nodes = batch_trees(trees, max_nodes=8)
+        inputs = tuple(jnp.asarray(a) for a in (w, l, r, lf, mk))
+        y = jnp.asarray(labels_root)
+
+        variables = m.variables
+
+        def loss_fn(params):
+            out, _ = m.apply({"params": params, "state": {}}, inputs,
+                             training=True)
+            root_logp = out[:, 0]  # root-first output convention
+            return -jnp.mean(jnp.take_along_axis(root_logp, y[:, None], 1))
+
+        step = jax.jit(jax.value_and_grad(loss_fn))
+        params = variables["params"]
+        for i in range(300):
+            loss, g = step(params)
+            params = jax.tree_util.tree_map(lambda p, gr: p - 0.2 * gr,
+                                            params, g)
+        assert float(loss) < 0.1, f"TreeLSTM failed to fit toy data: {loss}"
+
+    def test_treenn_accuracy_on_root(self):
+        out = jnp.asarray([[[0.9, 0.1], [0.2, 0.8]]])  # root = node 0 conv
+        tgt = jnp.asarray([[0, 1]])
+        r = TreeNNAccuracy().apply(out, tgt)
+        assert r.result()[0] == 1.0
+
+    def test_grad_flows_through_tree(self):
+        m = BinaryTreeLSTM(10, 8, 8, 2)
+        variables = m.init(KEY)
+        inputs, _ = batch_trees([((1, 2), (3, 4))], max_nodes=8)
+        inputs = tuple(jnp.asarray(a) for a in inputs)
+
+        def loss(params):
+            out, _ = m.apply({"params": params, "state": {}}, inputs)
+            return jnp.sum(out)
+
+        g = jax.grad(loss)(variables["params"])
+        assert float(jnp.abs(g["compose"]["weight"]).sum()) > 0
+        assert float(jnp.abs(g["embedding"]).sum()) > 0
