@@ -60,6 +60,7 @@ class DLEstimator:
         self.batch_size = 32
         self.max_epoch = 10
         self.optim_method: OptimMethod = SGD(learningrate=1e-2)
+        self._learning_rate: Optional[float] = None
         self.mesh = None
         self.end_trigger: Optional[Trigger] = None
 
@@ -73,6 +74,9 @@ class DLEstimator:
         return self
 
     def set_learning_rate(self, v: float) -> "DLEstimator":
+        # stored and applied at fit() time, so the call order relative to
+        # set_optim_method doesn't matter
+        self._learning_rate = v
         self.optim_method.learningrate = v
         return self
 
@@ -98,6 +102,8 @@ class DLEstimator:
         return np.asarray(label, np.float32).reshape(self.label_size)
 
     def fit(self, df) -> "DLModel":
+        if self._learning_rate is not None:
+            self.optim_method.learningrate = self._learning_rate
         feats = _get_column(df, self.features_col)
         labels = _get_column(df, self.label_col)
         samples = [self._make_sample(f, l) for f, l in zip(feats, labels)]

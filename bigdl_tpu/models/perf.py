@@ -63,11 +63,15 @@ def run_perf(model_name: str = "resnet50", batch_size: int = 32,
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from bigdl_tpu.parallel import (
-            FlatParamSpec, make_dp_train_step, make_mesh,
+            FlatParamSpec, make_dp_train_step, make_mesh, parse_axes,
         )
 
-        axes = {k: int(v) for k, v in
-                (p.split("=") for p in mesh_axes.split(","))}
+        axes = parse_axes(mesh_axes)
+        if "data" not in axes:
+            raise SystemExit(
+                f"--mesh {mesh_axes!r} has no 'data' axis; the perf "
+                "harness benchmarks data-parallel training (e.g. "
+                "--mesh data=8)")
         mesh = make_mesh(axes)
         n = mesh.shape["data"]
         spec = FlatParamSpec(variables["params"], n)

@@ -75,6 +75,11 @@ def main(argv=None):
         import numpy as np
         from bigdl_tpu.dataset import Sample
 
+        if args.dataFolder:
+            raise SystemExit(
+                f"--dataFolder is not supported for model {args.model!r} "
+                "(only lenet / resnet20-cifar have dataset loaders); drop "
+                "-f to train on synthetic data")
         model, shape, classes = _build_model(args.model, 1000)
         rng = np.random.RandomState(0)
         train = [Sample(rng.rand(*shape).astype(np.float32),
@@ -102,11 +107,9 @@ def main(argv=None):
         opt.set_train_summary(TrainSummary(args.summary, args.model))
         opt.set_validation_summary(ValidationSummary(args.summary, args.model))
     if args.mesh:
-        from bigdl_tpu.parallel import make_mesh
+        from bigdl_tpu.parallel import make_mesh, parse_axes
 
-        axes = {k: int(v) for k, v in
-                (p.split("=") for p in args.mesh.split(","))}
-        opt.set_mesh(make_mesh(axes))
+        opt.set_mesh(make_mesh(parse_axes(args.mesh)))
 
     opt.optimize()
 

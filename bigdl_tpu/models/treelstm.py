@@ -138,7 +138,11 @@ class BinaryTreeLSTM(Module):
         out_mask = (t_range < n_nodes[:, None]).astype(jnp.float32)[..., None]
         h_out = h_out * out_mask
 
-        logits = h_out @ p["cls"]["weight"] + p["cls"]["bias"]
+        # mask logits too: padded slots otherwise emit log_softmax(bias)
+        # and (with labels padded to class 0) would push the classifier
+        # bias toward class 0 on every padding slot. Masked logits give a
+        # constant uniform distribution with ZERO gradient to the params.
+        logits = (h_out @ p["cls"]["weight"] + p["cls"]["bias"]) * out_mask
         return jax.nn.log_softmax(logits, axis=-1), variables["state"]
 
 

@@ -44,6 +44,7 @@ from bigdl_tpu.nn.recurrent import (
     Cell, RnnCell, LSTM, LSTMPeephole, GRU, Recurrent, BiRecurrent,
     TimeDistributed,
 )
+from bigdl_tpu.nn.attention import MultiHeadAttention
 from bigdl_tpu.nn.criterion import (
     ClassNLLCriterion, CrossEntropyCriterion, MSECriterion, AbsCriterion,
     BCECriterion, SmoothL1Criterion, MarginCriterion, MultiLabelMarginCriterion,

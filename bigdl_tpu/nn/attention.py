@@ -1,0 +1,138 @@
+"""Attention layers.
+
+No reference counterpart: the reference's sequence stack tops out at
+BiRecurrent/LSTM (SURVEY.md §5.7 — "no ring attention, no
+context/sequence parallel ... nothing to port"). Attention is this
+framework's TPU-first extension of that subsystem: MultiHeadAttention
+rides the Pallas flash kernel (bigdl_tpu/ops/flash_attention.py) on TPU
+and composes with the sequence-parallel plane
+(bigdl_tpu/parallel/ring_attention.py) for long contexts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.initialization import Xavier
+from bigdl_tpu.nn.module import Module
+
+
+class MultiHeadAttention(Module):
+    """Multi-head (self- or cross-) attention over (B, S, E) inputs.
+
+    apply(variables, x)            → self-attention
+    apply(variables, [q_in, kv_in]) → cross-attention (kv_in keys/values)
+
+    `impl` selects the attention math: None → auto (Pallas flash on TPU,
+    jnp reference elsewhere); see bigdl_tpu.ops.flash_attention.
+    Attention-probability dropout only exists on the reference impl (the
+    flash kernel never materializes probabilities); output-projection
+    dropout works everywhere.
+    """
+
+    def __init__(
+        self,
+        embed_dim: int,
+        num_heads: int,
+        head_dim: Optional[int] = None,
+        causal: bool = False,
+        attn_dropout: float = 0.0,
+        out_dropout: float = 0.0,
+        with_bias: bool = True,
+        impl: Optional[str] = None,
+        name: Optional[str] = None,
+    ):
+        super().__init__(name=name)
+        if head_dim is None:
+            if embed_dim % num_heads:
+                raise ValueError(
+                    f"embed_dim {embed_dim} not divisible by num_heads "
+                    f"{num_heads}; pass head_dim explicitly")
+            head_dim = embed_dim // num_heads
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        self.causal = causal
+        self.attn_dropout = attn_dropout
+        self.out_dropout = out_dropout
+        self.with_bias = with_bias
+        self.impl = impl
+
+    def init_params(self, rng):
+        e, h, d = self.embed_dim, self.num_heads, self.head_dim
+        ks = jax.random.split(rng, 4)
+        init = Xavier()
+        p = {
+            "wq": init(ks[0], (e, h * d), fan_in=e, fan_out=h * d),
+            "wk": init(ks[1], (e, h * d), fan_in=e, fan_out=h * d),
+            "wv": init(ks[2], (e, h * d), fan_in=e, fan_out=h * d),
+            "wo": init(ks[3], (h * d, e), fan_in=h * d, fan_out=e),
+        }
+        if self.with_bias:
+            p.update(
+                bq=jnp.zeros((h * d,), jnp.float32),
+                bk=jnp.zeros((h * d,), jnp.float32),
+                bv=jnp.zeros((h * d,), jnp.float32),
+                bo=jnp.zeros((e,), jnp.float32),
+            )
+        return p
+
+    def _proj(self, x, w, b):
+        y = x @ w
+        if b is not None:
+            y = y + b
+        batch, seq = y.shape[0], y.shape[1]
+        return y.reshape(batch, seq, self.num_heads,
+                         self.head_dim).transpose(0, 2, 1, 3)
+
+    def apply(self, variables, input, training=False, rng=None):
+        from bigdl_tpu.ops.flash_attention import flash_attention
+
+        p = variables["params"]
+        if isinstance(input, (list, tuple)):
+            x_q, x_kv = input[0], input[1]
+        else:
+            x_q = x_kv = input
+        b = (lambda k: p[k]) if self.with_bias else (lambda k: None)
+        q = self._proj(x_q, p["wq"], b("bq"))       # (B, H, Sq, D)
+        k = self._proj(x_kv, p["wk"], b("bk"))
+        v = self._proj(x_kv, p["wv"], b("bv"))
+
+        use_attn_drop = (training and self.attn_dropout > 0.0)
+        if use_attn_drop:
+            if rng is None:
+                raise ValueError(f"{self.name}: attn_dropout needs rng")
+            rng, arng = jax.random.split(rng)
+            # probability dropout requires materialized probs → reference
+            sm_scale = 1.0 / (self.head_dim ** 0.5)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm_scale
+            if self.causal:
+                sq, sk = s.shape[-2], s.shape[-1]
+                row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+                col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+                s = jnp.where(col <= row + (sk - sq), s, -1e30)
+            probs = jax.nn.softmax(s, axis=-1)
+            keep = 1.0 - self.attn_dropout
+            mask = jax.random.bernoulli(arng, keep, probs.shape)
+            probs = jnp.where(mask, probs, 0.0) / keep
+            out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        else:
+            out = flash_attention(q, k, v, causal=self.causal,
+                                  impl=self.impl)
+
+        batch, _, seq, _ = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(
+            batch, seq, self.num_heads * self.head_dim)
+        y = out @ p["wo"]
+        if self.with_bias:
+            y = y + p["bo"]
+        if training and self.out_dropout > 0.0:
+            if rng is None:
+                raise ValueError(f"{self.name}: out_dropout needs rng")
+            keep = 1.0 - self.out_dropout
+            mask = jax.random.bernoulli(rng, keep, y.shape)
+            y = jnp.where(mask, y, 0.0) / keep
+        return y, variables["state"]

@@ -1,0 +1,20 @@
+"""Custom TPU ops — Pallas (Mosaic) kernels with XLA/jnp fallbacks.
+
+This package is the framework's native-kernel layer: where the reference
+ships hand-written MKL / MKL-DNN primitives behind JNI
+(com.intel.analytics.bigdl.mkl.*, SURVEY.md §2.1), we ship Pallas kernels
+compiled by Mosaic for the TPU's MXU/VPU — with jnp reference
+implementations doubling as CPU fallbacks and numeric oracles.
+"""
+
+from bigdl_tpu.ops.flash_attention import (
+    attention_reference,
+    flash_attention,
+    flash_attention_with_lse,
+)
+
+__all__ = [
+    "attention_reference",
+    "flash_attention",
+    "flash_attention_with_lse",
+]

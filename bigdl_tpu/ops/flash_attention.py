@@ -1,0 +1,341 @@
+"""Flash attention — Pallas (Mosaic) TPU kernel with online softmax.
+
+The reference has no attention at all (SURVEY.md §5.7: sequence handling
+is unrolled-BPTT `nn/Recurrent.scala` only, bounded by one node's memory).
+Long-context attention is this framework's TPU-first extension of that
+subsystem, and the hot op is a real Pallas kernel — the TPU-native
+counterpart of the reference's hand-tuned native MKL-DNN primitives
+(SURVEY.md §2.1 native checklist).
+
+Design
+------
+* Forward: `pl.pallas_call` over a (batch*heads, q_blocks, kv_blocks)
+  grid. kv is the minor grid axis; an f32 VMEM accumulator plus running
+  max / running sum scratch implement the online (streaming) softmax, so
+  HBM traffic is O(S·D) and nothing of size S×S ever materializes. QK^T
+  and P·V both run on the MXU via `dot_general` with f32 accumulation.
+* Backward: blockwise `lax.scan` over KV blocks in plain XLA (recompute
+  from the saved log-sum-exp). Memory O(S·block_k) — long-context safe —
+  while XLA fuses the elementwise chain into the two matmuls per block.
+* The same math is exposed as `attention_reference` (jnp oracle for
+  tests, CPU fallback), and `flash_attention_with_lse` returns the
+  (out, lse) pair that the ring-attention combine consumes
+  (bigdl_tpu/parallel/ring_attention.py).
+
+Numerics: masked logits use a large finite negative (-1e30), not -inf,
+so fully-masked rows produce zeros (not NaN) after normalization — the
+convention the ring combine relies on.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+# --------------------------------------------------------------------------
+# jnp oracle / CPU fallback
+# --------------------------------------------------------------------------
+
+def attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    return_lse: bool = False,
+):
+    """Plain softmax attention. q,k,v: (..., S, D); returns (..., S, D).
+
+    Numeric oracle for the Pallas kernel and the non-TPU fallback.
+    Materializes S×S — fine for tests and short sequences.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        q_len, k_len = s.shape[-2], s.shape[-1]
+        row = lax.broadcasted_iota(jnp.int32, (q_len, k_len), 0)
+        col = lax.broadcasted_iota(jnp.int32, (q_len, k_len), 1)
+        s = jnp.where(col <= row + (k_len - q_len), s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("...qk,...kd->...qd", (p / l).astype(v.dtype), v)
+    if return_lse:
+        lse = (m + jnp.log(l))[..., 0]
+        return out, lse
+    return out
+
+
+# --------------------------------------------------------------------------
+# Pallas forward kernel
+# --------------------------------------------------------------------------
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+               *, sm_scale, causal, block_q, block_k, seq_k, num_kv):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, D)
+        k = k_ref[0]                                         # (bk, D)
+        s = lax.dot_general(q, k.astype(jnp.float32),
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (bq, bk)
+        col = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = col < seq_k
+        if causal:
+            row = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = mask & (col <= row)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]                                # (bq, 1)
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                               # (bq, bk)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+
+        acc = acc_scr[:] * alpha + lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bq, D)
+
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        acc_scr[:] = acc
+
+    if causal:
+        # blocks strictly above the diagonal contribute nothing — skip
+        @pl.when(k_start <= q_start + block_q - 1)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        lse = m_scr[:, :1] + jnp.log(safe_l)
+        lse = jnp.where(l == 0.0, _NEG_INF, lse)             # (bq, 1)
+        lse_ref[0, :] = lse[:, 0]
+
+
+def _pad_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
+    pad = (-x.shape[axis]) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k,
+                      interpret):
+    """q,k,v: (BH, S, D) → (out (BH, S, D), lse (BH, S))."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, seq_q, dim = q.shape
+    seq_k = k.shape[1]
+
+    qp = _pad_to(_pad_to(q, 1, block_q), 2, 128)
+    kp = _pad_to(_pad_to(k, 1, block_k), 2, 128)
+    vp = _pad_to(_pad_to(v, 1, block_k), 2, 128)
+    sq, dp = qp.shape[1], qp.shape[2]
+    sk = kp.shape[1]
+    num_q, num_kv = sq // block_q, sk // block_k
+
+    kernel = functools.partial(
+        _fa_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_k=seq_k, num_kv=num_kv)
+
+    out_p, lse_p = pl.pallas_call(
+        kernel,
+        grid=(bh, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, dp), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, dp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out_p[:, :seq_q, :dim], lse_p[:, :seq_q]
+
+
+# --------------------------------------------------------------------------
+# Blockwise XLA backward (recompute from lse)
+# --------------------------------------------------------------------------
+
+def _flash_bwd_blockwise(q, k, v, o, lse, do, causal, sm_scale, block_k):
+    """Flash backward via lax.scan over KV blocks; memory O(S·block_k)."""
+    bh, seq_q, dim = q.shape
+    seq_k = k.shape[1]
+    pad_k = (-seq_k) % block_k
+    kp = _pad_to(k, 1, block_k)
+    vp = _pad_to(v, 1, block_k)
+    sk = kp.shape[1]
+    num_kv = sk // block_k
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                  # (BH, Sq)
+    k_blocks = kp.reshape(bh, num_kv, block_k, dim).transpose(1, 0, 2, 3)
+    v_blocks = vp.reshape(bh, num_kv, block_k, dim).transpose(1, 0, 2, 3)
+
+    q32, do32 = q.astype(jnp.float32), do.astype(jnp.float32)
+
+    def step(dq_acc, blk):
+        j, kb, vb = blk                                       # (BH, bk, D)
+        s = jnp.einsum("bqd,bkd->bqk", q32,
+                       kb.astype(jnp.float32)) * sm_scale
+        col = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (seq_q, block_k), 1)
+        mask = col < seq_k
+        if causal:
+            row = lax.broadcasted_iota(jnp.int32, (seq_q, block_k), 0)
+            mask = mask & (col <= row + (seq_k - seq_q))
+        p = jnp.where(mask[None], jnp.exp(s - lse[..., None]), 0.0)
+        dv = jnp.einsum("bqk,bqd->bkd", p, do32)
+        dp = jnp.einsum("bqd,bkd->bqk", do32, vb.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * sm_scale
+        dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds,
+                                     kb.astype(jnp.float32))
+        dk = jnp.einsum("bqk,bqd->bkd", ds, q32)
+        return dq_acc, (dk, dv)
+
+    dq, (dk_blocks, dv_blocks) = lax.scan(
+        step, jnp.zeros_like(q32),
+        (jnp.arange(num_kv), k_blocks, v_blocks))
+    dk = dk_blocks.transpose(1, 0, 2, 3).reshape(bh, sk, dim)
+    dv = dv_blocks.transpose(1, 0, 2, 3).reshape(bh, sk, dim)
+    if pad_k:
+        dk, dv = dk[:, :seq_k], dv[:, :seq_k]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# --------------------------------------------------------------------------
+# Public entry with custom VJP
+# --------------------------------------------------------------------------
+
+def _forward(q, k, v, causal, sm_scale, block_q, block_k, impl):
+    if impl == "reference":
+        return attention_reference(q, k, v, causal, sm_scale,
+                                   return_lse=True)
+    return _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k,
+                             interpret=(impl == "interpret"))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, causal, sm_scale, block_q, block_k, impl):
+    out, _ = _forward(q, k, v, causal, sm_scale, block_q, block_k, impl)
+    return out
+
+
+def _flash_core_fwd(q, k, v, causal, sm_scale, block_q, block_k, impl):
+    out, lse = _forward(q, k, v, causal, sm_scale, block_q, block_k, impl)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_core_bwd(causal, sm_scale, block_q, block_k, impl, res, do):
+    q, k, v, out, lse = res
+    return _flash_bwd_blockwise(q, k, v, out, lse, do, causal, sm_scale,
+                                block_k)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def _default_impl() -> str:
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:  # pragma: no cover - backend init failure
+        platform = "cpu"
+    return "pallas" if platform == "tpu" else "reference"
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    impl: Optional[str] = None,
+) -> jax.Array:
+    """Memory-efficient attention. q,k,v: (B, H, S, D) or (BH, S, D).
+
+    impl: None → auto ('pallas' on TPU, 'reference' elsewhere);
+    'pallas' | 'interpret' (Pallas interpreter mode, for CPU tests) |
+    'reference'.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    impl = impl or _default_impl()
+    squeeze = q.ndim == 4
+    if squeeze:
+        b, h, s, d = q.shape
+        sk = k.shape[2]
+        q = q.reshape(b * h, s, d)
+        k = k.reshape(b * h, sk, k.shape[-1])
+        v = v.reshape(b * h, sk, v.shape[-1])
+    out = _flash_core(q, k, v, causal, float(sm_scale), block_q, block_k,
+                      impl)
+    if squeeze:
+        out = out.reshape(b, h, s, -1)
+    return out
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    impl: Optional[str] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """(out, lse) for one KV chunk — the ring-attention building block.
+
+    Not wrapped in the custom VJP: ring attention differentiates its own
+    combined result, recomputing per-chunk attention in its backward.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    impl = impl or _default_impl()
+    return _forward(q, k, v, causal, float(sm_scale), block_q, block_k,
+                    impl)

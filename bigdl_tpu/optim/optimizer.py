@@ -324,5 +324,8 @@ class LocalOptimizer:
                                           ("epoch", "neval", "records")})
                 logger.info("checkpoint -> %s", path)
 
+        for summary in (o.train_summary, o.validation_summary):
+            if summary is not None:
+                summary.writer.flush()
         o.model.variables = variables
         return o.model

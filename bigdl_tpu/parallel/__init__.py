@@ -1,7 +1,9 @@
 """bigdl_tpu.parallel — mesh, collectives-based parameter plane, and
 parallelism strategies (reference: bigdl/parameters/ + optim/DistriOptimizer)."""
 
-from bigdl_tpu.parallel.mesh import make_mesh, replicated, sharded, host_to_global
+from bigdl_tpu.parallel.mesh import (
+    make_mesh, parse_axes, replicated, sharded, host_to_global,
+)
 from bigdl_tpu.parallel.data_parallel import (
     FlatParamSpec, make_dp_train_step, make_dp_eval_step,
 )

@@ -26,6 +26,23 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def parse_axes(s: str) -> Dict[str, int]:
+    """Parse a CLI mesh string like ``"data=8"`` or ``"data=4,model=2"``."""
+    out: Dict[str, int] = {}
+    for part in s.split(","):
+        name, eq, size = part.partition("=")
+        name = name.strip()
+        if not eq or not name:
+            raise ValueError(
+                f"bad mesh spec {part!r} in {s!r}; expected name=size")
+        try:
+            out[name] = int(size)
+        except ValueError:
+            raise ValueError(
+                f"bad mesh size {size!r} for axis {name!r} in {s!r}")
+    return out
+
+
 def make_mesh(axes: Optional[Dict[str, int]] = None,
               devices: Optional[Sequence] = None) -> Mesh:
     """Build a mesh from {axis_name: size}; sizes must multiply to the

@@ -172,12 +172,18 @@ def read_events(path: str):
         while True:
             header = f.read(8)
             if len(header) < 8:
-                break
+                break  # truncated tail (writer mid-record) — stop cleanly
             (length,) = struct.unpack("<Q", header)
-            (hcrc,) = struct.unpack("<I", f.read(4))
+            hcrc_bytes = f.read(4)
+            if len(hcrc_bytes) < 4:
+                break
+            (hcrc,) = struct.unpack("<I", hcrc_bytes)
             assert hcrc == masked_crc32c(header), "header crc mismatch"
             data = f.read(length)
-            (dcrc,) = struct.unpack("<I", f.read(4))
+            dcrc_bytes = f.read(4)
+            if len(data) < length or len(dcrc_bytes) < 4:
+                break
+            (dcrc,) = struct.unpack("<I", dcrc_bytes)
             assert dcrc == masked_crc32c(data), "data crc mismatch"
             out.append(_parse_event(data))
     return [e for e in out if e is not None]
