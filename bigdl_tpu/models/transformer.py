@@ -1,0 +1,249 @@
+"""Decoder-only Transformer language model — the long-context flagship.
+
+The reference's language-model family tops out at LSTM BPTT
+(models/rnn/, SURVEY.md §2.5); this model is the TPU-first successor in
+the same zoo slot, designed so every parallelism axis maps onto the mesh:
+
+* **Stacked-parameter layers under `lax.scan`** — all L blocks share one
+  pytree with a leading (L, ...) layer axis. One trace compiles once no
+  matter the depth (XLA-friendly), tensor-parallel sharding is a single
+  PartitionSpec per stacked leaf, and pipeline stages are contiguous
+  slices of the layer axis (bigdl_tpu/parallel/pipeline.py).
+* **Flash attention** on the hot path (bigdl_tpu/ops/flash_attention.py,
+  Pallas on TPU), or **ring attention** over a mesh `seq` axis when
+  `sp_axis` is set and apply() runs inside shard_map
+  (bigdl_tpu/parallel/ring_attention.py).
+* Pre-LayerNorm residual blocks, GELU MLP, learned positional embedding,
+  weight-tied output head — standard GPT-2-style architecture.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_identity(x, axis):
+    """Megatron's conjugate "f" operator: identity forward, psum backward.
+
+    Placed where a replicated activation enters column-parallel compute,
+    so its cotangent (which each TP shard holds only a partial of) is
+    summed over the TP axis before reaching upstream replicated params —
+    their grads then come out full and identical on every shard, needing
+    no per-leaf corrections. The row-parallel psum in the forward is the
+    conjugate "g" (psum forward; its transpose is already identity)."""
+    return x
+
+
+def _tpid_fwd(x, axis):
+    return x, None
+
+
+def _tpid_bwd(axis, _, ct):
+    return (lax.psum(ct, axis),)
+
+
+tp_identity.defvjp(_tpid_fwd, _tpid_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_reduce(x, axis):
+    """Megatron's conjugate "g" operator: psum forward, identity backward.
+
+    A bare lax.psum would not do: inside shard_map without replication
+    tracking its AD transpose is another psum, which multiplies the
+    (identical-per-shard) cotangents by the axis size. The custom VJP
+    pins the backward to identity, which is the correct transpose here
+    because the summed activation is replicated — each shard already
+    holds the full cotangent."""
+    return lax.psum(x, axis)
+
+
+def _tpred_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _tpred_bwd(axis, _, ct):
+    return (ct,)
+
+
+tp_reduce.defvjp(_tpred_fwd, _tpred_bwd)
+
+
+@dataclass
+class TransformerConfig:
+    vocab_size: int = 256
+    max_len: int = 512
+    dim: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    causal: bool = True
+    tie_embeddings: bool = True
+
+
+class TransformerLM(Module):
+    """apply(variables, tokens (B, S) int32) → log-probs (B, S, V).
+
+    `sp_axis`: if set, attention runs as ring attention over that mesh
+    axis — apply() must then be called inside shard_map with the
+    sequence dimension sharded on `sp_axis` (positional embeddings are
+    offset by the shard's global position automatically).
+    """
+
+    def __init__(self, config: TransformerConfig,
+                 sp_axis: Optional[str] = None,
+                 tp_axis: Optional[str] = None,
+                 attn_impl: Optional[str] = None,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.cfg = config
+        self.sp_axis = sp_axis
+        self.tp_axis = tp_axis
+        self.attn_impl = attn_impl
+        if config.dim % config.num_heads:
+            raise ValueError("dim must be divisible by num_heads")
+        self.head_dim = config.dim // config.num_heads
+
+    # ------------------------------------------------------------ params
+    def init_params(self, rng):
+        c = self.cfg
+        e, f, l = c.dim, c.dim * c.mlp_ratio, c.num_layers
+        keys = iter(jax.random.split(rng, 16))
+
+        def norm(key, shape, fan_in):
+            return jax.random.normal(key, shape, jnp.float32) * (
+                fan_in ** -0.5)
+
+        blocks = {
+            "ln1_g": jnp.ones((l, e)), "ln1_b": jnp.zeros((l, e)),
+            "wq": norm(next(keys), (l, e, e), e),
+            "wk": norm(next(keys), (l, e, e), e),
+            "wv": norm(next(keys), (l, e, e), e),
+            "wo": norm(next(keys), (l, e, e), e),
+            "bq": jnp.zeros((l, e)), "bk": jnp.zeros((l, e)),
+            "bv": jnp.zeros((l, e)), "bo": jnp.zeros((l, e)),
+            "ln2_g": jnp.ones((l, e)), "ln2_b": jnp.zeros((l, e)),
+            "w1": norm(next(keys), (l, e, f), e),
+            "b1": jnp.zeros((l, f)),
+            "w2": norm(next(keys), (l, f, e), f),
+            "b2": jnp.zeros((l, e)),
+        }
+        p = {
+            "embed": jax.random.normal(next(keys),
+                                       (c.vocab_size, e)) * 0.02,
+            "pos": jax.random.normal(next(keys), (c.max_len, e)) * 0.02,
+            "blocks": blocks,
+            "lnf_g": jnp.ones((e,)), "lnf_b": jnp.zeros((e,)),
+        }
+        if not c.tie_embeddings:
+            p["head"] = norm(next(keys), (e, c.vocab_size), e)
+        return p
+
+    # ----------------------------------------------------------- forward
+    @staticmethod
+    def _ln(x, g, b, eps=1e-5):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
+        return (x - mu) * lax.rsqrt(var + eps) * g + b
+
+    def _attention(self, q, k, v):
+        from bigdl_tpu.ops.flash_attention import flash_attention
+        from bigdl_tpu.parallel.ring_attention import ring_attention
+
+        if self.sp_axis is not None:
+            return ring_attention(q, k, v, axis=self.sp_axis,
+                                  causal=self.cfg.causal)
+        return flash_attention(q, k, v, causal=self.cfg.causal,
+                               impl=self.attn_impl)
+
+    def _block(self, x, bp, dropout_rng, training):
+        """One pre-LN block. Works unchanged under tensor parallelism:
+        with `tp_axis` set (inside shard_map), wq/wk/wv/w1 arrive
+        column-sharded and wo/w2 row-sharded, so the local head count is
+        inferred from the weight shape and the two row-parallel matmuls
+        are followed by a psum — the Megatron-style split expressed as
+        per-device code + XLA collectives."""
+        c = self.cfg
+        b, s, e = x.shape
+        d = self.head_dim
+        h_local = bp["wq"].shape[-1] // d     # = num_heads / tp_size
+
+        y = self._ln(x, bp["ln1_g"], bp["ln1_b"])
+        if self.tp_axis is not None:
+            y = tp_identity(y, self.tp_axis)
+        q = (y @ bp["wq"] + bp["bq"]).reshape(b, s, h_local, d).transpose(0, 2, 1, 3)
+        k = (y @ bp["wk"] + bp["bk"]).reshape(b, s, h_local, d).transpose(0, 2, 1, 3)
+        v = (y @ bp["wv"] + bp["bv"]).reshape(b, s, h_local, d).transpose(0, 2, 1, 3)
+        a = self._attention(q, k, v)
+        a = a.transpose(0, 2, 1, 3).reshape(b, s, h_local * d)
+        a = a @ bp["wo"]                      # row-parallel: partial sums
+        if self.tp_axis is not None:
+            a = tp_reduce(a, self.tp_axis)
+        a = a + bp["bo"]
+        if training and c.dropout > 0.0:
+            keep = 1.0 - c.dropout
+            k1, dropout_rng = jax.random.split(dropout_rng)
+            a = jnp.where(jax.random.bernoulli(k1, keep, a.shape),
+                          a, 0.0) / keep
+        x = x + a
+
+        y = self._ln(x, bp["ln2_g"], bp["ln2_b"])
+        if self.tp_axis is not None:
+            y = tp_identity(y, self.tp_axis)
+        y = jax.nn.gelu(y @ bp["w1"] + bp["b1"])
+        y = y @ bp["w2"]                      # row-parallel: partial sums
+        if self.tp_axis is not None:
+            y = tp_reduce(y, self.tp_axis)
+        y = y + bp["b2"]
+        if training and c.dropout > 0.0:
+            keep = 1.0 - c.dropout
+            k2, _ = jax.random.split(dropout_rng)
+            y = jnp.where(jax.random.bernoulli(k2, keep, y.shape),
+                          y, 0.0) / keep
+        return x + y
+
+    def apply(self, variables, tokens, training=False, rng=None):
+        c = self.cfg
+        p = variables["params"]
+        s = tokens.shape[-1]
+
+        if self.sp_axis is not None:
+            pos_off = lax.axis_index(self.sp_axis) * s
+            pos = lax.dynamic_slice_in_dim(p["pos"], pos_off, s, axis=0)
+        else:
+            pos = p["pos"][:s]
+        x = p["embed"][tokens] + pos
+
+        if training and c.dropout > 0.0 and rng is None:
+            raise ValueError(f"{self.name}: dropout needs rng in training")
+        base_rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        def body(x, layer):
+            bp, lrng = layer
+            return self._block(x, bp, lrng, training), None
+
+        layer_rngs = jax.random.split(base_rng, c.num_layers)
+        x, _ = lax.scan(body, x, (p["blocks"], layer_rngs))
+
+        x = self._ln(x, p["lnf_g"], p["lnf_b"])
+        head = p["embed"].T if c.tie_embeddings else p["head"]
+        logits = x @ head
+        return jax.nn.log_softmax(logits, axis=-1), variables["state"]
+
+
+def build_lm(vocab_size: int = 256, dim: int = 128, num_heads: int = 4,
+             num_layers: int = 2, max_len: int = 512,
+             **kw) -> TransformerLM:
+    return TransformerLM(TransformerConfig(
+        vocab_size=vocab_size, dim=dim, num_heads=num_heads,
+        num_layers=num_layers, max_len=max_len), **kw)

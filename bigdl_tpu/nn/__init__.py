@@ -21,6 +21,7 @@ from bigdl_tpu.nn.conv import (
 from bigdl_tpu.nn.pooling import SpatialMaxPooling, SpatialAveragePooling
 from bigdl_tpu.nn.normalization import (
     BatchNormalization, SpatialBatchNormalization, SpatialCrossMapLRN, Normalize,
+    LayerNorm, RMSNorm,
 )
 from bigdl_tpu.nn.activation import (
     ReLU, ReLU6, Tanh, Sigmoid, SoftMax, LogSoftMax, SoftPlus, SoftSign,

@@ -89,7 +89,8 @@ class MultiHeadAttention(Module):
                          self.head_dim).transpose(0, 2, 1, 3)
 
     def apply(self, variables, input, training=False, rng=None):
-        from bigdl_tpu.ops.flash_attention import flash_attention
+        from bigdl_tpu.ops.flash_attention import (
+            attention_reference, flash_attention)
 
         p = variables["params"]
         if isinstance(input, (list, tuple)):
@@ -101,24 +102,14 @@ class MultiHeadAttention(Module):
         k = self._proj(x_kv, p["wk"], b("bk"))
         v = self._proj(x_kv, p["wv"], b("bv"))
 
-        use_attn_drop = (training and self.attn_dropout > 0.0)
-        if use_attn_drop:
+        if training and self.attn_dropout > 0.0:
             if rng is None:
                 raise ValueError(f"{self.name}: attn_dropout needs rng")
             rng, arng = jax.random.split(rng)
             # probability dropout requires materialized probs → reference
-            sm_scale = 1.0 / (self.head_dim ** 0.5)
-            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm_scale
-            if self.causal:
-                sq, sk = s.shape[-2], s.shape[-1]
-                row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
-                col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-                s = jnp.where(col <= row + (sk - sq), s, -1e30)
-            probs = jax.nn.softmax(s, axis=-1)
-            keep = 1.0 - self.attn_dropout
-            mask = jax.random.bernoulli(arng, keep, probs.shape)
-            probs = jnp.where(mask, probs, 0.0) / keep
-            out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+            out = attention_reference(q, k, v, causal=self.causal,
+                                      dropout=self.attn_dropout,
+                                      dropout_rng=arng)
         else:
             out = flash_attention(q, k, v, causal=self.causal,
                                   impl=self.impl)

@@ -128,3 +128,51 @@ class Normalize(Module):
         else:
             norm = jnp.sum(jnp.abs(x) ** self.p, axis=-1, keepdims=True) ** (1.0 / self.p)
         return x / jnp.maximum(norm, self.eps), variables["state"]
+
+
+class LayerNorm(Module):
+    """Layer normalization over the last axis.
+
+    No direct reference counterpart (the reference predates LayerNorm's
+    ubiquity); included as the normalization for the transformer stack
+    (bigdl_tpu/models/transformer.py)."""
+
+    def __init__(self, size: int, eps: float = 1e-5, affine: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.size = size
+        self.eps = eps
+        self.affine = affine
+
+    def init_params(self, rng):
+        if not self.affine:
+            return {}
+        return {"weight": jnp.ones((self.size,), jnp.float32),
+                "bias": jnp.zeros((self.size,), jnp.float32)}
+
+    def apply(self, variables, x, training=False, rng=None):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
+        y = (x - mu) * lax.rsqrt(var + self.eps)
+        if self.affine:
+            p = variables["params"]
+            y = y * p["weight"] + p["bias"]
+        return y, variables["state"]
+
+
+class RMSNorm(Module):
+    """RMS normalization over the last axis (no mean subtraction)."""
+
+    def __init__(self, size: int, eps: float = 1e-6,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.size = size
+        self.eps = eps
+
+    def init_params(self, rng):
+        return {"weight": jnp.ones((self.size,), jnp.float32)}
+
+    def apply(self, variables, x, training=False, rng=None):
+        ms = jnp.mean(x * x, axis=-1, keepdims=True)
+        y = x * lax.rsqrt(ms + self.eps)
+        return y * variables["params"]["weight"], variables["state"]

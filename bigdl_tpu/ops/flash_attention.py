@@ -51,11 +51,15 @@ def attention_reference(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     return_lse: bool = False,
+    dropout: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
 ):
     """Plain softmax attention. q,k,v: (..., S, D); returns (..., S, D).
 
     Numeric oracle for the Pallas kernel and the non-TPU fallback.
-    Materializes S×S — fine for tests and short sequences.
+    Materializes S×S — fine for tests and short sequences. `dropout`
+    applies inverted dropout to the attention probabilities (the one
+    path that needs them materialized; flash never does).
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -68,7 +72,17 @@ def attention_reference(
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    out = jnp.einsum("...qk,...kd->...qd", (p / l).astype(v.dtype), v)
+    probs = p / l
+    # fully-masked rows (possible when causal and seq_q > seq_k) emit
+    # zeros, matching the kernel's convention
+    probs = jnp.where(m > _NEG_INF / 2, probs, 0.0)
+    if dropout > 0.0:
+        if dropout_rng is None:
+            raise ValueError("attention dropout needs dropout_rng")
+        keep = 1.0 - dropout
+        mask = jax.random.bernoulli(dropout_rng, keep, probs.shape)
+        probs = jnp.where(mask, probs, 0.0) / keep
+    out = jnp.einsum("...qk,...kd->...qd", probs.astype(v.dtype), v)
     if return_lse:
         lse = (m + jnp.log(l))[..., 0]
         return out, lse
@@ -80,7 +94,8 @@ def attention_reference(
 # --------------------------------------------------------------------------
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-               *, sm_scale, causal, block_q, block_k, seq_k, num_kv):
+               *, sm_scale, causal, block_q, block_k, seq_q, seq_k,
+               num_kv):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -102,9 +117,11 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         col = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         mask = col < seq_k
         if causal:
+            # bottom-right alignment (query i sees keys ≤ i + seq_k-seq_q),
+            # matching attention_reference and the blockwise backward
             row = q_start + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            mask = mask & (col <= row)
+            mask = mask & (col <= row + (seq_k - seq_q))
         s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_scr[:, :1]                                # (bq, 1)
@@ -125,8 +142,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         acc_scr[:] = acc
 
     if causal:
-        # blocks strictly above the diagonal contribute nothing — skip
-        @pl.when(k_start <= q_start + block_q - 1)
+        # blocks strictly above the (aligned) diagonal contribute nothing
+        @pl.when(k_start <= q_start + block_q - 1 + (seq_k - seq_q))
         def _():
             _compute()
     else:
@@ -168,7 +185,7 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k,
 
     kernel = functools.partial(
         _fa_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-        block_k=block_k, seq_k=seq_k, num_kv=num_kv)
+        block_k=block_k, seq_q=seq_q, seq_k=seq_k, num_kv=num_kv)
 
     out_p, lse_p = pl.pallas_call(
         kernel,

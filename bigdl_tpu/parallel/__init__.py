@@ -8,3 +8,12 @@ from bigdl_tpu.parallel.data_parallel import (
     FlatParamSpec, make_dp_train_step, make_dp_eval_step,
 )
 from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
+from bigdl_tpu.parallel.ring_attention import (
+    make_ring_attention, ring_attention, ulysses_attention,
+)
+from bigdl_tpu.parallel.tensor_parallel import (
+    make_transformer_train_step, shard_params, slot_specs_for,
+    transformer_tp_specs,
+)
+from bigdl_tpu.parallel.pipeline import make_pipeline_train_step, pipeline_specs
+from bigdl_tpu.parallel.moe import MoE, moe_specs

@@ -1,0 +1,146 @@
+"""Mixture-of-Experts with expert parallelism over a mesh axis.
+
+No reference counterpart (SURVEY.md §2.3 lists EP as absent); this is
+the `expert` mesh axis. Switch-style top-1 routing with capacity:
+
+    gates   = softmax(x @ router)                 (T, E)
+    expert  = argmax(gates); position-in-expert via cumsum
+    dispatch = onehot(expert) ∧ (position < capacity)   (T, E, C)
+    expert_in  = dispatchᵀ x                      (E, C, D)
+    --- all_to_all over the expert axis ---       each device receives
+    expert_out = local experts (E_local of them)  every device's tokens
+    --- all_to_all back ---                       for ITS experts
+    y = combine (dispatch · gate) expert_out      (T, D)
+
+The einsum-dispatch formulation keeps everything dense/static for XLA
+(no dynamic shapes — dropped tokens beyond capacity fall out of the
+dispatch mask, the standard Switch trade-off) and the two all_to_alls
+are the only cross-device traffic, riding ICI.
+
+A load-balancing auxiliary loss (mean gate fraction × mean dispatch
+fraction × E, per Switch/GShard) is returned alongside the output.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.initialization import Xavier
+from bigdl_tpu.nn.module import Module
+
+
+class MoE(Module):
+    """Top-1 (Switch) MoE feed-forward layer.
+
+    apply(variables, x (..., T, D)) → ((..., T, D), aux_loss) — the
+    output is a tuple; aux_loss should be added to the training loss
+    scaled by e.g. 0.01.
+
+    With `expert_axis` set, apply() must run inside shard_map on a mesh
+    containing that axis; the expert-stacked params (leading dim
+    num_experts) are then sharded P(expert_axis, ...) and each device
+    holds num_experts/axis_size experts, exchanging tokens via
+    all_to_all.
+    """
+
+    def __init__(self, dim: int, hidden: int, num_experts: int,
+                 capacity_factor: float = 1.25,
+                 expert_axis: Optional[str] = None,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.dim = dim
+        self.hidden = hidden
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.expert_axis = expert_axis
+
+    def init_params(self, rng):
+        e, d, f = self.num_experts, self.dim, self.hidden
+        ks = jax.random.split(rng, 3)
+        init = Xavier()
+        return {
+            "router": init(ks[0], (d, e), fan_in=d, fan_out=e),
+            "w1": init(ks[1], (e, d, f), fan_in=d, fan_out=f),
+            "b1": jnp.zeros((e, f), jnp.float32),
+            "w2": init(ks[2], (e, f, d), fan_in=f, fan_out=d),
+            "b2": jnp.zeros((e, d), jnp.float32),
+        }
+
+    def _route(self, x2, router):
+        """x2: (T, D) → dispatch (T, E, C), combine (T, E, C), aux."""
+        t = x2.shape[0]
+        e = self.num_experts
+        cap = max(1, int(self.capacity_factor * t / e))
+        gates = jax.nn.softmax(x2 @ router, axis=-1)          # (T, E)
+        expert = jnp.argmax(gates, axis=-1)                   # (T,)
+        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # (T, E)
+        # position of each token within its expert's queue
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0       # (T, E)
+        keep = onehot * (pos < cap)                           # (T, E)
+        pos_oh = jax.nn.one_hot(pos.max(axis=-1).astype(jnp.int32), cap,
+                                dtype=jnp.float32)            # (T, C)
+        dispatch = keep[:, :, None] * pos_oh[:, None, :]      # (T, E, C)
+        gate_val = jnp.sum(gates * keep, axis=-1,
+                           keepdims=True)                     # (T, 1)
+        combine = dispatch * gate_val[:, :, None]
+        # Switch load-balancing aux: fraction routed × mean gate, per e
+        frac = jnp.mean(onehot, axis=0)
+        mean_gate = jnp.mean(gates, axis=0)
+        aux = jnp.sum(frac * mean_gate) * e
+        return dispatch, combine, aux, cap
+
+    def _experts(self, p, xin):
+        """xin: (E_local, C_tot, D) → same shape through each expert."""
+        h = jnp.einsum("ecd,edf->ecf", xin, p["w1"]) + p["b1"][:, None, :]
+        h = jax.nn.gelu(h)
+        return jnp.einsum("ecf,efd->ecd", h, p["w2"]) + p["b2"][:, None, :]
+
+    def apply(self, variables, x, training=False, rng=None):
+        p = variables["params"]
+        shape = x.shape
+        x2 = x.reshape(-1, self.dim)
+        dispatch, combine, aux, cap = self._route(x2, p["router"])
+
+        if self.expert_axis is None:
+            xin = jnp.einsum("tec,td->ecd", dispatch, x2)
+            yout = self._experts(p, xin)
+            y = jnp.einsum("tec,ecd->td", combine, yout)
+            return (y.reshape(shape), aux), variables["state"]
+
+        # expert-parallel: params arrive expert-sharded; route globally,
+        # exchange tokens so each device runs only its local experts
+        axis = self.expert_axis
+        n = lax.axis_size(axis)
+        e_local = p["w1"].shape[0]                 # num_experts / n
+        if e_local * n != self.num_experts:
+            raise ValueError(
+                f"num_experts {self.num_experts} != {e_local}·{n}")
+        xin = jnp.einsum("tec,td->ecd", dispatch, x2)   # (E, C, D)
+        # (E, C, D) = (n, e_local, C, D): send slice j to device j
+        xin = xin.reshape(n, e_local, cap, self.dim)
+        xin = lax.all_to_all(xin, axis, split_axis=0, concat_axis=0,
+                             tiled=True)               # (n, e_local, C, D)
+        xin = xin.transpose(1, 0, 2, 3).reshape(
+            e_local, n * cap, self.dim)                # my experts, all toks
+        yout = self._experts(p, xin)                   # (e_local, nC, D)
+        yout = yout.reshape(e_local, n, cap, self.dim).transpose(1, 0, 2, 3)
+        yout = lax.all_to_all(yout, axis, split_axis=0, concat_axis=0,
+                              tiled=True)              # (n, e_local, C, D)
+        yout = yout.reshape(self.num_experts, cap, self.dim)
+        y = jnp.einsum("tec,ecd->td", combine, yout)
+        # aux is computed from the global (replicated) router — identical
+        # on every shard already
+        return (y.reshape(shape), aux), variables["state"]
+
+
+def moe_specs(expert_axis: str = "expert"):
+    """PartitionSpecs for MoE params (experts stacked on the lead dim)."""
+    from jax.sharding import PartitionSpec as P
+
+    return {"router": P(),
+            "w1": P(expert_axis, None, None), "b1": P(expert_axis, None),
+            "w2": P(expert_axis, None, None), "b2": P(expert_axis, None)}

@@ -1,0 +1,157 @@
+"""Pipeline parallelism — GPipe microbatch schedule over a mesh axis.
+
+No reference counterpart (SURVEY.md §2.3: the reference has data
+parallelism only); this is the `pipe` mesh axis. The TransformerLM's
+stacked-layer parameters make stages trivial: stage i owns the
+contiguous layer slice blocks[i·L/n : (i+1)·L/n] — i.e. every stacked
+block leaf is sharded on its LAYER axis with P('pipe', ...). Activations
+hop stage→stage over the ICI ring with `lax.ppermute`.
+
+Schedule: classic GPipe. M microbatches flow through n stages in
+M + n - 1 ticks; stage s processes microbatch t - s at tick t. The
+backward schedule is derived by jax.grad reversing the forward
+(ppermute transposes to the inverse permutation), so warmup/drain
+bubbles match GPipe's 2(n-1) ticks.
+
+Losses exist only on the last stage; they cross to every stage through
+the same psum-forward/identity-backward operator the tensor-parallel
+plane uses (models/transformer.py#tp_reduce). Replicated leaves
+(embed/pos/final LN) are USED on different stages (lookup on stage 0,
+head on stage n-1), so their per-stage grads are partial and get psum'd
+over the pipe axis; layer-sharded leaves' grads are exact locally.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.models.transformer import TransformerLM, tp_reduce
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def pipeline_specs(pipe_axis: str = "pipe", tie_embeddings: bool = True):
+    """PartitionSpecs: stacked block leaves sharded on the layer axis."""
+    def blk(ndim):
+        return P(pipe_axis, *([None] * (ndim - 1)))
+
+    blocks = {
+        "ln1_g": blk(2), "ln1_b": blk(2), "ln2_g": blk(2), "ln2_b": blk(2),
+        "wq": blk(3), "wk": blk(3), "wv": blk(3), "wo": blk(3),
+        "bq": blk(2), "bk": blk(2), "bv": blk(2), "bo": blk(2),
+        "w1": blk(3), "b1": blk(2), "w2": blk(3), "b2": blk(2),
+    }
+    specs = {"embed": P(), "pos": P(), "lnf_g": P(), "lnf_b": P(),
+             "blocks": blocks}
+    if not tie_embeddings:
+        specs["head"] = P()
+    return specs
+
+
+def make_pipeline_train_step(
+    model: TransformerLM,
+    method,
+    mesh: Mesh,
+    pipe_axis: str = "pipe",
+    dp_axis: Optional[str] = None,
+    microbatches: int = 4,
+) -> Callable:
+    """Jitted GPipe training step for TransformerLM over pipe(×data).
+
+    Signature: (params, slots, tokens, targets, lr, stepno, rng)
+             -> (params', slots', mean_loss)
+
+    tokens/targets: (B, S) with B divisible by microbatches (× dp size).
+    The model must have tp_axis=None/sp_axis=None (pipe composes with dp
+    here; TP/SP composition inside a stage is a further extension).
+    """
+    if model.tp_axis is not None or model.sp_axis is not None:
+        raise ValueError("pipeline stage model must not set tp/sp axes")
+    n = mesh.shape[pipe_axis]
+    if model.cfg.num_layers % n:
+        raise ValueError(
+            f"num_layers {model.cfg.num_layers} not divisible by "
+            f"{n} pipeline stages")
+    m_micro = microbatches
+    cfg = model.cfg
+
+    def body(params, slots, tokens, targets, lr, stepno, rng):
+        idx = lax.axis_index(pipe_axis)
+        b, s = tokens.shape
+        mb = b // m_micro
+        toks_mb = tokens.reshape(m_micro, mb, s)
+        tgts_mb = targets.reshape(m_micro, mb, s)
+
+        def loss_fn(p):
+            def embed(tk):
+                return p["embed"][tk] + p["pos"][:s]
+
+            def stage(x):
+                def blk(x, bp):
+                    return model._block(x, bp, jax.random.PRNGKey(0),
+                                        False), None
+                x, _ = lax.scan(blk, x, p["blocks"])
+                return x
+
+            def head_loss(x, tg):
+                x = model._ln(x, p["lnf_g"], p["lnf_b"])
+                head = p["embed"].T if cfg.tie_embeddings else p["head"]
+                logp = jax.nn.log_softmax(x @ head, axis=-1)
+                return jnp.mean(
+                    -jnp.take_along_axis(logp, tg[..., None], -1))
+
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            h = jnp.zeros((mb, s, cfg.dim), jnp.float32)
+            total = jnp.zeros((), jnp.float32)
+            for t in range(m_micro + n - 1):
+                x_in = jnp.where(idx == 0,
+                                 embed(toks_mb[min(t, m_micro - 1)]), h)
+                y = stage(x_in)
+                mb_id = t - idx
+                valid_last = (idx == n - 1) & (mb_id >= 0) & (mb_id < m_micro)
+                tg = lax.dynamic_index_in_dim(
+                    tgts_mb, jnp.clip(mb_id, 0, m_micro - 1), axis=0,
+                    keepdims=False)
+                total = total + jnp.where(valid_last, head_loss(y, tg), 0.0)
+                if t != m_micro + n - 2:
+                    h = lax.ppermute(y, pipe_axis, perm)
+            # share the last stage's loss with every stage (identity bwd)
+            return tp_reduce(total, pipe_axis) / m_micro
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+
+        # replicated leaves are used on different stages → sum partials
+        specs = pipeline_specs(pipe_axis, cfg.tie_embeddings)
+        grads = jax.tree_util.tree_map(
+            lambda sp, g: g if any(a is not None for a in sp)
+            else lax.psum(g, pipe_axis),
+            specs, grads, is_leaf=lambda x: isinstance(x, P))
+        if dp_axis:
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, dp_axis), grads)
+            loss = lax.pmean(loss, dp_axis)
+
+        new_params, new_slots = method.update(grads, params, slots, lr,
+                                              stepno)
+        return new_params, new_slots, loss
+
+    specs = pipeline_specs(pipe_axis, cfg.tie_embeddings)
+    from bigdl_tpu.parallel.tensor_parallel import slot_specs_for
+
+    slot_specs = slot_specs_for(method, specs)
+    tok_spec = P(dp_axis, None) if dp_axis else P()
+    smapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(specs, slot_specs, tok_spec, tok_spec, P(), P(), P()),
+        out_specs=(specs, slot_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(smapped, donate_argnums=(0, 1))

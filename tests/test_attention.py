@@ -99,6 +99,17 @@ class TestFlashKernel:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.parametrize("sq,sk", [(8, 16), (16, 8), (24, 40)])
+    def test_causal_cross_attention_bottom_right_aligned(self, sq, sk):
+        # causal with seq_q != seq_k: query i sees keys ≤ i + (sk - sq),
+        # the KV-cache decode convention; kernel must match the oracle
+        q, k, v = _rand_qkv(jax.random.PRNGKey(6), sq=sq, sk=sk)
+        ref = attention_reference(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_q=8, block_k=8,
+                              impl="interpret")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
 
 class TestMultiHeadAttention:
     def test_forward_shape_and_oracle(self):

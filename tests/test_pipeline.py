@@ -1,0 +1,76 @@
+"""GPipe pipeline-parallel training step vs the single-device oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.models.transformer import TransformerConfig, TransformerLM
+from bigdl_tpu.optim import SGD
+from bigdl_tpu.parallel import make_mesh, shard_params, slot_specs_for
+from bigdl_tpu.parallel.pipeline import make_pipeline_train_step, pipeline_specs
+
+CFG = TransformerConfig(vocab_size=32, max_len=32, dim=16, num_heads=2,
+                        num_layers=4, dropout=0.0)
+
+
+def _data(b=8, s=12):
+    rng = np.random.RandomState(1)
+    return (jnp.asarray(rng.randint(0, 32, (b, s)).astype(np.int32)),
+            jnp.asarray(rng.randint(0, 32, (b, s)).astype(np.int32)))
+
+
+def _oracle(params, slots, toks, tgts, lr):
+    model = TransformerLM(CFG, name="lm")
+    method = SGD(learningrate=lr, momentum=0.9)
+
+    def loss_fn(p):
+        logp, _ = model.apply({"params": p, "state": {}}, toks)
+        return jnp.mean(-jnp.take_along_axis(logp, tgts[..., None], -1))
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    new_p, new_s = method.update(grads, params, slots, jnp.asarray(lr),
+                                 jnp.asarray(0))
+    return new_p, new_s, loss
+
+
+@pytest.mark.parametrize("axes,dp", [({"pipe": 4}, None),
+                                     ({"pipe": 4, "data": 2}, "data")])
+def test_pipeline_matches_single_device(axes, dp):
+    n_dev = int(np.prod(list(axes.values())))
+    mesh = make_mesh(axes, devices=jax.devices()[:n_dev])
+    model = TransformerLM(CFG, name="lm")
+    params = model.init(jax.random.PRNGKey(0))["params"]
+    method = SGD(learningrate=0.1, momentum=0.9)
+    slots = method.init_slots(params)
+    toks, tgts = _data()
+
+    ref_p, _, ref_loss = _oracle(params, slots, toks, tgts, 0.1)
+
+    specs = pipeline_specs("pipe")
+    step = make_pipeline_train_step(model, method, mesh, pipe_axis="pipe",
+                                    dp_axis=dp, microbatches=4)
+    pp = shard_params(mesh, specs, params)
+    ps = shard_params(mesh, slot_specs_for(method, specs), slots)
+    tok_spec = NamedSharding(mesh, P(dp, None) if dp else P())
+    new_p, _, loss = step(pp, ps, jax.device_put(toks, tok_spec),
+                          jax.device_put(tgts, tok_spec),
+                          jnp.asarray(0.1), jnp.asarray(0),
+                          jax.random.PRNGKey(0))
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), atol=1e-5)
+    for (ka, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(new_p),
+            jax.tree_util.tree_leaves_with_path(ref_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5, err_msg=str(ka))
+
+
+def test_pipeline_rejects_bad_layer_split():
+    mesh = make_mesh({"pipe": 8})
+    model = TransformerLM(TransformerConfig(num_layers=4, dim=16,
+                                            num_heads=2, vocab_size=16),
+                          name="lm")
+    with pytest.raises(ValueError, match="not divisible"):
+        make_pipeline_train_step(model, SGD(), mesh, microbatches=2)
