@@ -5,6 +5,7 @@ from bigdl_tpu.dataset.transformer import (
     Transformer, ChainedTransformer, chain, MapTransformer, SampleToMiniBatch,
 )
 from bigdl_tpu.dataset.dataset import (
-    AbstractDataSet, LocalDataSet, ShardedDataSet, TransformedDataSet, DataSet,
+    AbstractDataSet, LocalDataSet, PrefetchDataSet, ShardedDataSet,
+    TransformedDataSet, DataSet,
 )
-from bigdl_tpu.dataset import image, text, mnist, cifar
+from bigdl_tpu.dataset import image, native, text, mnist, cifar

@@ -21,11 +21,13 @@ TRAIN_STD = np.asarray([62.99321928, 62.08870764, 66.70489964], np.float32)
 
 
 def _read_bin(path: str):
-    raw = np.fromfile(path, np.uint8).reshape(-1, 3073)
-    labels = raw[:, 0].astype(np.int32)
-    # stored CHW planes; convert to HWC (TPU-first channels-last)
-    imgs = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
-    return imgs, labels
+    """Decode CHW records → HWC via the native (C++) data plane when
+    available (bigdl_tpu/dataset/native.py; Python fallback inside)."""
+    from bigdl_tpu.dataset import native
+
+    with open(path, "rb") as f:
+        imgs, labels = native.decode_cifar10(f.read())
+    return imgs, labels.astype(np.int32)
 
 
 def load_cifar10(folder: str, train: bool = True) -> List[Sample]:

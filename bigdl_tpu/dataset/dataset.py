@@ -142,3 +142,57 @@ class DataSet:
     @staticmethod
     def sharded(elements: Sequence, **kw) -> ShardedDataSet:
         return ShardedDataSet(elements, **kw)
+
+
+class PrefetchDataSet(AbstractDataSet):
+    """Dataset backed by the native (C++) prefetcher.
+
+    Wraps `bigdl_tpu.dataset.native.Prefetcher` — worker threads decode,
+    augment, and normalize batches into a ring buffer off the training
+    thread, the TPU-era counterpart of the reference's Spark executors
+    feeding partitions (SURVEY.md §2.4 TPU equivalent / §7 input-pipeline
+    hard part). train=True streams forever (epoch reshuffles happen in
+    the workers); train=False iterates the raw arrays once, unaugmented.
+    """
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray,
+                 batch_size: int, mean, std, pad: int = 0,
+                 hflip: bool = False, n_threads: int = 2,
+                 capacity: int = 4, seed: int = 0):
+        from bigdl_tpu.dataset import native
+
+        self._prefetcher = native.Prefetcher(
+            images, labels, batch_size, mean, std, pad=pad, hflip=hflip,
+            n_threads=n_threads, capacity=capacity, seed=seed)
+        self.images = self._prefetcher.images
+        self.labels = self._prefetcher.labels
+        self.batch_size = batch_size
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    @property
+    def native(self) -> bool:
+        return self._prefetcher.native
+
+    def size(self) -> int:
+        return len(self.labels)
+
+    def data(self, train: bool) -> Iterator:
+        if train:
+            def forever():
+                while True:
+                    img, lbl = self._prefetcher.next()
+                    yield MiniBatch(img, lbl)
+            return forever()
+
+        def once():
+            n = len(self.labels)
+            for i in range(0, n, self.batch_size):
+                img = self.images[i:i + self.batch_size]
+                yield MiniBatch(
+                    (img.astype(np.float32) - self.mean) / self.std,
+                    self.labels[i:i + self.batch_size].copy())
+        return once()
+
+    def close(self) -> None:
+        self._prefetcher.close()

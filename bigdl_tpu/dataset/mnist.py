@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import gzip
 import os
-import struct
 from typing import List, Tuple
 
 import numpy as np
@@ -31,20 +30,19 @@ def _open(path):
 
 
 def read_idx_images(path: str) -> np.ndarray:
+    """Decode via the native (C++) data plane when available
+    (bigdl_tpu/dataset/native.py; pure-Python fallback inside)."""
+    from bigdl_tpu.dataset import native
+
     with _open(path) as f:
-        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
-        if magic != 2051:
-            raise ValueError(f"{path}: bad magic {magic} (want 2051)")
-        data = np.frombuffer(f.read(n * rows * cols), np.uint8)
-    return data.reshape(n, rows, cols)
+        return native.decode_idx_images(f.read())
 
 
 def read_idx_labels(path: str) -> np.ndarray:
+    from bigdl_tpu.dataset import native
+
     with _open(path) as f:
-        magic, n = struct.unpack(">II", f.read(8))
-        if magic != 2049:
-            raise ValueError(f"{path}: bad magic {magic} (want 2049)")
-        return np.frombuffer(f.read(n), np.uint8)
+        return native.decode_idx_labels(f.read())
 
 
 def _find(folder: str, stem: str) -> str:

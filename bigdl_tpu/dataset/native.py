@@ -1,0 +1,299 @@
+"""ctypes bindings for the native (C++) host data plane.
+
+Reference parity: the reference backs its hot paths with native code
+behind JNI (BigDL-core mkl/mkldnn/bigquant shared objects, SURVEY.md
+§2.1). On TPU the device math belongs to XLA, so our native layer lives
+where native still pays: the host input pipeline (native/dataplane.cpp —
+threaded decode/augment/normalize + a prefetching ring buffer that keeps
+the chips fed, SURVEY.md §7).
+
+The library is compiled on first use with g++ (no pybind11 — plain C ABI
+via ctypes) and cached under native/build/. Every entry point has a
+pure-Python fallback so the package works without a toolchain:
+`available()` reports which plane is active.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_ROOT, "native", "dataplane.cpp")
+_SO = os.path.join(_ROOT, "native", "build", "libbigdl_dataplane.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build() -> Optional[str]:
+    if not os.path.exists(_SRC):
+        # prebuilt library without source (installed layout) — use as-is
+        return _SO if os.path.exists(_SO) else None
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-Wall", "-pthread",
+           "-shared", "-o", _SO, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return _SO
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        so = _build()
+        if so is None:
+            return None
+        lib = ctypes.CDLL(so)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.bdl_normalize_u8.argtypes = [u8p, f32p, ctypes.c_int64,
+                                         ctypes.c_int, f32p, f32p,
+                                         ctypes.c_int]
+        lib.bdl_hflip.argtypes = [f32p, u8p] + [ctypes.c_int] * 4
+        lib.bdl_shift_crop.argtypes = [f32p, f32p,
+                                       ctypes.POINTER(ctypes.c_int),
+                                       ctypes.POINTER(ctypes.c_int)] + \
+            [ctypes.c_int] * 4
+        lib.bdl_decode_idx_images.argtypes = [u8p, ctypes.c_int64, u8p,
+                                              i64p, i64p, i64p]
+        lib.bdl_decode_idx_images.restype = ctypes.c_int
+        lib.bdl_decode_idx_labels.argtypes = [u8p, ctypes.c_int64, u8p,
+                                              i64p]
+        lib.bdl_decode_idx_labels.restype = ctypes.c_int
+        lib.bdl_decode_cifar10.argtypes = [u8p, ctypes.c_int64, u8p, u8p,
+                                           i64p]
+        lib.bdl_decode_cifar10.restype = ctypes.c_int
+        lib.bdl_prefetcher_create.argtypes = [
+            u8p, i32p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int, f32p, f32p]
+        lib.bdl_prefetcher_create.restype = ctypes.c_void_p
+        lib.bdl_prefetcher_next.argtypes = [ctypes.c_void_p, f32p, i32p]
+        lib.bdl_prefetcher_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True if the native library is (or can be) loaded."""
+    return _load() is not None
+
+
+def _u8(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _f32(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _i32(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def normalize_u8(images: np.ndarray, mean: Sequence[float],
+                 std: Sequence[float], n_threads: int = 4) -> np.ndarray:
+    """u8 (..., C) → f32 (x - mean[c]) / std[c]; native when possible."""
+    images = np.ascontiguousarray(images, np.uint8)
+    c = images.shape[-1]
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    lib = _load()
+    if lib is None:
+        return (images.astype(np.float32) - mean) / std
+    out = np.empty(images.shape, np.float32)
+    lib.bdl_normalize_u8(_u8(images), _f32(out),
+                         images.size // c, c, _f32(mean), _f32(std),
+                         n_threads)
+    return out
+
+
+def decode_idx_images(raw: bytes) -> np.ndarray:
+    lib = _load()
+    buf = np.frombuffer(raw, np.uint8)
+    if lib is None:
+        import struct
+        magic, n, rows, cols = struct.unpack(">IIII", raw[:16])
+        if magic != 2051:
+            raise ValueError(f"bad IDX magic {magic}")
+        return buf[16:16 + n * rows * cols].reshape(n, rows, cols).copy()
+    n = ctypes.c_int64()
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    rc = lib.bdl_decode_idx_images(_u8(buf), len(raw), None,
+                                   ctypes.byref(n), ctypes.byref(rows),
+                                   ctypes.byref(cols))
+    if rc:
+        raise ValueError(f"IDX image decode failed ({rc})")
+    out = np.empty((n.value, rows.value, cols.value), np.uint8)
+    lib.bdl_decode_idx_images(_u8(buf), len(raw), _u8(out),
+                              ctypes.byref(n), ctypes.byref(rows),
+                              ctypes.byref(cols))
+    return out
+
+
+def decode_idx_labels(raw: bytes) -> np.ndarray:
+    lib = _load()
+    buf = np.frombuffer(raw, np.uint8)
+    if lib is None:
+        import struct
+        magic, n = struct.unpack(">II", raw[:8])
+        if magic != 2049:
+            raise ValueError(f"bad IDX magic {magic}")
+        return buf[8:8 + n].copy()
+    n = ctypes.c_int64()
+    rc = lib.bdl_decode_idx_labels(_u8(buf), len(raw), None,
+                                   ctypes.byref(n))
+    if rc:
+        raise ValueError(f"IDX label decode failed ({rc})")
+    out = np.empty((n.value,), np.uint8)
+    lib.bdl_decode_idx_labels(_u8(buf), len(raw), _u8(out),
+                              ctypes.byref(n))
+    return out
+
+
+def decode_cifar10(raw: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    """CIFAR-10 binary records → (images u8 NHWC, labels u8)."""
+    lib = _load()
+    buf = np.frombuffer(raw, np.uint8)
+    rec = 1 + 3072
+    if len(raw) % rec:
+        raise ValueError(
+            f"CIFAR decode failed: {len(raw)} bytes is not a whole "
+            f"number of {rec}-byte records")
+    if lib is None:
+        n = len(raw) // rec
+        recs = buf.reshape(n, rec)
+        labels = recs[:, 0].copy()
+        chw = recs[:, 1:].reshape(n, 3, 32, 32)
+        return chw.transpose(0, 2, 3, 1).copy(), labels
+    n = ctypes.c_int64()
+    rc = lib.bdl_decode_cifar10(_u8(buf), len(raw), None, None,
+                                ctypes.byref(n))
+    if rc:
+        raise ValueError(f"CIFAR decode failed ({rc})")
+    images = np.empty((n.value, 32, 32, 3), np.uint8)
+    labels = np.empty((n.value,), np.uint8)
+    lib.bdl_decode_cifar10(_u8(buf), len(raw), _u8(images), _u8(labels),
+                           ctypes.byref(n))
+    return images, labels
+
+
+class Prefetcher:
+    """Multithreaded native batch producer over an in-memory u8 dataset.
+
+    Yields (images f32 (B,H,W,C), labels i32 (B,)) batches: shuffled
+    every epoch, normalized, optionally shift-crop/hflip augmented —
+    produced by C++ worker threads into a bounded ring buffer. Falls
+    back to a Python thread if the native library is unavailable
+    (`.native` tells which plane is running).
+    """
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray,
+                 batch_size: int, mean: Sequence[float],
+                 std: Sequence[float], pad: int = 0, hflip: bool = False,
+                 n_threads: int = 2, capacity: int = 4, seed: int = 0):
+        self.images = np.ascontiguousarray(images, np.uint8)
+        if self.images.ndim == 3:  # greyscale → add channel dim
+            self.images = self.images[..., None]
+        self.labels = np.ascontiguousarray(labels, np.int32)
+        self.batch_size = batch_size
+        n, h, w, c = self.images.shape
+        self.shape = (h, w, c)
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.pad, self.hflip = pad, hflip
+        self._lib = _load()
+        self.native = self._lib is not None
+        if self.native:
+            self._handle = self._lib.bdl_prefetcher_create(
+                _u8(self.images), _i32(self.labels), n, h, w, c,
+                batch_size, capacity, n_threads, seed, pad,
+                1 if hflip else 0, _f32(self.mean), _f32(self.std))
+        else:
+            import queue
+
+            self._q = queue.Queue(maxsize=capacity)
+            self._stop = threading.Event()
+            self._rng = np.random.RandomState(seed)
+            self._t = threading.Thread(target=self._py_worker, daemon=True)
+            self._t.start()
+
+    # ---- python fallback -------------------------------------------------
+    def _py_worker(self):
+        n = len(self.labels)
+        h, w, c = self.shape
+        while not self._stop.is_set():
+            order = self._rng.permutation(n)
+            for i in range(0, n - self.batch_size + 1, self.batch_size):
+                if self._stop.is_set():
+                    return
+                idx = order[i:i + self.batch_size]
+                img = (self.images[idx].astype(np.float32) - self.mean) \
+                    / self.std
+                if self.pad:
+                    out = np.zeros_like(img)
+                    for j in range(len(idx)):
+                        dy, dx = self._rng.randint(-self.pad, self.pad + 1,
+                                                   2)
+                        y0, y1 = max(0, dy), min(h, h + dy)
+                        x0, x1 = max(0, dx), min(w, w + dx)
+                        out[j, y0:y1, x0:x1] = \
+                            img[j, y0 - dy:y1 - dy, x0 - dx:x1 - dx]
+                    img = out
+                if self.hflip:
+                    flips = self._rng.rand(len(idx)) < 0.5
+                    img[flips] = img[flips, :, ::-1]
+                self._q.put((img, self.labels[idx].copy()))
+
+    def next(self) -> Tuple[np.ndarray, np.ndarray]:
+        h, w, c = self.shape
+        if self.native:
+            if getattr(self, "_handle", None) is None:
+                raise RuntimeError("Prefetcher used after close()")
+            img = np.empty((self.batch_size, h, w, c), np.float32)
+            lbl = np.empty((self.batch_size,), np.int32)
+            self._lib.bdl_prefetcher_next(self._handle, _f32(img),
+                                          _i32(lbl))
+            return img, lbl
+        return self._q.get()
+
+    def __iter__(self):
+        while True:
+            yield self.next()
+
+    def close(self):
+        if self.native:
+            if getattr(self, "_handle", None):
+                self._lib.bdl_prefetcher_destroy(self._handle)
+                self._handle = None
+        else:
+            self._stop.set()
+            try:
+                while True:
+                    self._q.get_nowait()
+            except Exception:
+                pass
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -152,9 +152,9 @@ class TransformerLM(Module):
     # ----------------------------------------------------------- forward
     @staticmethod
     def _ln(x, g, b, eps=1e-5):
-        mu = jnp.mean(x, axis=-1, keepdims=True)
-        var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
-        return (x - mu) * lax.rsqrt(var + eps) * g + b
+        from bigdl_tpu.nn.normalization import layer_norm
+
+        return layer_norm(x, g, b, eps)
 
     def _attention(self, q, k, v):
         from bigdl_tpu.ops.flash_attention import flash_attention

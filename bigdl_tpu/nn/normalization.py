@@ -130,6 +130,19 @@ class Normalize(Module):
         return x / jnp.maximum(norm, self.eps), variables["state"]
 
 
+def layer_norm(x, weight=None, bias=None, eps: float = 1e-5):
+    """Functional layer norm over the last axis — shared by the
+    LayerNorm module and TransformerLM's block code."""
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
+    y = (x - mu) * lax.rsqrt(var + eps)
+    if weight is not None:
+        y = y * weight
+    if bias is not None:
+        y = y + bias
+    return y
+
+
 class LayerNorm(Module):
     """Layer normalization over the last axis.
 
@@ -151,13 +164,11 @@ class LayerNorm(Module):
                 "bias": jnp.zeros((self.size,), jnp.float32)}
 
     def apply(self, variables, x, training=False, rng=None):
-        mu = jnp.mean(x, axis=-1, keepdims=True)
-        var = jnp.mean((x - mu) ** 2, axis=-1, keepdims=True)
-        y = (x - mu) * lax.rsqrt(var + self.eps)
         if self.affine:
             p = variables["params"]
-            y = y * p["weight"] + p["bias"]
-        return y, variables["state"]
+            return layer_norm(x, p["weight"], p["bias"],
+                              self.eps), variables["state"]
+        return layer_norm(x, eps=self.eps), variables["state"]
 
 
 class RMSNorm(Module):

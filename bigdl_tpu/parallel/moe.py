@@ -132,8 +132,8 @@ class MoE(Module):
                               tiled=True)              # (n, e_local, C, D)
         yout = yout.reshape(self.num_experts, cap, self.dim)
         y = jnp.einsum("tec,ecd->td", combine, yout)
-        # aux is computed from the global (replicated) router — identical
-        # on every shard already
+        # aux is computed from THIS shard's tokens only — callers must
+        # pmean it over the expert axis before using it as a loss term
         return (y.reshape(shape), aux), variables["state"]
 
 

@@ -83,7 +83,7 @@ def make_transformer_train_step(
 
     tokens/targets: (B, S) int32, batch sharded on dp, sequence sharded
     on sp. The model must have been constructed with matching
-    tp_axis/sp_axis. Use `transformer_tp_specs()` + `shard_variables` to
+    tp_axis/sp_axis. Use `transformer_tp_specs()` + `shard_params` to
     place params/slots.
     """
     if (model.tp_axis or None) != (tp_axis or None):

@@ -1,0 +1,287 @@
+// Native host-side data plane for bigdl_tpu.
+//
+// Reference parity: the reference's native layer is C/C++ behind JNI
+// (BigDL-core: libjmkl / mkldnn / bigquant .so, SURVEY.md §2.1); its data
+// plane rides Spark executors (JVM). On TPU the device compute belongs to
+// XLA, so the native layer moves to where it still matters: the HOST input
+// pipeline that has to keep the chips fed (SURVEY.md §7 "Input pipeline
+// throughput" hard part). This library provides:
+//
+//   * batched image preprocessing kernels (u8→f32 normalize, random crop
+//     with zero padding, horizontal flip) parallelized with std::thread
+//   * IDX (MNIST) and CIFAR-10 binary decoding
+//   * a multithreaded prefetcher: worker threads produce shuffled,
+//     augmented, normalized f32 batches into a bounded ring buffer while
+//     the training loop (and the TPU) consume previous ones.
+//
+// C ABI throughout — consumed from Python via ctypes
+// (bigdl_tpu/dataset/native.py), no pybind11 dependency.
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- kernels
+
+// u8 (N,H,W,C) -> f32 (N,H,W,C), per-channel (x - mean[c]) / std[c]
+void bdl_normalize_u8(const uint8_t* src, float* dst, int64_t n_pix,
+                      int c, const float* mean, const float* stdd,
+                      int n_threads) {
+  if (n_threads < 1) n_threads = 1;
+  std::vector<float> inv(c);
+  for (int i = 0; i < c; ++i) inv[i] = 1.0f / stdd[i];
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      int ch = static_cast<int>(i % c);
+      dst[i] = (static_cast<float>(src[i]) - mean[ch]) * inv[ch];
+    }
+  };
+  int64_t total = n_pix * c;
+  if (n_threads == 1 || total < (1 << 16)) {
+    work(0, total);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t chunk = (total + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk, hi = std::min(total, lo + chunk);
+    if (lo >= hi) break;
+    ts.emplace_back(work, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+// f32 NHWC batch horizontal flip in place for rows where flags[i] != 0
+void bdl_hflip(float* img, const uint8_t* flags, int n, int h, int w,
+               int c) {
+  for (int i = 0; i < n; ++i) {
+    if (!flags[i]) continue;
+    float* base = img + static_cast<int64_t>(i) * h * w * c;
+    for (int y = 0; y < h; ++y) {
+      float* row = base + static_cast<int64_t>(y) * w * c;
+      for (int x = 0; x < w / 2; ++x)
+        for (int ch = 0; ch < c; ++ch)
+          std::swap(row[x * c + ch], row[(w - 1 - x) * c + ch]);
+    }
+  }
+}
+
+// f32 NHWC random crop with zero padding: src (n,h,w,c) -> dst (n,h,w,c)
+// shifted by per-image offsets in [-pad, pad] (offy/offx arrays).
+void bdl_shift_crop(const float* src, float* dst, const int* offy,
+                    const int* offx, int n, int h, int w, int c) {
+  const int64_t img_sz = static_cast<int64_t>(h) * w * c;
+  for (int i = 0; i < n; ++i) {
+    const float* s = src + i * img_sz;
+    float* d = dst + i * img_sz;
+    std::memset(d, 0, img_sz * sizeof(float));
+    int dy = offy[i], dx = offx[i];
+    int y0 = std::max(0, dy), y1 = std::min(h, h + dy);
+    int x0 = std::max(0, dx), x1 = std::min(w, w + dx);
+    for (int y = y0; y < y1; ++y) {
+      const float* srow = s + (static_cast<int64_t>(y - dy) * w + (x0 - dx)) * c;
+      float* drow = d + (static_cast<int64_t>(y) * w + x0) * c;
+      std::memcpy(drow, srow, static_cast<int64_t>(x1 - x0) * c * sizeof(float));
+    }
+  }
+}
+
+// ---------------------------------------------------------------- decoders
+
+// IDX3 images: returns 0 on success; out must hold n*rows*cols bytes.
+int bdl_decode_idx_images(const uint8_t* buf, int64_t len, uint8_t* out,
+                          int64_t* out_n, int64_t* out_rows,
+                          int64_t* out_cols) {
+  if (len < 16) return -1;
+  auto be32 = [&](int64_t off) {
+    return (static_cast<uint32_t>(buf[off]) << 24) |
+           (static_cast<uint32_t>(buf[off + 1]) << 16) |
+           (static_cast<uint32_t>(buf[off + 2]) << 8) |
+           static_cast<uint32_t>(buf[off + 3]);
+  };
+  if (be32(0) != 2051) return -2;
+  int64_t n = be32(4), rows = be32(8), cols = be32(12);
+  if (len < 16 + n * rows * cols) return -3;
+  *out_n = n; *out_rows = rows; *out_cols = cols;
+  if (out) std::memcpy(out, buf + 16, n * rows * cols);
+  return 0;
+}
+
+int bdl_decode_idx_labels(const uint8_t* buf, int64_t len, uint8_t* out,
+                          int64_t* out_n) {
+  if (len < 8) return -1;
+  uint32_t magic = (static_cast<uint32_t>(buf[0]) << 24) |
+                   (static_cast<uint32_t>(buf[1]) << 16) |
+                   (static_cast<uint32_t>(buf[2]) << 8) |
+                   static_cast<uint32_t>(buf[3]);
+  if (magic != 2049) return -2;
+  int64_t n = (static_cast<uint32_t>(buf[4]) << 24) |
+              (static_cast<uint32_t>(buf[5]) << 16) |
+              (static_cast<uint32_t>(buf[6]) << 8) |
+              static_cast<uint32_t>(buf[7]);
+  if (len < 8 + n) return -3;
+  *out_n = n;
+  if (out) std::memcpy(out, buf + 8, n);
+  return 0;
+}
+
+// CIFAR-10 binary: records of [label u8][3072 u8 CHW] -> NHWC u8 + labels
+int bdl_decode_cifar10(const uint8_t* buf, int64_t len, uint8_t* images,
+                       uint8_t* labels, int64_t* out_n) {
+  const int64_t rec = 1 + 3 * 32 * 32;
+  int64_t n = len / rec;
+  if (n * rec != len) return -1;
+  *out_n = n;
+  if (!images) return 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* r = buf + i * rec;
+    labels[i] = r[0];
+    const uint8_t* chw = r + 1;
+    uint8_t* img = images + i * 3072;
+    for (int y = 0; y < 32; ++y)
+      for (int x = 0; x < 32; ++x)
+        for (int ch = 0; ch < 3; ++ch)
+          img[(y * 32 + x) * 3 + ch] = chw[ch * 1024 + y * 32 + x];
+  }
+  return 0;
+}
+
+// -------------------------------------------------------------- prefetcher
+
+struct Batch {
+  std::vector<float> images;
+  std::vector<int32_t> labels;
+};
+
+struct Prefetcher {
+  const uint8_t* images;   // (n, h, w, c) u8, borrowed from caller
+  const int32_t* labels;   // (n,), borrowed
+  int64_t n;
+  int h, w, c, batch;
+  int pad;                 // random-shift augmentation range (0 = off)
+  bool hflip;
+  std::vector<float> mean, stdd;
+
+  std::deque<Batch> ring;
+  size_t capacity;
+  std::mutex mu;
+  std::condition_variable cv_full, cv_empty;
+  std::vector<std::thread> workers;
+  std::atomic<bool> stop{false};
+  std::mt19937 index_rng;
+  std::vector<int64_t> order;
+  int64_t cursor = 0;
+  std::mutex order_mu;
+
+  void refill_order() {  // order_mu held
+    if (order.empty()) {
+      order.resize(n);
+      for (int64_t i = 0; i < n; ++i) order[i] = i;
+    }
+    std::shuffle(order.begin(), order.end(), index_rng);
+    cursor = 0;
+  }
+
+  void take_indices(std::vector<int64_t>* idx) {
+    std::lock_guard<std::mutex> lk(order_mu);
+    idx->clear();
+    for (int i = 0; i < batch; ++i) {
+      if (cursor >= n) refill_order();
+      idx->push_back(order[cursor++]);
+    }
+  }
+
+  void worker(unsigned seed) {
+    std::mt19937 rng(seed);
+    std::vector<int64_t> idx;
+    const int64_t img_px = static_cast<int64_t>(h) * w;
+    while (!stop.load()) {
+      take_indices(&idx);
+      Batch b;
+      b.images.resize(static_cast<int64_t>(batch) * img_px * c);
+      b.labels.resize(batch);
+      std::vector<uint8_t> u8img(img_px * c);
+      for (int i = 0; i < batch; ++i) {
+        const uint8_t* src = images + idx[i] * img_px * c;
+        b.labels[i] = labels[idx[i]];
+        float* dst = b.images.data() + static_cast<int64_t>(i) * img_px * c;
+        bdl_normalize_u8(src, dst, img_px, c, mean.data(), stdd.data(), 1);
+        if (pad > 0) {
+          std::uniform_int_distribution<int> d(-pad, pad);
+          int offy = d(rng), offx = d(rng);
+          std::vector<float> tmp(dst, dst + img_px * c);
+          bdl_shift_crop(tmp.data(), dst, &offy, &offx, 1, h, w, c);
+        }
+        if (hflip && (rng() & 1)) {
+          uint8_t f = 1;
+          bdl_hflip(dst, &f, 1, h, w, c);
+        }
+      }
+      std::unique_lock<std::mutex> lk(mu);
+      cv_full.wait(lk, [&] { return ring.size() < capacity || stop.load(); });
+      if (stop.load()) return;
+      ring.push_back(std::move(b));
+      cv_empty.notify_one();
+    }
+  }
+};
+
+void* bdl_prefetcher_create(const uint8_t* images, const int32_t* labels,
+                            int64_t n, int h, int w, int c, int batch,
+                            int capacity, int n_threads, uint64_t seed,
+                            int pad, int hflip, const float* mean,
+                            const float* stdd) {
+  auto* p = new Prefetcher();
+  p->images = images; p->labels = labels;
+  p->n = n; p->h = h; p->w = w; p->c = c; p->batch = batch;
+  p->capacity = capacity > 0 ? capacity : 4;
+  p->pad = pad; p->hflip = hflip != 0;
+  p->mean.assign(mean, mean + c);
+  p->stdd.assign(stdd, stdd + c);
+  p->index_rng.seed(seed);
+  {
+    std::lock_guard<std::mutex> lk(p->order_mu);
+    p->refill_order();
+  }
+  if (n_threads < 1) n_threads = 1;
+  for (int t = 0; t < n_threads; ++t)
+    p->workers.emplace_back(&Prefetcher::worker, p,
+                            static_cast<unsigned>(seed + 1000003ULL * (t + 1)));
+  return p;
+}
+
+// Blocks until a batch is ready; copies into caller buffers.
+void bdl_prefetcher_next(void* handle, float* out_images,
+                         int32_t* out_labels) {
+  auto* p = static_cast<Prefetcher*>(handle);
+  Batch b;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv_empty.wait(lk, [&] { return !p->ring.empty(); });
+    b = std::move(p->ring.front());
+    p->ring.pop_front();
+    p->cv_full.notify_one();
+  }
+  std::memcpy(out_images, b.images.data(), b.images.size() * sizeof(float));
+  std::memcpy(out_labels, b.labels.data(), b.labels.size() * sizeof(int32_t));
+}
+
+void bdl_prefetcher_destroy(void* handle) {
+  auto* p = static_cast<Prefetcher*>(handle);
+  p->stop.store(true);
+  p->cv_full.notify_all();
+  p->cv_empty.notify_all();
+  for (auto& t : p->workers) t.join();
+  delete p;
+}
+
+}  // extern "C"
