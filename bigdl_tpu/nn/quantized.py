@@ -1,0 +1,168 @@
+"""INT8 quantized inference layers.
+
+Reference parity: nn/quantized/ (`Linear`, `SpatialConvolution` over
+`QuantizedTensor`) backed by the native bigquant INT8 gemm/conv kernels
+(com.intel.analytics.bigdl.bigquant.BigQuant — SURVEY.md §2.1). The
+TPU-native equivalent needs no hand-written kernels: `lax.dot_general` /
+`lax.conv_general_dilated` on int8 operands with
+`preferred_element_type=int32` compile straight onto the MXU's int8
+path, which is exactly what bigquant's hand-written AVX kernels emulate
+on CPU.
+
+Scheme (matching the reference's): weights quantized offline, symmetric
+per-output-channel (scale = max|w| / 127); activations quantized
+dynamically per batch, symmetric per-tensor — the reference's
+`QuantizedTensor` threshold scheme. Dequantize fuses into one f32 scale
+multiply after the int32 accumulation.
+
+`quantize(module, variables)` converts a trained model in place
+(reference: `Module.quantize()`), swapping Linear/SpatialConvolution
+inside containers for their quantized twins.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.container import Container, Sequential
+from bigdl_tpu.nn.conv import SpatialConvolution
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.module import Module
+
+
+def _quantize_weight(w: jax.Array, axis) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-channel int8: returns (int8 weights, f32 scales)."""
+    amax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+def _quantize_act(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Dynamic symmetric per-tensor int8 for activations."""
+    amax = jnp.max(jnp.abs(x))
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+class QuantizedLinear(Module):
+    """INT8 y = xW + b (reference: nn/quantized/Linear.scala)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 with_bias: bool = True, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+
+    @staticmethod
+    def from_float(linear: Linear, variables: Dict[str, Any]):
+        """Quantize a trained Linear's variables."""
+        m = QuantizedLinear(linear.input_size, linear.output_size,
+                            linear.with_bias, name=linear.name)
+        m._explicit_name = linear._explicit_name
+        p = variables["params"]
+        qw, scale = _quantize_weight(p["weight"], axis=0)  # per out-col
+        qp = {"qweight": qw, "scale": scale[0]}            # (out,)
+        if linear.with_bias:
+            qp["bias"] = p["bias"]
+        return m, {"params": qp, "state": {}}
+
+    def init_params(self, rng):
+        qp = {"qweight": jnp.zeros((self.input_size, self.output_size),
+                                   jnp.int8),
+              "scale": jnp.ones((self.output_size,), jnp.float32)}
+        if self.with_bias:
+            qp["bias"] = jnp.zeros((self.output_size,), jnp.float32)
+        return qp
+
+    def apply(self, variables, x, training=False, rng=None):
+        p = variables["params"]
+        xq, xs = _quantize_act(x)
+        acc = lax.dot_general(xq, p["qweight"],
+                              (((x.ndim - 1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * (p["scale"] * xs)
+        if self.with_bias:
+            y = y + p["bias"]
+        return y, variables["state"]
+
+
+class QuantizedSpatialConvolution(Module):
+    """INT8 NHWC conv (reference: nn/quantized/SpatialConvolution.scala)."""
+
+    def __init__(self, conv: SpatialConvolution,
+                 name: Optional[str] = None):
+        super().__init__(name=name or conv.name)
+        self._explicit_name = conv._explicit_name
+        self.conv = conv
+
+    @staticmethod
+    def from_float(conv: SpatialConvolution, variables: Dict[str, Any]):
+        m = QuantizedSpatialConvolution(conv)
+        p = variables["params"]
+        # HWIO: reduce over (H, W, I) → per-output-channel scale
+        qw, scale = _quantize_weight(p["weight"], axis=(0, 1, 2))
+        qp = {"qweight": qw, "scale": scale.reshape(-1)}
+        if conv.with_bias:
+            qp["bias"] = p["bias"]
+        return m, {"params": qp, "state": {}}
+
+    def init_params(self, rng):
+        c = self.conv
+        qp = {"qweight": jnp.zeros(
+            (c.kernel_h, c.kernel_w, c.n_input_plane // c.n_group,
+             c.n_output_plane), jnp.int8),
+            "scale": jnp.ones((c.n_output_plane,), jnp.float32)}
+        if c.with_bias:
+            qp["bias"] = jnp.zeros((c.n_output_plane,), jnp.float32)
+        return qp
+
+    def apply(self, variables, x, training=False, rng=None):
+        c = self.conv
+        p = variables["params"]
+        xq, xs = _quantize_act(x)
+        acc = lax.conv_general_dilated(
+            xq, p["qweight"],
+            window_strides=(c.stride_h, c.stride_w),
+            padding=[(c.pad_h, c.pad_h), (c.pad_w, c.pad_w)],
+            dimension_numbers=c._dn,
+            feature_group_count=c.n_group,
+            preferred_element_type=jnp.int32,
+        )
+        y = acc.astype(jnp.float32) * (p["scale"] * xs)
+        if c.with_bias:
+            y = y + p["bias"]
+        return y, variables["state"]
+
+
+def quantize(module: Module, variables: Dict[str, Any]
+             ) -> Tuple[Module, Dict[str, Any]]:
+    """Convert a trained model to INT8 inference form
+    (reference: AbstractModule.quantize()). Linear/SpatialConvolution
+    become quantized twins; containers recurse; everything else passes
+    through with its variables unchanged."""
+    if isinstance(module, Linear):
+        return QuantizedLinear.from_float(module, variables)
+    if isinstance(module, SpatialConvolution):
+        return QuantizedSpatialConvolution.from_float(module, variables)
+    if isinstance(module, Container):
+        new_children = []
+        new_params: Dict[str, Any] = {}
+        new_state: Dict[str, Any] = {}
+        for key, child in zip(module._keys, module.modules):
+            cvars = {"params": variables["params"][key],
+                     "state": variables["state"][key]}
+            qchild, qvars = quantize(child, cvars)
+            new_children.append(qchild)
+            new_params[key] = qvars["params"]
+            new_state[key] = qvars["state"]
+        clone = type(module)(*new_children, name=module.name)
+        clone._explicit_name = module._explicit_name
+        clone._keys = list(module._keys)   # keep original pytree keys
+        return clone, {"params": new_params, "state": new_state}
+    return module, variables

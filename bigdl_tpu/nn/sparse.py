@@ -1,0 +1,106 @@
+"""Sparse input layers — wide/embedding-bag models.
+
+Reference parity: tensor/SparseTensor.scala (CSR-ish sparse tensor for
+wide models), nn/SparseLinear.scala, nn/LookupTableSparse.scala
+(SURVEY.md §2.1 "Sparse tensor").
+
+TPU-first redesign: XLA wants static shapes, so a sparse batch is a
+fixed-capacity COO pair instead of CSR —
+
+    indices (B, K) int32   column ids, padded with 0
+    values  (B, K) float32 padded with 0.0  (so pads contribute nothing)
+
+`encode_sparse` builds that encoding from per-row (ids, vals) lists.
+Gather + einsum compile to efficient dynamic-gather HLO; no scatter in
+the forward, and jax.grad gives the scatter-add backward for the
+embedding table automatically.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.initialization import Xavier
+from bigdl_tpu.nn.module import Module
+
+
+def encode_sparse(rows: Sequence[Tuple[Sequence[int], Sequence[float]]],
+                  capacity: Optional[int] = None
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-row (ids, vals) → fixed-capacity (indices, values) arrays."""
+    if capacity is None:
+        capacity = max((len(ids) for ids, _ in rows), default=1)
+    n = len(rows)
+    indices = np.zeros((n, capacity), np.int32)
+    values = np.zeros((n, capacity), np.float32)
+    for i, (ids, vals) in enumerate(rows):
+        k = len(ids)
+        if k > capacity:
+            raise ValueError(f"row {i} has {k} nnz > capacity {capacity}")
+        indices[i, :k] = np.asarray(ids, np.int32)
+        values[i, :k] = np.asarray(vals, np.float32)
+    return indices, values
+
+
+class SparseLinear(Module):
+    """y = sparse_x · W + b over COO input (indices, values)
+    (reference: nn/SparseLinear.scala)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 with_bias: bool = True, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+
+    def init_params(self, rng):
+        wk, _ = jax.random.split(rng)
+        p = {"weight": Xavier()(wk, (self.input_size, self.output_size),
+                                fan_in=self.input_size,
+                                fan_out=self.output_size)}
+        if self.with_bias:
+            p["bias"] = jnp.zeros((self.output_size,), jnp.float32)
+        return p
+
+    def apply(self, variables, input, training=False, rng=None):
+        indices, values = input[0], input[1]
+        p = variables["params"]
+        rows = p["weight"][indices]              # (B, K, out) gather
+        y = jnp.einsum("bk,bko->bo", values, rows)
+        if self.with_bias:
+            y = y + p["bias"]
+        return y, variables["state"]
+
+
+class LookupTableSparse(Module):
+    """Embedding bag: combine embeddings of a variable-length id set
+    (reference: nn/LookupTableSparse.scala; combiner sum|mean|sqrtn)."""
+
+    def __init__(self, n_index: int, n_output: int,
+                 combiner: str = "sum", name: Optional[str] = None):
+        super().__init__(name=name)
+        if combiner not in ("sum", "mean", "sqrtn"):
+            raise ValueError(f"unknown combiner {combiner!r}")
+        self.n_index = n_index
+        self.n_output = n_output
+        self.combiner = combiner
+
+    def init_params(self, rng):
+        return {"weight": jax.random.normal(
+            rng, (self.n_index, self.n_output)) * 0.05}
+
+    def apply(self, variables, input, training=False, rng=None):
+        indices, values = input[0], input[1]
+        emb = variables["params"]["weight"][indices]   # (B, K, D)
+        out = jnp.einsum("bk,bkd->bd", values, emb)
+        if self.combiner != "sum":
+            w = jnp.sum(jnp.abs(values), axis=-1, keepdims=True)
+            if self.combiner == "sqrtn":
+                w = jnp.sqrt(jnp.sum(values * values, axis=-1,
+                                     keepdims=True))
+            out = out / jnp.maximum(w, 1e-8)
+        return out, variables["state"]

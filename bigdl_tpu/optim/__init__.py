@@ -12,6 +12,7 @@ from bigdl_tpu.optim.validation import (
     ValidationMethod, ValidationResult, Top1Accuracy, Top5Accuracy, Loss,
     TreeNNAccuracy, HitRatio, NDCG,
 )
+from bigdl_tpu.optim.lbfgs import LBFGS
 from bigdl_tpu.optim.metrics import Metrics, Timer
 from bigdl_tpu.optim.optimizer import Optimizer, LocalOptimizer
 from bigdl_tpu.optim.evaluator import Evaluator, Predictor, LocalPredictor

@@ -1,0 +1,156 @@
+"""L-BFGS with line search, fully under jit.
+
+Reference parity: optim/LBFGS.scala (two-loop recursion, history of
+(s, y) pairs, tolFun/tolX termination) + optim/LineSearch.scala
+(`lswolfe`). The reference's optimize() takes a `feval` closure it can
+re-evaluate during the line search — a different contract from the
+gradient-based OptimMethod.update used by the training loop — so LBFGS
+here exposes `minimize(feval, x0)` directly, mirroring
+`LBFGS.optimize(feval, x)`.
+
+TPU-first redesign: the reference's Scala loop with mutable ArrayBuffers
+becomes a `lax.while_loop` over fixed-shape history buffers
+((m, n) ring buffers + ring index), so the WHOLE optimization — history
+updates, two-loop recursion, line search — is one XLA computation with
+static shapes. Line search is backtracking Armijo under an inner
+`lax.while_loop` (the reference defaults to a fixed step unless lswolfe
+is passed; strong-Wolfe cubic interpolation is a documented divergence).
+Works on any params pytree via ravel_pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.flatten_util import ravel_pytree
+
+
+class LBFGS:
+    """minimize(feval, x0) → (x*, final_loss, n_iter).
+
+    feval: params-pytree → scalar loss (differentiated internally).
+    """
+
+    def __init__(self, max_iter: int = 100, history_size: int = 10,
+                 learningrate: float = 1.0, tolfun: float = 1e-8,
+                 tolx: float = 1e-9, line_search: bool = True,
+                 ls_max_steps: int = 20, armijo_c: float = 1e-4,
+                 ls_backtrack: float = 0.5):
+        self.max_iter = max_iter
+        self.history_size = history_size
+        self.learningrate = learningrate
+        self.tolfun = tolfun
+        self.tolx = tolx
+        self.line_search = line_search
+        self.ls_max_steps = ls_max_steps
+        self.armijo_c = armijo_c
+        self.ls_backtrack = ls_backtrack
+
+    def minimize(self, feval: Callable, x0: Any
+                 ) -> Tuple[Any, jax.Array, jax.Array]:
+        flat0, unravel = ravel_pytree(x0)
+        n = flat0.shape[0]
+        m = self.history_size
+
+        def f(flat):
+            return feval(unravel(flat))
+
+        vg = jax.value_and_grad(f)
+
+        def direction(g, s_hist, y_hist, rho, count, head):
+            """Two-loop recursion (reference: LBFGS.scala twoLoop)."""
+            q = -g
+            alphas = jnp.zeros((m,))
+
+            def bwd(i, carry):
+                q, alphas = carry
+                # newest-to-oldest: slot index
+                j = (head - 1 - i) % m
+                valid = i < count
+                a = rho[j] * jnp.dot(s_hist[j], q)
+                a = jnp.where(valid, a, 0.0)
+                q = q - a * y_hist[j]
+                return q, alphas.at[j].set(a)
+
+            q, alphas = lax.fori_loop(0, m, bwd, (q, alphas))
+            # initial Hessian scaling γ = s·y / y·y of the newest pair
+            jn = (head - 1) % m
+            gamma = jnp.where(
+                count > 0,
+                jnp.dot(s_hist[jn], y_hist[jn]) /
+                jnp.maximum(jnp.dot(y_hist[jn], y_hist[jn]), 1e-10),
+                1.0)
+            r = q * gamma
+
+            def fwd(i, r):
+                j = (head - count + i) % m      # oldest-to-newest
+                valid = i < count
+                beta = rho[j] * jnp.dot(y_hist[j], r)
+                upd = (alphas[j] - beta) * s_hist[j]
+                return r + jnp.where(valid, upd, 0.0)
+
+            return lax.fori_loop(0, m, fwd, r)
+
+        def search(x, fx, g, d):
+            """Backtracking Armijo: largest t=lr·β^k with sufficient
+            decrease (reference default is fixed-step; lswolfe is the
+            stronger variant — documented divergence)."""
+            gtd = jnp.dot(g, d)
+            t0 = jnp.asarray(self.learningrate)
+            if not self.line_search:
+                fx2, g2 = vg(x + t0 * d)
+                return t0, fx2, g2
+
+            def cond(carry):
+                t, k, fx2, _ = carry
+                return (k < self.ls_max_steps) & \
+                    (fx2 > fx + self.armijo_c * t * gtd)
+
+            def body(carry):
+                t, k, _, _ = carry
+                t = t * self.ls_backtrack
+                fx2, g2 = vg(x + t * d)
+                return t, k + 1, fx2, g2
+
+            fx_first, g_first = vg(x + t0 * d)
+            t, _, fx2, g2 = lax.while_loop(
+                cond, body, (t0, jnp.asarray(0), fx_first, g_first))
+            return t, fx2, g2
+
+        def step(carry):
+            x, fx, g, s_hist, y_hist, rho, count, head, it, _ = carry
+            d = direction(g, s_hist, y_hist, rho, count, head)
+            # fall back to steepest descent if d is not a descent dir
+            gtd = jnp.dot(g, d)
+            d = jnp.where(gtd < 0, d, -g)
+            t, fx2, g2 = search(x, fx, g, d)
+            s = t * d
+            y = g2 - g
+            sy = jnp.dot(s, y)
+            # curvature check before admitting the pair to history
+            ok = sy > 1e-10
+            s_hist = jnp.where(ok, s_hist.at[head].set(s), s_hist)
+            y_hist = jnp.where(ok, y_hist.at[head].set(y), y_hist)
+            rho = jnp.where(ok, rho.at[head].set(1.0 / jnp.maximum(sy, 1e-10)),
+                            rho)
+            head = jnp.where(ok, (head + 1) % m, head)
+            count = jnp.where(ok, jnp.minimum(count + 1, m), count)
+            converged = (jnp.abs(fx2 - fx) < self.tolfun) | \
+                (jnp.max(jnp.abs(s)) < self.tolx) | \
+                (jnp.max(jnp.abs(g2)) < self.tolfun)
+            return (x + s, fx2, g2, s_hist, y_hist, rho, count, head,
+                    it + 1, converged)
+
+        def cond(carry):
+            *_, it, converged = carry
+            return (it < self.max_iter) & jnp.logical_not(converged)
+
+        fx0, g0 = vg(flat0)
+        init = (flat0, fx0, g0, jnp.zeros((m, n)), jnp.zeros((m, n)),
+                jnp.zeros((m,)), jnp.asarray(0), jnp.asarray(0),
+                jnp.asarray(0), jnp.asarray(False))
+        out = lax.while_loop(cond, step, init)
+        return unravel(out[0]), out[1], out[8]

@@ -1,0 +1,165 @@
+"""Torch interop: import torch.nn models into bigdl_tpu modules.
+
+Reference parity: utils/TorchFile.scala (`load`/`save` of Torch7 .t7
+modules and tensors — the reference's model-import path from the Torch
+ecosystem, SURVEY.md §2.5). The modern Torch ecosystem is PyTorch, so
+this module converts `torch.nn` modules (architecture + weights) into
+our Module/variables pair instead of parsing the long-dead .t7 format.
+
+Layout conversions (we are NHWC/HWIO, torch is NCHW/OIHW):
+    Linear.weight  (out, in)      → (in, out)
+    Conv2d.weight  (O, I, kH, kW) → (kH, kW, I, O)
+    converted conv/pool/bn modules consume NHWC input — feed images as
+    (N, H, W, C); a leading `Transpose` is inserted automatically by
+    `from_torch` only when you pass `input_layout="NCHW"`.
+
+Import is by module-type dispatch over `torch.nn` containers; a clear
+error names any unsupported layer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.module import Module
+
+
+def _np(t) -> np.ndarray:
+    return t.detach().cpu().numpy()
+
+
+def _conv(tm) -> Tuple[Module, Dict[str, Any]]:
+    if tm.groups != 1 and tm.groups != tm.in_channels:
+        pass  # grouped conv maps directly via n_group
+    m = nn.SpatialConvolution(
+        tm.in_channels, tm.out_channels,
+        kernel_w=tm.kernel_size[1], kernel_h=tm.kernel_size[0],
+        stride_w=tm.stride[1], stride_h=tm.stride[0],
+        pad_w=tm.padding[1], pad_h=tm.padding[0],
+        n_group=tm.groups, with_bias=tm.bias is not None)
+    w = _np(tm.weight).transpose(2, 3, 1, 0)  # OIHW → HWIO
+    p = {"weight": w}
+    if tm.bias is not None:
+        p["bias"] = _np(tm.bias)
+    return m, {"params": p, "state": {}}
+
+
+def _linear(tm) -> Tuple[Module, Dict[str, Any]]:
+    m = nn.Linear(tm.in_features, tm.out_features,
+                  with_bias=tm.bias is not None)
+    p = {"weight": _np(tm.weight).T}
+    if tm.bias is not None:
+        p["bias"] = _np(tm.bias)
+    return m, {"params": p, "state": {}}
+
+
+def _batchnorm(tm, spatial: bool) -> Tuple[Module, Dict[str, Any]]:
+    cls = nn.SpatialBatchNormalization if spatial else nn.BatchNormalization
+    m = cls(tm.num_features, eps=tm.eps, momentum=tm.momentum or 0.1,
+            affine=tm.affine)
+    p = {}
+    if tm.affine:
+        p = {"weight": _np(tm.weight), "bias": _np(tm.bias)}
+    state = {"running_mean": _np(tm.running_mean),
+             "running_var": _np(tm.running_var)}
+    return m, {"params": p, "state": state}
+
+
+def _embedding(tm) -> Tuple[Module, Dict[str, Any]]:
+    m = nn.LookupTable(tm.num_embeddings, tm.embedding_dim)
+    return m, {"params": {"weight": _np(tm.weight)}, "state": {}}
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else v
+
+
+def _pool(tm, is_max: bool) -> Tuple[Module, Dict[str, Any]]:
+    k = _pair(tm.kernel_size)
+    s = _pair(tm.stride if tm.stride is not None else tm.kernel_size)
+    pad = _pair(tm.padding)
+    cls = nn.SpatialMaxPooling if is_max else nn.SpatialAveragePooling
+    kw = dict(kernel_w=k[1], kernel_h=k[0], stride_w=s[1], stride_h=s[0],
+              pad_w=pad[1], pad_h=pad[0],
+              ceil_mode=bool(getattr(tm, "ceil_mode", False)))
+    if not is_max:
+        kw["count_include_pad"] = bool(getattr(tm, "count_include_pad",
+                                               True))
+    m = cls(**kw)
+    return m, {"params": {}, "state": {}}
+
+
+def from_torch(tm, input_layout: str = "NHWC"
+               ) -> Tuple[Module, Dict[str, Any]]:
+    """Convert a torch.nn module tree → (Module, variables).
+
+    input_layout="NCHW" prepends an NCHW→NHWC transpose so the converted
+    model accepts the same input tensors the torch model did.
+    """
+    import torch.nn as tnn
+
+    def convert(tm) -> Tuple[Module, Dict[str, Any]]:
+        if isinstance(tm, tnn.Sequential):
+            children, params, state = [], {}, {}
+            seq = nn.Sequential()
+            for child in tm:
+                cm, cv = convert(child)
+                seq.add(cm)
+                key = seq._keys[-1]
+                params[key] = cv["params"]
+                state[key] = cv["state"]
+            return seq, {"params": params, "state": state}
+        if isinstance(tm, tnn.Linear):
+            return _linear(tm)
+        if isinstance(tm, tnn.Conv2d):
+            return _conv(tm)
+        if isinstance(tm, tnn.BatchNorm2d):
+            return _batchnorm(tm, spatial=True)
+        if isinstance(tm, tnn.BatchNorm1d):
+            return _batchnorm(tm, spatial=False)
+        if isinstance(tm, tnn.Embedding):
+            return _embedding(tm)
+        if isinstance(tm, tnn.MaxPool2d):
+            return _pool(tm, is_max=True)
+        if isinstance(tm, tnn.AvgPool2d):
+            return _pool(tm, is_max=False)
+        if isinstance(tm, tnn.ReLU):
+            return nn.ReLU(), {"params": {}, "state": {}}
+        if isinstance(tm, tnn.ReLU6):
+            return nn.ReLU6(), {"params": {}, "state": {}}
+        if isinstance(tm, tnn.Tanh):
+            return nn.Tanh(), {"params": {}, "state": {}}
+        if isinstance(tm, tnn.Sigmoid):
+            return nn.Sigmoid(), {"params": {}, "state": {}}
+        if isinstance(tm, tnn.GELU):
+            return nn.GELU(), {"params": {}, "state": {}}
+        if isinstance(tm, tnn.Softmax):
+            return nn.SoftMax(), {"params": {}, "state": {}}
+        if isinstance(tm, tnn.LogSoftmax):
+            return nn.LogSoftMax(), {"params": {}, "state": {}}
+        if isinstance(tm, tnn.Dropout):
+            return nn.Dropout(tm.p), {"params": {}, "state": {}}
+        if isinstance(tm, tnn.Flatten):
+            if getattr(tm, "start_dim", 1) != 1:
+                raise NotImplementedError("Flatten(start_dim != 1)")
+            return (nn.Reshape((-1,), batch_mode=True),
+                    {"params": {}, "state": {}})
+        if isinstance(tm, tnn.Identity):
+            return nn.Identity(), {"params": {}, "state": {}}
+        raise NotImplementedError(
+            f"torch module {type(tm).__name__} has no bigdl_tpu mapping")
+
+    module, variables = convert(tm)
+    if input_layout == "NCHW":
+        wrapped = nn.Sequential()
+        # NCHW→NHWC via 1-based swap pairs: [N,C,H,W]→[N,H,C,W]→[N,H,W,C]
+        wrapped.add(nn.Transpose(((2, 3), (3, 4))))
+        wrapped.add(module)
+        k0, k1 = wrapped._keys
+        variables = {"params": {k0: {}, k1: variables["params"]},
+                     "state": {k0: {}, k1: variables["state"]}}
+        return wrapped, variables
+    return module, variables

@@ -1,0 +1,69 @@
+"""INT8 quantized inference vs the float models."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.quantized import (
+    QuantizedLinear, QuantizedSpatialConvolution, quantize)
+
+
+def test_quantized_linear_close_to_float():
+    lin = nn.Linear(32, 16, name="fc")
+    variables = lin.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 32))
+    ref, _ = lin.apply(variables, x)
+
+    qlin, qvars = QuantizedLinear.from_float(lin, variables)
+    out, _ = qlin.apply(qvars, x)
+    # int8 symmetric quantization: ~1% relative error on these activations
+    err = float(jnp.max(jnp.abs(out - ref)) / jnp.max(jnp.abs(ref)))
+    assert err < 0.05, err
+    assert qvars["params"]["qweight"].dtype == jnp.int8
+
+
+def test_quantized_conv_close_to_float():
+    conv = nn.SpatialConvolution(3, 8, 3, pad_w=1, pad_h=1, name="c1")
+    variables = conv.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16, 3))
+    ref, _ = conv.apply(variables, x)
+    qconv, qvars = QuantizedSpatialConvolution.from_float(conv, variables)
+    out, _ = qconv.apply(qvars, x)
+    err = float(jnp.max(jnp.abs(out - ref)) / jnp.max(jnp.abs(ref)))
+    assert err < 0.05, err
+
+
+def test_quantize_whole_model_keeps_predictions():
+    # train-free check: same argmax on most inputs after quantization
+    from bigdl_tpu.models import lenet
+
+    model = lenet.build(10)
+    variables = model.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (32, 28, 28, 1))
+    ref, _ = model.apply(variables, x)
+
+    qmodel, qvars = quantize(model, variables)
+    out, _ = qmodel.apply(qvars, x)
+    agree = float(np.mean(np.asarray(ref).argmax(-1) ==
+                          np.asarray(out).argmax(-1)))
+    assert agree > 0.9, agree
+    # pytree keys preserved so checkpointing stays compatible
+    assert set(qvars["params"].keys()) == set(variables["params"].keys())
+    # weights really are int8 underneath
+    leaves = jax.tree_util.tree_leaves(qvars["params"])
+    assert any(l.dtype == jnp.int8 for l in leaves)
+
+
+def test_quantized_model_size_shrinks():
+    from bigdl_tpu.models import lenet
+
+    model = lenet.build(10)
+    variables = model.init(jax.random.PRNGKey(0))
+    qmodel, qvars = quantize(model, variables)
+
+    def nbytes(tree):
+        return sum(l.size * l.dtype.itemsize
+                   for l in jax.tree_util.tree_leaves(tree))
+
+    assert nbytes(qvars["params"]) < 0.35 * nbytes(variables["params"])

@@ -1,0 +1,73 @@
+"""Sparse layers vs dense oracles."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.sparse import LookupTableSparse, SparseLinear, encode_sparse
+
+
+def _dense_from_coo(indices, values, size):
+    n, k = indices.shape
+    dense = np.zeros((n, size), np.float32)
+    for i in range(n):
+        for j in range(k):
+            dense[i, indices[i, j]] += values[i, j]
+    return dense
+
+
+def test_encode_sparse_pads():
+    idx, val = encode_sparse([([1, 3], [2.0, 4.0]), ([0], [1.0])])
+    assert idx.shape == (2, 2)
+    np.testing.assert_array_equal(idx, [[1, 3], [0, 0]])
+    np.testing.assert_array_equal(val, [[2.0, 4.0], [1.0, 0.0]])
+
+
+def test_sparse_linear_matches_dense():
+    m = SparseLinear(50, 8, name="sl")
+    variables = m.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    rows = []
+    for _ in range(7):
+        ids = rng.choice(50, size=rng.randint(1, 6), replace=False)
+        rows.append((ids, rng.randn(len(ids))))
+    idx, val = encode_sparse(rows)
+    out, _ = m.apply(variables, (jnp.asarray(idx), jnp.asarray(val)))
+
+    dense = _dense_from_coo(idx, val, 50)
+    ref = dense @ np.asarray(variables["params"]["weight"]) + \
+        np.asarray(variables["params"]["bias"])
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+
+def test_lookup_sparse_combiners():
+    rng = np.random.RandomState(1)
+    idx, val = encode_sparse([([2, 5, 9], [1.0, 1.0, 1.0]),
+                              ([4], [1.0])])
+    for combiner in ("sum", "mean", "sqrtn"):
+        m = LookupTableSparse(16, 4, combiner=combiner, name=f"lt_{combiner}")
+        variables = m.init(jax.random.PRNGKey(0))
+        out, _ = m.apply(variables, (jnp.asarray(idx), jnp.asarray(val)))
+        w = np.asarray(variables["params"]["weight"])
+        row0 = w[2] + w[5] + w[9]
+        if combiner == "mean":
+            row0 = row0 / 3.0
+        elif combiner == "sqrtn":
+            row0 = row0 / np.sqrt(3.0)
+        np.testing.assert_allclose(np.asarray(out)[0], row0, atol=1e-5)
+
+
+def test_sparse_embedding_grad_is_scatter_add():
+    m = LookupTableSparse(10, 4, name="lt")
+    variables = m.init(jax.random.PRNGKey(0))
+    idx, val = encode_sparse([([1, 1], [1.0, 1.0])])  # duplicate id
+
+    def loss(p):
+        out, _ = m.apply({"params": p, "state": {}},
+                         (jnp.asarray(idx), jnp.asarray(val)))
+        return jnp.sum(out)
+
+    g = jax.grad(loss)(variables["params"])["weight"]
+    # duplicate contributions accumulate
+    np.testing.assert_allclose(np.asarray(g)[1], 2.0 * np.ones(4), atol=1e-6)
+    assert float(np.abs(np.asarray(g)[0]).sum()) == 0.0
