@@ -8,4 +8,5 @@ from bigdl_tpu.dataset.dataset import (
     AbstractDataSet, LocalDataSet, PrefetchDataSet, ShardedDataSet,
     TransformedDataSet, DataSet,
 )
-from bigdl_tpu.dataset import image, native, text, mnist, cifar
+from bigdl_tpu.dataset import image, native, text, mnist, cifar, vision
+from bigdl_tpu.dataset.vision import ImageFeature, ImageFrame

@@ -76,3 +76,86 @@ def build(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
 
 
 Inception_v1 = build
+
+
+# --------------------------------------------------------------- Inception v2
+
+def _conv_bn(n_in, n_out, k, stride=1, pad=0, name=""):
+    """conv + SpatialBatchNormalization + ReLU — the v2 building block
+    (reference: Inception_v2.scala — every conv is followed by
+    SpatialBatchNormalization(nOut, 1e-3) + ReLU(true))."""
+    return nn.Sequential(
+        nn.SpatialConvolution(n_in, n_out, k, k, stride, stride, pad, pad,
+                              w_init=Xavier()).set_name(name + f"conv{k}x{k}"),
+        nn.SpatialBatchNormalization(n_out, eps=1e-3).set_name(name + "bn"),
+        nn.ReLU(),
+    )
+
+
+def inception_layer_v2(n_in, config, prefix=""):
+    """(reference: Inception_v2.scala#Inception_Layer_v2)
+
+    config = ((c1,), (c3r, c3), (d3r, d3), (pool_kind, pp)) with the v2
+    branch set: 1x1 / 1x1->3x3 / 1x1->3x3->3x3 (double-3x3 replaces v1's
+    5x5) / pool->proj. ``c1 == 0`` selects the stride-2 ("pass-through")
+    variant: the 1x1 branch disappears, both conv branches stride 2, the
+    pool branch max-pools stride 2 with no projection.
+    """
+    (c1,), (c3r, c3), (d3r, d3), (pool_kind, pp) = config
+    stride = 2 if c1 == 0 else 1
+    branches = []
+    if c1 > 0:
+        branches.append(_conv_bn(n_in, c1, 1, name=prefix + "1x1/"))
+    branches.append(nn.Sequential(
+        _conv_bn(n_in, c3r, 1, name=prefix + "3x3r/"),
+        _conv_bn(c3r, c3, 3, stride=stride, pad=1, name=prefix + "3x3/")))
+    branches.append(nn.Sequential(
+        _conv_bn(n_in, d3r, 1, name=prefix + "d3x3r/"),
+        _conv_bn(d3r, d3, 3, pad=1, name=prefix + "d3x3a/"),
+        _conv_bn(d3, d3, 3, stride=stride, pad=1, name=prefix + "d3x3b/")))
+    if pool_kind == "max":
+        pool = nn.SpatialMaxPooling(3, 3, stride, stride,
+                                    *(() if stride == 2 else (1, 1))).ceil()
+    else:
+        pool = nn.SpatialAveragePooling(3, 3, 1, 1, 1, 1).ceil()
+    if pp > 0:
+        branches.append(nn.Sequential(
+            pool, _conv_bn(n_in, pp, 1, name=prefix + "pool/")))
+    else:
+        branches.append(pool)
+    return nn.Concat(4, *branches)
+
+
+def build_v2(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
+    """BN-Inception (reference: models/inception/Inception_v2.scala —
+    channel configs per inception_3a..5b of that graph)."""
+    m = nn.Sequential(
+        nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3,
+                              w_init=Xavier()).set_name("conv1/7x7_s2"),
+        nn.SpatialBatchNormalization(64, eps=1e-3),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+        _conv_bn(64, 64, 1, name="conv2/3x3_reduce/"),
+        _conv_bn(64, 192, 3, pad=1, name="conv2/3x3/"),
+        nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+        inception_layer_v2(192, ((64,), (64, 64), (64, 96), ("avg", 32)), "3a/"),
+        inception_layer_v2(256, ((64,), (64, 96), (64, 96), ("avg", 64)), "3b/"),
+        inception_layer_v2(320, ((0,), (128, 160), (64, 96), ("max", 0)), "3c/"),
+        inception_layer_v2(576, ((224,), (64, 96), (96, 128), ("avg", 128)), "4a/"),
+        inception_layer_v2(576, ((192,), (96, 128), (96, 128), ("avg", 128)), "4b/"),
+        inception_layer_v2(576, ((160,), (128, 160), (128, 160), ("avg", 96)), "4c/"),
+        inception_layer_v2(576, ((96,), (128, 192), (160, 192), ("avg", 96)), "4d/"),
+        inception_layer_v2(576, ((0,), (128, 192), (192, 256), ("max", 0)), "4e/"),
+        inception_layer_v2(1024, ((352,), (192, 320), (160, 224), ("avg", 128)), "5a/"),
+        inception_layer_v2(1024, ((352,), (192, 320), (192, 224), ("max", 128)), "5b/"),
+        nn.SpatialAveragePooling(7, 7, 1, 1),
+    )
+    if has_dropout:
+        m.add(nn.Dropout(0.4))
+    m.add(nn.Reshape([1024]))
+    m.add(nn.Linear(1024, class_num).set_name("loss3/classifier"))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+Inception_v2 = build_v2

@@ -28,6 +28,7 @@ def _build_model(name: str, class_num: int):
         "resnet18": (lambda: resnet.build_imagenet(18, class_num), (224, 224, 3), class_num),
         "resnet20-cifar": (lambda: resnet.build_cifar(20, 10), (32, 32, 3), 10),
         "inception-v1": (lambda: inception.build(class_num), (224, 224, 3), class_num),
+        "inception-v2": (lambda: inception.build_v2(class_num), (224, 224, 3), class_num),
         "vgg16": (lambda: vgg.build(16, class_num), (224, 224, 3), class_num),
         "alexnet": (lambda: alexnet.build(class_num), (224, 224, 3), class_num),
     }

@@ -30,6 +30,7 @@ class Container(Module):
             self.add(m)
 
     def add(self, module: Module) -> "Container":
+        self._record_mutation("add", module)
         key = f"{len(self.modules)}_{module.key_name()}"
         self.modules.append(module)
         self._keys.append(key)

@@ -232,6 +232,7 @@ class ParallelCriterion(Criterion):
         self.repeat_target = repeat_target
 
     def add(self, criterion: Criterion, weight: float = 1.0) -> "ParallelCriterion":
+        self._record_mutation("add", criterion, weight)
         self.criterions.append(criterion)
         self.weights.append(weight)
         return self
@@ -257,6 +258,7 @@ class MultiCriterion(Criterion):
         self.weights = []
 
     def add(self, criterion: Criterion, weight: float = 1.0) -> "MultiCriterion":
+        self._record_mutation("add", criterion, weight)
         self.criterions.append(criterion)
         self.weights.append(weight)
         return self

@@ -14,8 +14,10 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from bigdl_tpu.nn.module import _SpecCaptured, _wrap_ctor_capture
 
-class InitializationMethod:
+
+class InitializationMethod(_SpecCaptured):
     def __call__(self, rng: jax.Array, shape, fan_in: int, fan_out: int, dtype=jnp.float32):
         raise NotImplementedError
 

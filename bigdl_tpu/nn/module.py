@@ -42,7 +42,46 @@ def _fold_rng(rng: Optional[jax.Array], i: int) -> Optional[jax.Array]:
     return None if rng is None else jax.random.fold_in(rng, i)
 
 
-class Module:
+def _wrap_ctor_capture(cls):
+    """Wrap ``cls.__init__`` so constructing any Module/Criterion records
+    ``self._ctor = (type(self), args, kwargs)`` — the raw material for
+    architecture serialization (reference:
+    utils/serializer/ModuleSerializer.scala — there every layer hand-codes
+    protobuf converters; capturing constructor args gives the same
+    information generically). Post-construction mutators (`set_name`,
+    `ceil`, `Container.add`) append to ``self._mutations`` (guarded by
+    ``_ctor_done``) and are replayed on load."""
+    orig = cls.__dict__.get("__init__")
+    if orig is None or getattr(orig, "_spec_wrapped", False):
+        return
+
+    def __init__(self, *args, _orig=orig, **kwargs):
+        first = "_ctor" not in self.__dict__
+        if first:
+            self.__dict__["_ctor"] = (type(self), args, kwargs)
+            self.__dict__["_ctor_done"] = False
+        _orig(self, *args, **kwargs)
+        if first:
+            self.__dict__["_ctor_done"] = True
+
+    __init__._spec_wrapped = True
+    __init__.__wrapped__ = orig
+    cls.__init__ = __init__
+
+
+class _SpecCaptured:
+    """Mixin: auto-capture constructor args on every subclass."""
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        _wrap_ctor_capture(cls)
+
+    def _record_mutation(self, method: str, *args) -> None:
+        if self.__dict__.get("_ctor_done", False):
+            self.__dict__.setdefault("_mutations", []).append((method, args))
+
+
+class Module(_SpecCaptured):
     """Base class for all modules.
 
     Subclasses override:
@@ -168,6 +207,7 @@ class Module:
     def set_name(self, name: str) -> "Module":
         self.name = name
         self._explicit_name = True
+        self._record_mutation("set_name", name)
         return self
 
     def key_name(self) -> str:
@@ -181,7 +221,7 @@ class Module:
         return f"{type(self).__name__}(name={self.name!r})"
 
 
-class Criterion:
+class Criterion(_SpecCaptured):
     """Loss-function base.
 
     Reference parity: nn/abstractnn/AbstractCriterion.scala — `forward`
@@ -199,3 +239,27 @@ class Criterion:
 
     def __repr__(self):
         return f"{type(self).__name__}()"
+
+
+
+def _save_module(self, directory: str, variables=None, name: str = "module"):
+    """Persist architecture+weights (reference: Module.saveModule)."""
+    from bigdl_tpu.serialization.module_serializer import save_module
+
+    if variables is None:
+        variables = self._variables
+    return save_module(directory, self, variables=variables, name=name)
+
+
+def _load_module(directory: str, name: str = "module"):
+    """(module, variables) from disk (reference: Module.loadModule)."""
+    from bigdl_tpu.serialization.module_serializer import load_module
+
+    module, variables = load_module(directory, name=name)
+    if variables is not None:
+        module._variables = variables
+    return module
+
+
+Module.save_module = _save_module
+Module.load_module = staticmethod(_load_module)

@@ -46,6 +46,7 @@ class SpatialMaxPooling(Module):
 
     def ceil(self) -> "SpatialMaxPooling":
         self.ceil_mode = True
+        self._record_mutation("ceil")
         return self
 
     def apply(self, variables, x, training=False, rng=None):
@@ -84,6 +85,7 @@ class SpatialAveragePooling(Module):
 
     def ceil(self) -> "SpatialAveragePooling":
         self.ceil_mode = True
+        self._record_mutation("ceil")
         return self
 
     def apply(self, variables, x, training=False, rng=None):

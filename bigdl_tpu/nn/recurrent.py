@@ -192,6 +192,7 @@ class Recurrent(Module):
         self.return_state = return_state
 
     def add(self, cell: Cell) -> "Recurrent":
+        self._record_mutation("add", cell)
         self.cell = cell
         return self
 

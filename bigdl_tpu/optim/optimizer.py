@@ -121,12 +121,26 @@ class Optimizer:
         self._resume = True
         return self
 
+    @staticmethod
+    def _coerce_summary(summary, cls):
+        if isinstance(summary, str):
+            return cls(summary, "bigdl_tpu")
+        if not hasattr(summary, "add_scalar"):
+            raise TypeError(
+                f"expected a {cls.__name__} (or a logdir string), got "
+                f"{type(summary).__name__}")
+        return summary
+
     def set_train_summary(self, summary) -> "Optimizer":
-        self.train_summary = summary
+        from bigdl_tpu.visualization import TrainSummary
+
+        self.train_summary = self._coerce_summary(summary, TrainSummary)
         return self
 
     def set_validation_summary(self, summary) -> "Optimizer":
-        self.validation_summary = summary
+        from bigdl_tpu.visualization import ValidationSummary
+
+        self.validation_summary = self._coerce_summary(summary, ValidationSummary)
         return self
 
     def set_constant_gradient_clipping(self, min_v: float, max_v: float) -> "Optimizer":

@@ -1,0 +1,8 @@
+"""Serialization (reference: utils/serializer/ + checkpoint flow §5.4)."""
+
+from bigdl_tpu.serialization.checkpoint import (
+    Checkpoint, load_pytree, save_pytree,
+)
+from bigdl_tpu.serialization.module_serializer import (
+    load_module, module_to_spec, save_module, spec_to_module,
+)

@@ -1,0 +1,233 @@
+"""Module (architecture + weights) serialization.
+
+Reference parity: utils/serializer/ModuleSerializer.scala /
+ModuleLoader / ModulePersister and the protobuf `bigdl.proto`
+(`BigDLModule`, `AttrValue`) — `Module.saveModule(path)` /
+`Module.loadModule(path)` round-trips any layer graph with its weights.
+
+TPU-first redesign: instead of one hand-written protobuf converter per
+layer (the reference's `DataConverter` zoo), the architecture spec is
+derived generically from captured constructor args
+(`nn/module.py#_SpecCaptured`) plus replayed mutators, emitted as JSON;
+weights ride the same npz+manifest container as checkpoints
+(serialization/checkpoint.py). `Graph` DAGs are encoded as a node table
+with input indices — the same shape as the reference's `BigDLModule.
+subModules` + pre/post edges.
+
+Loading only imports classes under the ``bigdl_tpu.`` namespace — a spec
+cannot name arbitrary importables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.serialization.checkpoint import load_pytree, save_pytree
+
+FORMAT_VERSION = 1
+_ALLOWED_PREFIX = "bigdl_tpu."
+
+
+def _class_ref(cls) -> str:
+    mod = cls.__module__
+    if not mod.startswith(_ALLOWED_PREFIX):
+        raise ValueError(
+            f"cannot serialize {cls!r}: class lives outside bigdl_tpu "
+            f"({mod}) — register a bigdl_tpu subclass instead")
+    return f"{mod}:{cls.__qualname__}"
+
+
+def _resolve(ref: str):
+    mod, _, qual = ref.partition(":")
+    if not (mod + ".").startswith(_ALLOWED_PREFIX):
+        raise ValueError(f"refusing to import {ref!r} (outside bigdl_tpu)")
+    obj = importlib.import_module(mod)
+    for part in qual.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def _encode(value) -> Any:
+    """Encode one ctor-arg value to JSON-able form."""
+    from bigdl_tpu.nn.graph import Graph, Node
+    from bigdl_tpu.nn.module import Criterion, Module
+
+    if isinstance(value, Graph):
+        return _encode_graph(value)
+    if isinstance(value, (Module, Criterion)):
+        return {"__kind__": "module", **module_to_spec(value)}
+    if isinstance(value, Node):
+        raise ValueError("raw graph Nodes only appear inside Graph specs")
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return {"__kind__": "dataclass",
+                "class": _class_ref(type(value)),
+                "fields": {k: _encode(v) for k, v in
+                           dataclasses.asdict(value).items()}}
+    if isinstance(value, np.ndarray):
+        return {"__kind__": "ndarray", "dtype": str(value.dtype),
+                "data": value.tolist()}
+    if isinstance(value, (np.integer,)):
+        return int(value)
+    if isinstance(value, (np.floating,)):
+        return float(value)
+    if isinstance(value, tuple):
+        return {"__kind__": "tuple", "items": [_encode(v) for v in value]}
+    if isinstance(value, list):
+        return [_encode(v) for v in value]
+    if isinstance(value, dict):
+        return {"__kind__": "dict",
+                "items": {k: _encode(v) for k, v in value.items()}}
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    # Last resort: objects with captured ctors (InitializationMethod etc.);
+    # ctor-less bigdl_tpu objects (e.g. Xavier()) rebuild with no args.
+    cls, args, kwargs = getattr(value, "_ctor", (type(value), (), {}))
+    return {"__kind__": "object", "class": _class_ref(cls),
+            "args": [_encode(a) for a in args],
+            "kwargs": {k: _encode(v) for k, v in kwargs.items()}}
+
+
+def _decode(value) -> Any:
+    if isinstance(value, dict):
+        kind = value.get("__kind__")
+        if kind == "module":
+            return spec_to_module(value)
+        if kind == "graph":
+            return _decode_graph(value)
+        if kind == "dataclass":
+            cls = _resolve(value["class"])
+            return cls(**{k: _decode(v) for k, v in value["fields"].items()})
+        if kind == "ndarray":
+            return np.asarray(value["data"], dtype=value["dtype"])
+        if kind == "tuple":
+            return tuple(_decode(v) for v in value["items"])
+        if kind == "dict":
+            return {k: _decode(v) for k, v in value["items"].items()}
+        if kind == "object":
+            cls = _resolve(value["class"])
+            return cls(*[_decode(a) for a in value["args"]],
+                       **{k: _decode(v) for k, v in value["kwargs"].items()})
+        raise ValueError(f"unknown spec kind {kind!r}")
+    if isinstance(value, list):
+        return [_decode(v) for v in value]
+    return value
+
+
+def _encode_graph(graph) -> Dict[str, Any]:
+    """Graph → node table with input indices (reference:
+    serializer flattens Graph into subModules + preModules/nextModules)."""
+    order = graph._order
+    index = {id(n): i for i, n in enumerate(order)}
+    nodes = []
+    for n in order:
+        nodes.append({
+            "module": None if n.module is None else module_to_spec(n.module),
+            "inputs": [index[id(p)] for p in n.inputs],
+        })
+    return {
+        "__kind__": "graph",
+        "class": _class_ref(type(graph)),
+        "nodes": nodes,
+        "input_nodes": [index[id(n)] for n in graph.input_nodes],
+        "output_nodes": [index[id(n)] for n in graph.output_nodes],
+        "name": graph.name if graph._explicit_name else None,
+        # pytree keys per topo-order node (None for Input) — persisted so
+        # post-wiring renames can't shift keys away from saved weights
+        "keys": [graph._keys.get(id(n)) for n in order],
+    }
+
+
+def _decode_graph(spec):
+    from bigdl_tpu.nn.graph import Node
+
+    cls = _resolve(spec["class"])
+    nodes: List[Node] = []
+    for ns in spec["nodes"]:
+        mod = None if ns["module"] is None else spec_to_module(ns["module"])
+        nodes.append(Node(mod, [nodes[i] for i in ns["inputs"]]))
+    graph = cls([nodes[i] for i in spec["input_nodes"]],
+                [nodes[i] for i in spec["output_nodes"]],
+                name=spec["name"])
+    keys = spec.get("keys")
+    if keys is not None:
+        # `nodes` is aligned with the saved spec order (the original
+        # graph's topo order), independent of the rebuilt _order.
+        graph._keys = {id(n): k for n, k in zip(nodes, keys)
+                       if k is not None}
+    return graph
+
+
+def module_to_spec(module) -> Dict[str, Any]:
+    """Architecture of a module as a JSON-able dict."""
+    from bigdl_tpu.nn.graph import Graph
+
+    if isinstance(module, Graph):
+        return _encode_graph(module)
+    cls, args, kwargs = getattr(module, "_ctor", (type(module), (), {}))
+    spec: Dict[str, Any] = {
+        "class": _class_ref(cls),
+        "args": [_encode(a) for a in args],
+        "kwargs": {k: _encode(v) for k, v in kwargs.items()},
+    }
+    muts = getattr(module, "_mutations", None)
+    if muts:
+        spec["mutations"] = [
+            {"method": m, "args": [_encode(a) for a in a_]}
+            for m, a_ in muts]
+    # Containers snapshot child pytree keys at add-time; replaying a
+    # post-add set_name would recompute them differently, so persist the
+    # exact key list and restore it verbatim on load.
+    keys = getattr(module, "_keys", None)
+    if isinstance(keys, list):
+        spec["keys"] = list(keys)
+    return spec
+
+
+def spec_to_module(spec: Dict[str, Any]):
+    if spec.get("__kind__") == "graph":
+        return _decode_graph(spec)
+    cls = _resolve(spec["class"])
+    module = cls(*[_decode(a) for a in spec["args"]],
+                 **{k: _decode(v) for k, v in spec["kwargs"].items()})
+    for mut in spec.get("mutations", ()):
+        getattr(module, mut["method"])(*[_decode(a) for a in mut["args"]])
+    if "keys" in spec:
+        module._keys = list(spec["keys"])
+    return module
+
+
+def save_module(directory: str, module, variables: Optional[Dict] = None,
+                name: str = "module") -> str:
+    """Persist architecture (+ optionally weights) — the reference's
+    `Module.saveModule` (utils/serializer/ModulePersister.scala)."""
+    os.makedirs(directory, exist_ok=True)
+    spec = {"format_version": FORMAT_VERSION, "spec": module_to_spec(module)}
+    path = os.path.join(directory, name + ".json")
+    with open(path, "w") as f:
+        json.dump(spec, f, indent=1)
+    if variables is not None:
+        save_pytree(directory, name + "_vars", variables)
+    return directory
+
+
+def load_module(directory: str, name: str = "module",
+                with_variables: bool = True):
+    """Inverse of save_module — the reference's `Module.loadModule`
+    (utils/serializer/ModuleLoader.scala). Returns (module, variables);
+    variables is None when no weights were saved."""
+    with open(os.path.join(directory, name + ".json")) as f:
+        payload = json.load(f)
+    if payload.get("format_version", 0) > FORMAT_VERSION:
+        raise ValueError("module file written by a newer format version")
+    module = spec_to_module(payload["spec"])
+    variables = None
+    if with_variables and os.path.exists(
+            os.path.join(directory, name + "_vars.json")):
+        variables, _ = load_pytree(directory, name + "_vars")
+    return module, variables

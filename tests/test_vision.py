@@ -1,0 +1,121 @@
+"""Vision ImageFrame pipeline tests (reference: transform/vision/image/
+specs — see SURVEY.md §2.4 Vision ImageFrame row)."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset import vision
+from bigdl_tpu.dataset.vision import (
+    AspectScale, Brightness, CenterCrop, ChannelNormalize, Contrast, HFlip,
+    ImageFeature, ImageFrame, ImageFrameToSample, MatToTensor, PixelNormalizer,
+    RandomCrop, RandomTransformer, Resize, Saturation,
+)
+
+
+def _img(h=8, w=6, c=3, seed=0):
+    return np.random.default_rng(seed).uniform(0, 255, (h, w, c)).astype(
+        np.float32)
+
+
+def test_resize_shapes_and_identity():
+    img = _img(8, 6)
+    out = Resize(4, 3).transform_image(img)
+    assert out.shape == (4, 3, 3)
+    same = Resize(8, 6).transform_image(img)
+    np.testing.assert_allclose(same, img)
+
+
+def test_resize_bilinear_constant_preserved():
+    img = np.full((5, 7, 3), 42.0, np.float32)
+    out = Resize(9, 4).transform_image(img)
+    np.testing.assert_allclose(out, 42.0, rtol=1e-6)
+
+
+def test_aspect_scale_short_side():
+    img = _img(10, 20)
+    out = AspectScale(5).transform_image(img)
+    assert out.shape == (5, 10, 3)
+
+
+def test_center_and_random_crop():
+    img = _img(10, 10)
+    assert CenterCrop(4, 6).transform_image(img).shape == (4, 6, 3)
+    out = RandomCrop(4, 6, seed=0).transform_image(img)
+    assert out.shape == (4, 6, 3)
+
+
+def test_hflip():
+    img = _img()
+    np.testing.assert_allclose(HFlip().transform_image(img), img[:, ::-1])
+
+
+def test_photometric_ranges():
+    img = _img()
+    out = Brightness(5.0, 5.0, seed=0).transform_image(img)
+    np.testing.assert_allclose(out, img + 5.0, rtol=1e-6)
+    out = Contrast(2.0, 2.0, seed=0).transform_image(img)
+    np.testing.assert_allclose(out, img * 2.0, rtol=1e-6)
+    # saturation with alpha=1 is identity
+    out = Saturation(1.0, 1.0, seed=0).transform_image(img)
+    np.testing.assert_allclose(out, img, rtol=1e-5)
+
+
+def test_channel_normalize_and_pixel_normalizer():
+    img = _img()
+    mean, std = [1.0, 2.0, 3.0], [2.0, 2.0, 2.0]
+    out = ChannelNormalize(mean, std).transform_image(img)
+    np.testing.assert_allclose(out, (img - np.array(mean)) / 2.0, rtol=1e-6)
+    out = PixelNormalizer(img).transform_image(img)
+    np.testing.assert_allclose(out, 0.0, atol=1e-6)
+
+
+def test_mat_to_tensor_chw():
+    img = _img(4, 5, 3)
+    assert MatToTensor(to_chw=True).transform_image(img).shape == (3, 4, 5)
+
+
+def test_random_transformer_prob_extremes():
+    img = _img()
+    f = ImageFeature(img.copy())
+    never = RandomTransformer(HFlip(), 0.0, seed=0).transform_feature(f)
+    np.testing.assert_allclose(never.image, img)
+    always = RandomTransformer(HFlip(), 1.0, seed=0).transform_feature(
+        ImageFeature(img.copy()))
+    np.testing.assert_allclose(always.image, img[:, ::-1])
+
+
+def test_frame_transform_chain_and_to_sample():
+    imgs = np.stack([_img(10, 10, 3, seed=i) for i in range(4)])
+    labels = np.arange(4)
+    frame = ImageFrame.from_arrays(imgs, labels)
+    chain = Resize(8, 8) >> CenterCrop(6, 6) >> \
+        ChannelNormalize([0.0] * 3, [255.0] * 3) >> MatToTensor()
+    out = frame.transform(chain)
+    assert len(out) == 4
+    samples = out.to_samples()
+    assert samples[0].feature.shape == (6, 6, 3)
+    assert int(samples[2].label) == 2
+
+
+def test_error_isolation_marks_invalid():
+    class Boom(vision.FeatureTransformer):
+        def transform_image(self, img):
+            raise RuntimeError("boom")
+
+    frame = ImageFrame.from_arrays(np.zeros((2, 4, 4, 3), np.float32),
+                                   np.arange(2))
+    out = frame.transform(Boom())
+    assert all(not f.is_valid for f in out)
+    assert out.to_samples() == []
+    # terminal stage drops invalid
+    assert list(ImageFrameToSample()(iter(out.features))) == []
+
+
+def test_image_frame_read_roundtrip(tmp_path):
+    img = _img(5, 5)
+    np.save(tmp_path / "a.npy", img)
+    (tmp_path / "a.label").write_text("7")
+    frame = ImageFrame.read(str(tmp_path), with_label=True)
+    assert len(frame) == 1
+    np.testing.assert_allclose(frame.features[0].image, img)
+    assert frame.features[0][ImageFeature.LABEL] == 7
