@@ -18,6 +18,7 @@ batch at the jit boundary.
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
@@ -91,8 +92,15 @@ class FeatureTransformer(Transformer):
         for feature in it:
             try:
                 yield self.transform_feature(feature)
-            except Exception:
+            except Exception as e:
+                # isolate the bad feature but leave a trail — a systematic
+                # misconfiguration would otherwise silently empty the set
+                # (reference: FeatureTransformer logs on invalidation)
                 feature[ImageFeature.VALID] = False
+                feature["error"] = f"{type(self).__name__}: {e}"
+                logging.getLogger(__name__).warning(
+                    "%s failed on feature %s: %s", type(self).__name__,
+                    feature.get(ImageFeature.URI, "<in-memory>"), e)
                 yield feature
 
     # `a -> b` composition of the reference keeps working via `>>`
@@ -104,6 +112,8 @@ class FeatureTransformer(Transformer):
 def _bilinear_resize(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
     """Pure-numpy bilinear resize, align_corners=False convention."""
     h, w = img.shape[:2]
+    if img.ndim == 2:
+        img = img[:, :, None]
     if (h, w) == (out_h, out_w):
         return img.astype(np.float32, copy=False)
     ys = (np.arange(out_h, dtype=np.float32) + 0.5) * (h / out_h) - 0.5
@@ -115,8 +125,6 @@ def _bilinear_resize(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
     wy = np.clip(ys - y0, 0.0, 1.0)[:, None, None]
     wx = np.clip(xs - x0, 0.0, 1.0)[None, :, None]
     img = img.astype(np.float32, copy=False)
-    if img.ndim == 2:
-        img = img[:, :, None]
     row0, row1 = img[y0], img[y1]
     top = row0[:, x0] * (1 - wx) + row0[:, x1] * wx
     bot = row1[:, x0] * (1 - wx) + row1[:, x1] * wx

@@ -14,7 +14,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from bigdl_tpu.nn.module import _SpecCaptured, _wrap_ctor_capture
+from bigdl_tpu.nn.module import _SpecCaptured
 
 
 class InitializationMethod(_SpecCaptured):

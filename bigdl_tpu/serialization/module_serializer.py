@@ -49,7 +49,16 @@ def _resolve(ref: str):
         raise ValueError(f"refusing to import {ref!r} (outside bigdl_tpu)")
     obj = importlib.import_module(mod)
     for part in qual.split("."):
+        # each traversal step must stay on classes DEFINED in bigdl_tpu —
+        # otherwise a crafted spec could walk through a module-level import
+        # (e.g. `module:os.system`) into arbitrary callables
         obj = getattr(obj, part)
+        if not (isinstance(obj, type)
+                and (getattr(obj, "__module__", "") + ".").startswith(
+                    _ALLOWED_PREFIX)):
+            raise ValueError(
+                f"refusing to resolve {ref!r}: {part!r} is not a "
+                f"bigdl_tpu class")
     return obj
 
 
@@ -81,8 +90,14 @@ def _encode(value) -> Any:
     if isinstance(value, list):
         return [_encode(v) for v in value]
     if isinstance(value, dict):
+        if all(isinstance(k, str) for k in value):
+            return {"__kind__": "dict",
+                    "items": {k: _encode(v) for k, v in value.items()}}
+        # non-string keys would be silently stringified by JSON — keep
+        # them as encoded pairs so e.g. int-keyed maps round-trip intact
         return {"__kind__": "dict",
-                "items": {k: _encode(v) for k, v in value.items()}}
+                "pairs": [[_encode(k), _encode(v)]
+                          for k, v in value.items()]}
     if value is None or isinstance(value, (bool, int, float, str)):
         return value
     # Last resort: objects with captured ctors (InitializationMethod etc.);
@@ -108,6 +123,8 @@ def _decode(value) -> Any:
         if kind == "tuple":
             return tuple(_decode(v) for v in value["items"])
         if kind == "dict":
+            if "pairs" in value:
+                return {_decode(k): _decode(v) for k, v in value["pairs"]}
             return {k: _decode(v) for k, v in value["items"].items()}
         if kind == "object":
             cls = _resolve(value["class"])

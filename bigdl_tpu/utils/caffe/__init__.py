@@ -1,0 +1,9 @@
+"""Caffe model interop (reference: utils/caffe/ — CaffeLoader.scala,
+CaffePersister.scala, Converter.scala)."""
+
+from bigdl_tpu.utils.caffe.loader import (  # noqa: F401
+    CaffeLoader,
+    CaffePersister,
+    load,
+    persist,
+)
