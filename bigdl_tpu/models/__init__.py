@@ -1,5 +1,6 @@
 """Model zoo (reference: bigdl/models/)."""
 
 from bigdl_tpu.models import (
-    alexnet, autoencoder, inception, lenet, resnet, rnn, vgg,
+    alexnet, autoencoder, inception, lenet, ncf, resnet, rnn,
+    textclassifier, vgg,
 )

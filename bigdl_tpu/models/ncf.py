@@ -1,0 +1,53 @@
+"""Neural Collaborative Filtering (NCF / NeuralCF).
+
+Reference parity: the BigDL paper's headline recommendation benchmark
+(arXiv 1804.05839 §evaluation, NCF vs GPU comparison; model shape per the
+reference line's `NeuralCF` — GMF + MLP towers over user/item embeddings,
+evaluated with HitRatio/NDCG which live in `bigdl_tpu.optim.validation`).
+
+Input is an int array (batch, 2) of [user_id, item_id] pairs (0-based);
+output is log-probabilities over `class_num` rating classes, trained with
+`ClassNLLCriterion` like the reference. The two embedding towers are pure
+gathers + an MLP — everything XLA fuses into a handful of MXU matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from bigdl_tpu import nn
+
+
+def build(user_count: int, item_count: int, class_num: int = 5,
+          user_embed: int = 20, item_embed: int = 20,
+          hidden_layers: Sequence[int] = (40, 20, 10),
+          include_mf: bool = True, mf_embed: int = 20) -> "nn.Graph":
+    """GMF ⊙ + MLP concat tower, mirroring NeuralCF's constructor shape."""
+    pair = nn.Input()
+    user = nn.Select(2, 1)(pair)   # (B,) user ids
+    item = nn.Select(2, 2)(pair)   # (B,) item ids
+
+    # MLP tower: concat(user_emb, item_emb) -> hidden ReLU stack
+    u_mlp = nn.LookupTable(user_count, user_embed)(user)
+    i_mlp = nn.LookupTable(item_count, item_embed)(item)
+    h = nn.JoinTable(2)(u_mlp, i_mlp)
+    in_dim = user_embed + item_embed
+    for out_dim in hidden_layers:
+        h = nn.Linear(in_dim, out_dim)(h)
+        h = nn.ReLU()(h)
+        in_dim = out_dim
+
+    if include_mf:
+        # GMF tower: elementwise product of dedicated MF embeddings
+        u_mf = nn.LookupTable(user_count, mf_embed)(user)
+        i_mf = nn.LookupTable(item_count, mf_embed)(item)
+        gmf = nn.CMulTable()(u_mf, i_mf)
+        h = nn.JoinTable(2)(gmf, h)
+        in_dim = in_dim + mf_embed
+
+    score = nn.Linear(in_dim, class_num)(h)
+    out = nn.LogSoftMax()(score)
+    return nn.Graph(pair, out)
+
+
+NeuralCF = build

@@ -1,0 +1,59 @@
+"""Text-classification CNN (news20-style).
+
+Reference parity: example/textclassification (TextClassifier.scala) — GloVe
+embeddings → temporal conv(128, k=5) → ReLU → temporal max-pool(5) ×2 →
+global pool → linear(128) → linear(classNum) → logsoftmax.
+
+Here the embedding is a trainable `LookupTable` (optionally initialised from
+pretrained vectors via `set_embedding`); input is int token ids
+(batch, seq_len). The temporal convs lower onto the MXU (see
+nn.TemporalConvolution).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bigdl_tpu import nn
+
+
+def build(class_num: int = 20, vocab_size: int = 20000,
+          sequence_len: int = 500, embedding_dim: int = 100,
+          filters: int = 128) -> nn.Sequential:
+    pooled = sequence_len
+    model = nn.Sequential(
+        nn.LookupTable(vocab_size, embedding_dim).set_name("embedding"),
+    )
+    in_dim = embedding_dim
+    for i in range(2):
+        model.add(nn.TemporalConvolution(in_dim, filters, 5)
+                  .set_name(f"conv{i + 1}"))
+        model.add(nn.ReLU())
+        model.add(nn.TemporalMaxPooling(5, 5))
+        pooled = (pooled - 5 + 1) // 5
+        in_dim = filters
+    model.add(nn.TemporalConvolution(in_dim, filters, 5).set_name("conv3"))
+    model.add(nn.ReLU())
+    model.add(nn.TemporalMaxPooling(-1))  # global max over time
+    model.add(nn.Reshape([filters]))
+    model.add(nn.Linear(filters, 100).set_name("fc1"))
+    model.add(nn.ReLU())
+    model.add(nn.Linear(100, class_num).set_name("score"))
+    model.add(nn.LogSoftMax())
+    return model
+
+
+def set_embedding(variables: dict, vectors: np.ndarray) -> dict:
+    """Install pretrained embedding vectors (e.g. GloVe) into `variables`
+    (the reference bakes GloVe weights into the LookupTable the same way)."""
+    params = dict(variables["params"])
+    key = next(k for k in params if k.endswith("_embedding"))
+    emb = dict(params[key])
+    assert emb["weight"].shape == vectors.shape, (
+        f"{emb['weight'].shape} vs {vectors.shape}")
+    emb["weight"] = vectors.astype(np.float32)
+    params[key] = emb
+    return {**variables, "params": params}
+
+
+TextClassifier = build

@@ -16,9 +16,11 @@ from bigdl_tpu.nn.initialization import (
 from bigdl_tpu.nn.linear import Linear, Bilinear, CMul, CAdd
 from bigdl_tpu.nn.conv import (
     SpatialConvolution, SpatialShareConvolution, SpatialDilatedConvolution,
-    SpatialFullConvolution,
+    SpatialFullConvolution, TemporalConvolution,
 )
-from bigdl_tpu.nn.pooling import SpatialMaxPooling, SpatialAveragePooling
+from bigdl_tpu.nn.pooling import (
+    SpatialMaxPooling, SpatialAveragePooling, TemporalMaxPooling,
+)
 from bigdl_tpu.nn.normalization import (
     BatchNormalization, SpatialBatchNormalization, SpatialCrossMapLRN, Normalize,
     LayerNorm, RMSNorm,

@@ -193,3 +193,50 @@ class SpatialFullConvolution(Module):
         if self.with_bias:
             y = y + p["bias"]
         return y, variables["state"]
+
+
+class TemporalConvolution(Module):
+    """1-D convolution over (batch, time, frame) input (reference:
+    nn/TemporalConvolution.scala — inputFrameSize, outputFrameSize,
+    kernelW, strideW). Lowered to `lax.conv_general_dilated` with a
+    singleton spatial dim so XLA maps it onto the MXU like any conv.
+    """
+
+    def __init__(self, input_frame_size: int, output_frame_size: int,
+                 kernel_w: int, stride_w: int = 1,
+                 w_init: Optional[InitializationMethod] = None,
+                 b_init: Optional[InitializationMethod] = None,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w
+        self.w_init = w_init or Xavier()
+        self.b_init = b_init or Zeros()
+
+    def init_params(self, rng):
+        wk, bk = jax.random.split(rng)
+        fan_in = self.input_frame_size * self.kernel_w
+        fan_out = self.output_frame_size * self.kernel_w
+        return {
+            "weight": self.w_init(
+                wk, (self.kernel_w, self.input_frame_size,
+                     self.output_frame_size),
+                fan_in=fan_in, fan_out=fan_out),
+            "bias": self.b_init(bk, (self.output_frame_size,),
+                                fan_in=fan_in, fan_out=fan_out),
+        }
+
+    def apply(self, variables, x, training=False, rng=None):
+        p = variables["params"]
+        # (B, T, C) -> (B, 1, T, C), kernel (1, KW, I, O)
+        dn = lax.conv_dimension_numbers(
+            (1, 1, 1, self.input_frame_size),
+            (1, self.kernel_w, self.input_frame_size, self.output_frame_size),
+            ("NHWC", "HWIO", "NHWC"))
+        y = lax.conv_general_dilated(
+            x[:, None, :, :], p["weight"][None, :, :, :],
+            window_strides=(1, self.stride_w), padding="VALID",
+            dimension_numbers=dn)
+        return y[:, 0, :, :] + p["bias"], variables["state"]

@@ -105,3 +105,23 @@ class SpatialAveragePooling(Module):
             cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
             y = s / jnp.maximum(cnt, 1.0)
         return y, variables["state"]
+
+
+class TemporalMaxPooling(Module):
+    """1-D max pooling over (batch, time, frame) input (reference:
+    nn/TemporalMaxPooling.scala — kW, dW). `kernel_w=-1` pools over the
+    whole time axis (the text-classifier's global max-pool idiom)."""
+
+    def __init__(self, kernel_w: int, stride_w: Optional[int] = None,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w if stride_w is not None else kernel_w
+
+    def apply(self, variables, x, training=False, rng=None):
+        kw = x.shape[1] if self.kernel_w == -1 else self.kernel_w
+        sw = x.shape[1] if self.kernel_w == -1 else self.stride_w
+        y = lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, kw, 1), (1, sw, 1),
+            [(0, 0), (0, 0), (0, 0)])
+        return y, variables["state"]

@@ -26,6 +26,7 @@ import numpy as np
 from bigdl_tpu import nn
 from bigdl_tpu.nn.graph import Graph, Input, Node
 from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.table import T
 
 from bigdl_tpu.utils.caffe import bigdl_caffe_pb2 as pb
 
@@ -161,14 +162,21 @@ class CaffeLoader:
 
     # ---- layer converters ---------------------------------------------
 
-    def _convert(self, layer: _Layer, blobs: List[Any], rank: int
+    def _convert(self, layer: _Layer, blobs: List[Any], rank: int,
+                 in_shape: Optional[Sequence[int]] = None,
                  ) -> Tuple[Module, Optional[Dict[str, Any]], int]:
-        """→ (module, variables | None for stateless, output_rank)."""
+        """→ (module, variables | None for stateless, output_rank).
+
+        `in_shape` is the bottom blob's NHWC shape when known — needed to
+        fresh-initialize Convolution/InnerProduct layers that have no
+        weights in the caffemodel (reference: CaffeLoader.copyParameters
+        matches by name; unmatched layers keep their init).
+        """
         t, p = layer.type, layer.proto
         if t == "Convolution":
-            return self._conv(p, blobs) + (4,)
+            return self._conv(p, blobs, in_shape) + (4,)
         if t == "InnerProduct":
-            return self._inner_product(p, blobs, rank) + (2,)
+            return self._inner_product(p, blobs, rank, in_shape) + (2,)
         if t == "Pooling":
             return self._pooling(p.pooling_param), None, 4
         if t in ("ReLU", "ReLU6"):
@@ -180,7 +188,14 @@ class CaffeLoader:
             return nn.Tanh(), None, rank
         if t == "Sigmoid":
             return nn.Sigmoid(), None, rank
-        if t in ("Softmax", "SoftmaxWithLoss"):
+        if t in ("Softmax", "SoftmaxWithLoss", "SigmoidCrossEntropyLoss",
+                 "EuclideanLoss", "HingeLoss"):
+            # loss layers degrade to their prediction op (label bottoms are
+            # dropped by the caller); plain Euclidean/Hinge pass through
+            if t in ("EuclideanLoss", "HingeLoss"):
+                return nn.Identity(), None, rank
+            if t == "SigmoidCrossEntropyLoss":
+                return nn.Sigmoid(), None, rank
             return nn.SoftMax(), None, rank
         if t == "LRN":
             lp = p.lrn_param
@@ -203,6 +218,8 @@ class CaffeLoader:
         if t == "Concat":
             axis = p.concat_param.axis if p.concat_param.HasField("axis") \
                 else p.concat_param.concat_dim
+            if axis < 0:  # caffe allows negative axes, counted from the end
+                axis += rank
             dim = _NCHW_TO_NHWC_DIM[axis] if rank == 4 else axis + 1
             return nn.JoinTable(dimension=dim, n_input_dims=rank), None, rank
         if t == "Eltwise":
@@ -232,7 +249,7 @@ class CaffeLoader:
         seq.add(nn.Reshape((-1,), batch_mode=True))
         return seq
 
-    def _conv(self, p, blobs):
+    def _conv(self, p, blobs, in_shape=None):
         cp = p.convolution_param
         kh = int(cp.kernel_h or (cp.kernel_size[0] if cp.kernel_size else 1))
         kw = int(cp.kernel_w or (cp.kernel_size[-1] if cp.kernel_size else 1))
@@ -244,8 +261,21 @@ class CaffeLoader:
         n_out = int(cp.num_output)
         group = int(cp.group)
         if not blobs:
-            raise ValueError("Convolution needs weights (pass a caffemodel "
-                             "or load via prototxt+init)")
+            # unmatched layer: fresh init, channels from the bottom shape
+            if in_shape is None or len(in_shape) != 4:
+                raise ValueError(
+                    "Convolution without weights needs a known input shape "
+                    "(declare input_shape in the prototxt)")
+            n_in = int(in_shape[-1])
+            if dil > 1:
+                m = nn.SpatialDilatedConvolution(
+                    n_in, n_out, kw, kh, sw, sh, pw, ph,
+                    dilation_w=dil, dilation_h=dil, with_bias=cp.bias_term)
+            else:
+                m = nn.SpatialConvolution(
+                    n_in, n_out, kw, kh, sw, sh, pw, ph, n_group=group,
+                    with_bias=cp.bias_term)
+            return m, None
         w = _blob_array(blobs[0])  # (O, I/g, kH, kW)
         n_in = int(w.shape[1]) * group
         if dil > 1:
@@ -261,15 +291,32 @@ class CaffeLoader:
             params["bias"] = _blob_array(blobs[1]).reshape(-1)
         return m, {"params": params, "state": {}}
 
-    def _inner_product(self, p, blobs, rank):
+    def _inner_product(self, p, blobs, rank, in_shape=None):
         ip = p.inner_product_param
+        n_out = int(ip.num_output)
         if not blobs:
-            raise ValueError("InnerProduct needs weights")
-        w = _blob_array(blobs[0]).reshape(int(ip.num_output), -1)
+            # unmatched layer: fresh init, fan-in from the bottom shape
+            if in_shape is None:
+                raise ValueError(
+                    "InnerProduct without weights needs a known input shape "
+                    "(declare input_shape in the prototxt)")
+            n_in = 1
+            for d in in_shape[1:]:
+                n_in *= int(d)
+            lin = nn.Linear(n_in, n_out, with_bias=ip.bias_term)
+            if rank == 4:
+                seq = self._flatten()
+                seq.add(lin)
+                return seq, None
+            return lin, None
         if ip.transpose:
-            w = w.T.copy()
+            # blob stored input-major (K, num_output); use as-is after
+            # reshaping in that orientation (caffe InnerProduct transpose)
+            w = _blob_array(blobs[0]).reshape(-1, n_out).T.copy()
+        else:
+            w = _blob_array(blobs[0]).reshape(n_out, -1)
         n_in = w.shape[1]
-        lin = nn.Linear(n_in, int(ip.num_output), with_bias=ip.bias_term)
+        lin = nn.Linear(n_in, n_out, with_bias=ip.bias_term)
         params = {"weight": w.T}  # (O, I) → (I, O)
         if ip.bias_term:
             params["bias"] = _blob_array(blobs[1]).reshape(-1)
@@ -297,10 +344,12 @@ class CaffeLoader:
         sw = int(pp.stride_w or pp.stride)
         ph = int(pp.pad_h or pp.pad)
         pw = int(pp.pad_w or pp.pad)
-        # Caffe pooling always rounds output size UP (ceil semantics)
+        # Caffe pooling rounds output size UP by default (ceil semantics);
+        # round_mode=FLOOR (upstream caffe.proto field 13) opts out
+        ceil = pp.round_mode != pb.PoolingParameter.FLOOR
         cls = nn.SpatialMaxPooling if is_max else nn.SpatialAveragePooling
         m = cls(kernel_w=kw, kernel_h=kh, stride_w=sw, stride_h=sh,
-                pad_w=pw, pad_h=ph, ceil_mode=True)
+                pad_w=pw, pad_h=ph, ceil_mode=ceil)
         return m
 
     @staticmethod
@@ -345,16 +394,24 @@ class CaffeLoader:
     def load(self) -> Tuple[Graph, Dict[str, Any]]:
         import jax
 
+        import jax.numpy as jnp
+
         net, weights = self._read()
         blob_node: Dict[str, Node] = {}
         blob_rank: Dict[str, int] = {}
+        blob_shape: Dict[str, Optional[Tuple[int, ...]]] = {}
         input_nodes: List[Node] = []
         node_vars: Dict[int, Dict[str, Any]] = {}
 
-        def add_input(name: str, shape: Sequence[int]):
+        def to_nhwc(shape):
+            s = tuple(int(d) for d in shape)
+            return (s[0], s[2], s[3], s[1]) if len(s) == 4 else s
+
+        def add_input(name: str, shape: Optional[Sequence[int]]):
             node = Input()
             blob_node[name] = node
             blob_rank[name] = len(shape) if shape else 4
+            blob_shape[name] = to_nhwc(shape) if shape else None
             input_nodes.append(node)
 
         # net-level inputs (input/input_shape/input_dim prototxt style)
@@ -364,8 +421,23 @@ class CaffeLoader:
             elif net.input_dim:
                 shape = tuple(net.input_dim[4 * i:4 * i + 4])
             else:
-                shape = (1, 1, 1, 1)
+                shape = None
             add_input(name, shape)
+
+        def out_shape(module, variables, in_shapes):
+            """Abstract-eval the module to get its output NHWC shape."""
+            if any(s is None for s in in_shapes):
+                return None
+            try:
+                xs = [jax.ShapeDtypeStruct(tuple(s), jnp.float32)
+                      for s in in_shapes]
+                args = xs if len(xs) == 1 else [T(*xs)]
+                res = jax.eval_shape(
+                    lambda v, *a: module.apply(v, *a, training=False)[0],
+                    variables, *args)
+                return tuple(res.shape)
+            except Exception:
+                return None
 
         for layer in _iter_layers(net):
             if not _test_phase(layer):
@@ -373,7 +445,7 @@ class CaffeLoader:
             if layer.type in _SKIP_TYPES:
                 continue
             if layer.type in _DATA_TYPES:
-                shape = (1, 1, 1, 1)
+                shape = None
                 ipp = getattr(layer.proto, "input_param", None)
                 if ipp is not None and ipp.shape:
                     shape = tuple(ipp.shape[0].dim)
@@ -382,14 +454,18 @@ class CaffeLoader:
                 for extra in layer.tops[1:]:
                     blob_node[extra] = blob_node[layer.tops[0]]
                     blob_rank[extra] = 1
+                    blob_shape[extra] = None
                 continue
             if layer.type == "Split":
                 src = blob_node[layer.bottoms[0]]
                 for top in layer.tops:
                     blob_node[top] = src
                     blob_rank[top] = blob_rank[layer.bottoms[0]]
+                    blob_shape[top] = blob_shape.get(layer.bottoms[0])
                 continue
             bottoms = [b for b in layer.bottoms if b in blob_node]
+            if layer.type.endswith("Loss") and bottoms:
+                bottoms = bottoms[:1]  # drop label/weight bottoms
             if not bottoms:
                 raise ValueError(f"layer {layer.name}: unknown bottoms "
                                  f"{layer.bottoms}")
@@ -397,7 +473,8 @@ class CaffeLoader:
             blobs = list(layer.blobs) or weights.get(layer.name, [])
             if not blobs and layer.type in ("Convolution", "InnerProduct"):
                 self.unmatched.append(layer.name)
-            module, variables, out_rank = self._convert(layer, blobs, rank)
+            module, variables, out_rank = self._convert(
+                layer, blobs, rank, blob_shape.get(bottoms[0]))
             module.set_name(layer.name)
             parents = [blob_node[b] for b in bottoms]
             node = Node.wire(module, parents)
@@ -406,11 +483,17 @@ class CaffeLoader:
             top = layer.tops[0] if layer.tops else layer.name
             blob_node[top] = node
             blob_rank[top] = out_rank
+            shape_vars = variables if variables is not None else \
+                jax.eval_shape(module.init, jax.random.PRNGKey(0))
+            blob_shape[top] = out_shape(
+                module, shape_vars, [blob_shape.get(b) for b in bottoms])
 
-        # graph outputs: blobs never consumed as bottoms
+        # graph outputs: blobs never consumed as bottoms of real layers
+        # (skipped layers like Accuracy must not hide a terminal blob)
         consumed = set()
         for layer in _iter_layers(net):
-            if _test_phase(layer) and layer.type not in _DATA_TYPES:
+            if _test_phase(layer) and layer.type not in _DATA_TYPES \
+                    and layer.type not in _SKIP_TYPES:
                 consumed.update(layer.bottoms)
         outputs = [n for b, n in blob_node.items()
                    if b not in consumed and not (n in input_nodes)]
@@ -572,9 +655,14 @@ class CaffePersister:
             blob_of[i + n_entries - 1] = top
             return n_entries
 
-        # flatten idiom: Transpose((2,4),(3,4)) then Reshape((-1,))
+        # flatten idiom: exactly Transpose((2,4),(3,4)) then Reshape((-1,))
+        # (the NHWC→NCHW + flatten pair _flatten() emits) — anything else
+        # keeps its own layers
         if isinstance(mod, nn.Transpose) and i + 1 < len(entries) and \
-                isinstance(entries[i + 1][0], nn.Reshape):
+                mod.permutations == [(2, 4), (3, 4)] and \
+                isinstance(entries[i + 1][0], nn.Reshape) and \
+                entries[i + 1][0].size == (-1,) and \
+                entries[i + 1][0].batch_mode is not False:
             l, top = self._new_layer(net, "Flatten", mod.name,
                                      bots)
             blob_of[i] = top
@@ -615,6 +703,8 @@ class CaffePersister:
             pp.kernel_h, pp.kernel_w = mod.kernel_h, mod.kernel_w
             pp.stride_h, pp.stride_w = mod.stride_h, mod.stride_w
             pp.pad_h, pp.pad_w = mod.pad_h, mod.pad_w
+            if not mod.ceil_mode:
+                pp.round_mode = pb.PoolingParameter.FLOOR
             return finish(l, top)
         simple = {nn.ReLU: "ReLU", nn.Tanh: "TanH", nn.Sigmoid: "Sigmoid",
                   nn.SoftMax: "Softmax"}

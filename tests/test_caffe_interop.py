@@ -262,3 +262,124 @@ def test_unsupported_layer_raises(tmp_path):
     path.write_bytes(net.SerializeToString())
     with pytest.raises(NotImplementedError, match="FancyNewLayer"):
         caffe.load(model_path=str(path))
+
+
+def test_inner_product_transpose_blob(tmp_path):
+    """transpose=true stores the blob input-major (K, num_output)."""
+    rng = np.random.default_rng(7)
+    net = pb.NetParameter()
+    net.input.append("data")
+    net.input_shape.add().dim.extend([1, 6])
+    fc = net.layer.add()
+    fc.name, fc.type = "fc", "InnerProduct"
+    fc.bottom.append("data"); fc.top.append("fc")
+    fc.inner_product_param.num_output = 4
+    fc.inner_product_param.transpose = True
+    w = rng.standard_normal((6, 4)).astype(np.float32)  # (K, N)
+    b = rng.standard_normal((4,)).astype(np.float32)
+    _mk_blob(fc, w); _mk_blob(fc, b)
+    path = tmp_path / "t.caffemodel"
+    path.write_bytes(net.SerializeToString())
+
+    model, variables = caffe.CaffeLoader(model_path=str(path)).load()
+    x = rng.standard_normal((3, 6)).astype(np.float32)
+    out, _ = model.apply(variables, jnp.asarray(x), training=False)
+    np.testing.assert_allclose(np.asarray(out), x @ w + b,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_prototxt_only_fresh_init(tmp_path):
+    """Architecture-only import: unmatched layers keep fresh init."""
+    from google.protobuf import text_format
+
+    rng = np.random.default_rng(8)
+    net, _ = _simple_net(rng)
+    arch = pb.NetParameter(); arch.CopyFrom(net)
+    for l in arch.layer:
+        del l.blobs[:]
+    def_path = tmp_path / "arch.prototxt"
+    def_path.write_text(text_format.MessageToString(arch))
+
+    ldr = caffe.CaffeLoader(def_path=str(def_path))
+    model, variables = ldr.load()
+    assert set(ldr.unmatched) == {"conv1", "fc1"}
+    x = rng.standard_normal((2, 8, 8, 2)).astype(np.float32)
+    out, _ = model.apply(variables, jnp.asarray(x), training=False)
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(np.asarray(out).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_accuracy_layer_does_not_hide_output(tmp_path):
+    """A terminal blob also feeding Accuracy must stay an output."""
+    rng = np.random.default_rng(9)
+    net, _ = _simple_net(rng)
+    acc = net.layer.add()
+    acc.name, acc.type = "accuracy", "Accuracy"
+    acc.bottom.append("prob"); acc.bottom.append("label")
+    acc.top.append("accuracy")
+    path = tmp_path / "acc.caffemodel"
+    path.write_bytes(net.SerializeToString())
+
+    model, variables = caffe.CaffeLoader(model_path=str(path)).load()
+    x = rng.standard_normal((1, 8, 8, 2)).astype(np.float32)
+    out, _ = model.apply(variables, jnp.asarray(x), training=False)
+    assert out.shape == (1, 10)
+
+
+def test_concat_negative_axis(tmp_path):
+    rng = np.random.default_rng(10)
+    net = pb.NetParameter()
+    net.input.append("a"); net.input_shape.add().dim.extend([1, 2, 4, 4])
+    net.input.append("b"); net.input_shape.add().dim.extend([1, 3, 4, 4])
+    cat = net.layer.add()
+    cat.name, cat.type = "cat", "Concat"
+    cat.bottom.append("a"); cat.bottom.append("b"); cat.top.append("cat")
+    cat.concat_param.axis = -3  # == channel axis of a 4-D blob
+    path = tmp_path / "cat.caffemodel"
+    path.write_bytes(net.SerializeToString())
+
+    model, variables = caffe.CaffeLoader(model_path=str(path)).load()
+    a = rng.standard_normal((1, 4, 4, 2)).astype(np.float32)
+    b = rng.standard_normal((1, 4, 4, 3)).astype(np.float32)
+    out, _ = model.apply(variables, jnp.asarray(a), jnp.asarray(b),
+                         training=False)
+    assert out.shape == (1, 4, 4, 5)
+
+
+def test_floor_pooling_roundtrip(tmp_path):
+    """ceil_mode=False survives persist → load (round_mode=FLOOR)."""
+    m = nn.Sequential(
+        nn.SpatialConvolution(2, 3, 3, 3).set_name("c"),
+        nn.SpatialMaxPooling(2, 2, 2, 2, ceil_mode=False).set_name("p"),
+    )
+    variables = m.init(jax.random.PRNGKey(0))
+    dp = tmp_path / "f.prototxt"; mp = tmp_path / "f.caffemodel"
+    caffe.persist(str(dp), str(mp), m, variables,
+                  input_shape=(1, 7, 7, 2))
+    model2, vars2 = caffe.load(str(dp), str(mp))
+    x = jnp.asarray(np.random.default_rng(3).standard_normal(
+        (1, 7, 7, 2)).astype(np.float32))
+    want, _ = m.apply(variables, x, training=False)
+    got, _ = model2.apply(vars2, x, training=False)
+    assert got.shape == want.shape  # floor: (1,2,2,3), ceil would be 3x3
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_persister_keeps_non_flatten_transpose_reshape(tmp_path):
+    """A transpose/reshape pair that is NOT the flatten idiom must not be
+    collapsed into a Caffe Flatten layer."""
+    m = nn.Sequential(
+        nn.Transpose([(2, 3)]).set_name("t"),
+        nn.Reshape((4, -1)).set_name("r"),
+    )
+    variables = m.init(jax.random.PRNGKey(0))
+    dp = tmp_path / "nf.prototxt"; mp = tmp_path / "nf.caffemodel"
+    try:
+        caffe.persist(str(dp), str(mp), m, variables,
+                      input_shape=(1, 2, 2, 4))
+    except NotImplementedError:
+        return  # refusing to export is fine; silently flattening is not
+    net = pb.NetParameter()
+    net.ParseFromString((tmp_path / "nf.caffemodel").read_bytes())
+    assert not any(l.type == "Flatten" for l in net.layer)

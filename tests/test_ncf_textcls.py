@@ -1,0 +1,136 @@
+"""NCF + TextClassifier model tests, and the temporal conv/pool layers
+they ride on (reference: NeuralCF / example/textclassification;
+nn/TemporalConvolution.scala, nn/TemporalMaxPooling.scala)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from bigdl_tpu import nn
+from bigdl_tpu.models import ncf, textclassifier
+from bigdl_tpu.optim import SGD
+
+KEY = jax.random.PRNGKey(0)
+
+
+class TestTemporalConvolution:
+    def test_vs_torch_oracle(self):
+        tc = nn.TemporalConvolution(6, 4, 3, 2)
+        v = tc.init(KEY)
+        x = np.random.RandomState(0).randn(2, 11, 6).astype(np.float32)
+        y, _ = tc.apply(v, jnp.asarray(x))
+        assert y.shape == (2, 5, 4)
+        conv = torch.nn.Conv1d(6, 4, 3, stride=2, bias=True)
+        w = np.asarray(v["params"]["weight"])  # (KW, I, O)
+        conv.weight.data = torch.tensor(w.transpose(2, 1, 0))
+        conv.bias.data = torch.tensor(np.asarray(v["params"]["bias"]))
+        ref = conv(torch.tensor(x.transpose(0, 2, 1)))
+        ref = ref.detach().numpy().transpose(0, 2, 1)
+        np.testing.assert_allclose(np.asarray(y), ref, atol=1e-4)
+
+    def test_grads_flow(self):
+        tc = nn.TemporalConvolution(3, 2, 2)
+        v = tc.init(KEY)
+        x = jnp.ones((1, 5, 3))
+
+        def loss(p):
+            y, _ = tc.apply({"params": p, "state": {}}, x)
+            return jnp.sum(y ** 2)
+
+        g = jax.grad(loss)(v["params"])
+        assert float(jnp.abs(g["weight"]).sum()) > 0
+
+
+class TestTemporalMaxPooling:
+    def test_windows(self):
+        pool = nn.TemporalMaxPooling(2, 2)
+        x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(1, 6, 2))
+        y, _ = pool.apply({"params": {}, "state": {}}, x)
+        assert y.shape == (1, 3, 2)
+        np.testing.assert_allclose(
+            np.asarray(y[0, :, 0]), [2.0, 6.0, 10.0])
+
+    def test_global(self):
+        pool = nn.TemporalMaxPooling(-1)
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 7, 3),
+                        jnp.float32)
+        y, _ = pool.apply({"params": {}, "state": {}}, x)
+        assert y.shape == (2, 1, 3)
+        np.testing.assert_allclose(
+            np.asarray(y[:, 0]), np.asarray(x).max(1), rtol=1e-6)
+
+
+class TestNCF:
+    def test_shapes_and_logprobs(self):
+        m = ncf.build(30, 40, class_num=5).build(KEY).evaluate()
+        pairs = jnp.asarray(
+            np.random.RandomState(0).randint(0, 30, (8, 2)), jnp.int32)
+        out = m.forward(pairs)
+        assert out.shape == (8, 5)
+        np.testing.assert_allclose(
+            np.exp(np.asarray(out)).sum(-1), 1.0, rtol=1e-5)
+
+    def test_no_mf_tower(self):
+        m = ncf.build(10, 10, class_num=3, include_mf=False).build(KEY)
+        out = m.evaluate().forward(jnp.zeros((4, 2), jnp.int32))
+        assert out.shape == (4, 3)
+
+    def test_learns_synthetic_ratings(self):
+        # tiny synthetic problem: rating = (u + i) % 3
+        rng = np.random.RandomState(0)
+        users = rng.randint(0, 8, 256)
+        items = rng.randint(0, 8, 256)
+        labels = (users + items) % 3
+        pairs = jnp.asarray(np.stack([users, items], 1), jnp.int32)
+        y = jnp.asarray(labels, jnp.int32)
+
+        m = ncf.build(8, 8, class_num=3, user_embed=8, item_embed=8,
+                      hidden_layers=(16, 8), mf_embed=8)
+        variables = m.init(KEY)
+        crit = nn.ClassNLLCriterion()
+        method = SGD(learningrate=0.5)
+        slots = method.init_slots(variables["params"])
+        state = variables["state"]
+
+        @jax.jit
+        def step(params, slots, lr, t):
+            def lf(p):
+                out, _ = m.apply({"params": p, "state": state}, pairs)
+                return crit(out, y)
+            loss, g = jax.value_and_grad(lf)(params)
+            params, slots = method.update(g, params, slots, lr, t)
+            return params, slots, loss
+
+        params = variables["params"]
+        first = None
+        for t in range(60):
+            params, slots, loss = step(
+                params, slots, jnp.asarray(0.5), jnp.asarray(t))
+            if first is None:
+                first = float(loss)
+        assert float(loss) < 0.5 * first  # clearly learning
+
+
+class TestTextClassifier:
+    def test_forward_shape(self):
+        m = textclassifier.build(class_num=4, vocab_size=50,
+                                 sequence_len=160, embedding_dim=16,
+                                 filters=8).build(KEY).evaluate()
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 50, (2, 160)), jnp.int32)
+        out = m.forward(toks)
+        assert out.shape == (2, 4)
+        np.testing.assert_allclose(
+            np.exp(np.asarray(out)).sum(-1), 1.0, rtol=1e-5)
+
+    def test_set_embedding(self):
+        m = textclassifier.build(class_num=2, vocab_size=20,
+                                 sequence_len=160, embedding_dim=8,
+                                 filters=4)
+        v = m.init(KEY)
+        vec = np.random.RandomState(1).rand(20, 8).astype(np.float32)
+        v2 = textclassifier.set_embedding(v, vec)
+        emb = next(p for k, p in v2["params"].items()
+                   if k.endswith("_embedding"))
+        np.testing.assert_allclose(np.asarray(emb["weight"]), vec)
