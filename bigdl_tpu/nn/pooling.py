@@ -15,7 +15,16 @@ from jax import lax
 from bigdl_tpu.nn.module import Module
 
 
+def _same_pad(size, k, s):
+    out = -(-size // s)
+    total = max(0, (out - 1) * s + k - size)
+    return total // 2, total - total // 2
+
+
 def _pool_padding(pad_h, pad_w, ceil_mode, in_h, in_w, kh, kw, sh, sw):
+    if pad_w == -1:  # reference semantics: -1 → TF-style SAME padding
+        return [(0, 0), _same_pad(in_h, kh, sh), _same_pad(in_w, kw, sw),
+                (0, 0)]
     pads = [(0, 0), (pad_h, pad_h), (pad_w, pad_w), (0, 0)]
     if ceil_mode:
         # extend right/bottom so the last partial window is included

@@ -563,44 +563,10 @@ class CaffePersister:
     # ---- flatten sequence of (module, vars, inputs) -------------------
 
     def _linearize(self):
-        """Yield (module, variables, input_ids, my_id) in topo order."""
-        entries = []
+        """Yield (module, variables, input_ids) entries in topo order."""
+        from bigdl_tpu.utils.interop import linearize
 
-        def walk(mod: Module, v: Dict[str, Any], in_ids: List[int]) -> List[int]:
-            if isinstance(mod, Graph):
-                id_of: Dict[int, List[int]] = {}
-                if len(mod.input_nodes) == 1:
-                    id_of[id(mod.input_nodes[0])] = list(in_ids)
-                else:
-                    for inp_node, gid in zip(mod.input_nodes, in_ids):
-                        id_of[id(inp_node)] = [gid]
-                for node in mod._order:
-                    if node.module is None:
-                        continue
-                    key = mod._keys[id(node)]
-                    parent_ids = []
-                    for p in node.inputs:
-                        parent_ids.extend(id_of[id(p)])
-                    sub_v = {"params": v["params"][key],
-                             "state": v["state"][key]}
-                    id_of[id(node)] = walk(node.module, sub_v, parent_ids)
-                outs = []
-                for n in mod.output_nodes:
-                    outs.extend(id_of[id(n)])
-                return outs
-            if isinstance(mod, nn.Sequential):
-                cur = in_ids
-                for k, m in zip(mod._keys, mod.modules):
-                    sub_v = {"params": v["params"][k],
-                             "state": v["state"][k]}
-                    cur = walk(m, sub_v, cur)
-                return cur
-            eid = len(entries)
-            entries.append((mod, v, list(in_ids)))
-            return [eid]
-
-        out_ids = walk(self.module, self.variables, [-1])
-        return entries, out_ids
+        return linearize(self.module, self.variables)
 
     # ---- emission ------------------------------------------------------
 

@@ -1,0 +1,7 @@
+"""TensorFlow model interop (reference parity: utils/tf/ —
+TensorflowLoader, TensorflowSaver, per-op converters)."""
+
+from bigdl_tpu.utils.tf.loader import TensorflowLoader, load
+from bigdl_tpu.utils.tf.saver import TensorflowSaver, save
+
+__all__ = ["TensorflowLoader", "TensorflowSaver", "load", "save"]

@@ -1,0 +1,225 @@
+"""TensorFlow interop tests (reference: utils/tf/TensorflowLoaderSpec /
+TensorflowSaverSpec — SURVEY.md §4 "Interop").
+
+Real TensorFlow (available in the image) is the oracle: TF builds and
+runs a frozen graph, our loader imports the same bytes via the bundled
+wire-compatible proto; outputs must match. The saver round-trips both
+through our own loader and through real TF.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+tf = pytest.importorskip("tensorflow")
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils import tf as tf_interop
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _freeze(graph, outputs, path):
+    """Serialize a TF graph (constants only) to a frozen .pb file."""
+    gd = graph.as_graph_def()
+    with open(path, "wb") as f:
+        f.write(gd.SerializeToString())
+
+
+def _tf_run(graph, feeds, fetch):
+    with tf.compat.v1.Session(graph=graph) as sess:
+        return sess.run(fetch, feeds)
+
+
+def test_load_mlp_matches_tf(tmp_path):
+    rng = np.random.default_rng(0)
+    w1 = rng.standard_normal((10, 16)).astype(np.float32)
+    b1 = rng.standard_normal((16,)).astype(np.float32)
+    w2 = rng.standard_normal((16, 4)).astype(np.float32)
+    b2 = rng.standard_normal((4,)).astype(np.float32)
+
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [None, 10], name="input")
+        h = tf.nn.relu(tf.nn.bias_add(tf.matmul(x, w1), b1), name="h")
+        y = tf.nn.softmax(tf.nn.bias_add(tf.matmul(h, w2), b2), name="prob")
+    path = tmp_path / "mlp.pb"
+    _freeze(g, ["prob"], str(path))
+
+    model, variables = tf_interop.load(str(path))
+    xs = rng.standard_normal((3, 10)).astype(np.float32)
+    want = _tf_run(g, {"input:0": xs}, "prob:0")
+    got, _ = model.apply(variables, jnp.asarray(xs), training=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_load_cnn_matches_tf(tmp_path):
+    rng = np.random.default_rng(1)
+    wc = rng.standard_normal((3, 3, 2, 5)).astype(np.float32) * 0.3
+    bc = rng.standard_normal((5,)).astype(np.float32)
+    scale = rng.uniform(0.5, 1.5, 5).astype(np.float32)
+    offset = rng.standard_normal((5,)).astype(np.float32)
+    mean = rng.standard_normal((5,)).astype(np.float32)
+    var = rng.uniform(0.5, 2.0, 5).astype(np.float32)
+    wf = rng.standard_normal((5 * 4 * 4, 7)).astype(np.float32) * 0.2
+
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [None, 8, 8, 2],
+                                     name="input")
+        h = tf.nn.conv2d(x, wc, strides=[1, 1, 1, 1], padding="SAME")
+        h = tf.nn.bias_add(h, bc)
+        h = tf.compat.v1.nn.fused_batch_norm(
+            h, scale, offset, mean, var, epsilon=1e-3, is_training=False)[0]
+        h = tf.nn.relu(h)
+        h = tf.nn.max_pool2d(h, 2, 2, "VALID")
+        h = tf.reshape(h, [-1, 5 * 4 * 4])
+        y = tf.matmul(h, wf, name="logits")
+    path = tmp_path / "cnn.pb"
+    _freeze(g, ["logits"], str(path))
+
+    model, variables = tf_interop.load(str(path))
+    xs = rng.standard_normal((2, 8, 8, 2)).astype(np.float32)
+    want = _tf_run(g, {"input:0": xs}, "logits:0")
+    got, _ = model.apply(variables, jnp.asarray(xs), training=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
+
+
+def test_load_depthwise_and_avgpool_matches_tf(tmp_path):
+    rng = np.random.default_rng(2)
+    wd = rng.standard_normal((3, 3, 4, 2)).astype(np.float32) * 0.4
+
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [None, 6, 6, 4],
+                                     name="input")
+        h = tf.nn.depthwise_conv2d(x, wd, strides=[1, 1, 1, 1],
+                                   padding="SAME")
+        y = tf.nn.avg_pool2d(h, 2, 2, "SAME", name="out")
+    path = tmp_path / "dw.pb"
+    _freeze(g, ["out"], str(path))
+
+    model, variables = tf_interop.load(str(path))
+    xs = rng.standard_normal((2, 6, 6, 4)).astype(np.float32)
+    want = _tf_run(g, {"input:0": xs}, "out:0")
+    got, _ = model.apply(variables, jnp.asarray(xs), training=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-4)
+
+
+def test_load_branches_concat_mean(tmp_path):
+    rng = np.random.default_rng(3)
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [None, 4, 4, 3],
+                                     name="input")
+        a = tf.nn.relu(x)
+        b = tf.nn.tanh(x)
+        c = tf.concat([a, b], axis=3)
+        y = tf.reduce_mean(c, axis=[1, 2], name="gap")
+    path = tmp_path / "branch.pb"
+    _freeze(g, ["gap"], str(path))
+
+    model, variables = tf_interop.load(str(path))
+    xs = rng.standard_normal((2, 4, 4, 3)).astype(np.float32)
+    want = _tf_run(g, {"input:0": xs}, "gap:0")
+    got, _ = model.apply(variables, jnp.asarray(xs), training=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def _lenet_like():
+    return nn.Sequential(
+        nn.SpatialConvolution(1, 4, 5, 5).set_name("c1"),
+        nn.Tanh(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Reshape([4 * 12 * 12]),
+        nn.Linear(4 * 12 * 12, 10).set_name("fc"),
+        nn.LogSoftMax(),
+    )
+
+
+def test_save_roundtrip_own_loader(tmp_path):
+    m = _lenet_like()
+    variables = m.init(KEY)
+    path = tmp_path / "m.pb"
+    tf_interop.save(m, variables, str(path), (1, 28, 28, 1))
+
+    m2, v2 = tf_interop.load(str(path))
+    xs = np.random.default_rng(4).standard_normal(
+        (2, 28, 28, 1)).astype(np.float32)
+    want, _ = m.apply(variables, jnp.asarray(xs), training=False)
+    got, _ = m2.apply(v2, jnp.asarray(xs), training=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_save_loads_in_real_tensorflow(tmp_path):
+    m = _lenet_like()
+    variables = m.init(KEY)
+    path = tmp_path / "m.pb"
+    tf_interop.save(m, variables, str(path), (1, 28, 28, 1))
+
+    gd = tf.compat.v1.GraphDef()
+    gd.ParseFromString(path.read_bytes())
+    g = tf.Graph()
+    with g.as_default():
+        tf.import_graph_def(gd, name="")
+    xs = np.random.default_rng(5).standard_normal(
+        (2, 28, 28, 1)).astype(np.float32)
+    want, _ = m.apply(variables, jnp.asarray(xs), training=False)
+    got = _tf_run(g, {"input:0": xs}, "output:0")
+    np.testing.assert_allclose(got, np.asarray(want), rtol=1e-4, atol=1e-4)
+
+
+def test_graph_model_with_branches_roundtrip(tmp_path):
+    x = nn.Input()
+    h = nn.SpatialConvolution(2, 3, 3, 3, 1, 1, -1, -1).set_name("c")(x)
+    a = nn.ReLU()(h)
+    b = nn.Tanh()(h)
+    j = nn.CAddTable()(a, b)
+    y = nn.SoftMax()(nn.Reshape([3 * 16]).set_name("r")(j))
+    m = nn.Graph(x, y)
+    variables = m.init(KEY)
+    path = tmp_path / "g.pb"
+    tf_interop.save(m, variables, str(path), (1, 4, 4, 2))
+
+    m2, v2 = tf_interop.load(str(path))
+    xs = np.random.default_rng(6).standard_normal(
+        (2, 4, 4, 2)).astype(np.float32)
+    want, _ = m.apply(variables, jnp.asarray(xs), training=False)
+    got, _ = m2.apply(v2, jnp.asarray(xs), training=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_imported_model_is_trainable(tmp_path):
+    """Imported TF graphs are native models: jax.grad flows into the
+    imported weights (replaces the reference's BigDLSessionImpl)."""
+    rng = np.random.default_rng(7)
+    w = rng.standard_normal((6, 3)).astype(np.float32)
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [None, 6], name="input")
+        y = tf.nn.log_softmax(tf.matmul(x, w), name="out")
+    path = tmp_path / "t.pb"
+    _freeze(g, ["out"], str(path))
+    model, variables = tf_interop.load(str(path))
+
+    xs = jnp.asarray(rng.standard_normal((8, 6)).astype(np.float32))
+    ys = jnp.asarray(rng.integers(0, 3, 8), jnp.int32)
+    crit = nn.ClassNLLCriterion()
+
+    def loss_fn(params):
+        out, _ = model.apply(
+            {"params": params, "state": variables["state"]}, xs,
+            training=False)
+        return crit(out, ys)
+
+    grads = jax.grad(loss_fn)(variables["params"])
+    gnorm = sum(float(jnp.abs(g).sum())
+                for g in jax.tree_util.tree_leaves(grads))
+    assert gnorm > 0
