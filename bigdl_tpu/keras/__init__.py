@@ -1,0 +1,22 @@
+"""Keras-style model-building API.
+
+Reference parity: the reference line's `nn/keras` package (Keras-1-shaped
+layer wrappers over the core module library: Sequential/Model with
+`compile`/`fit`/`evaluate`/`predict`, layers inferring their input shapes
+from the previous layer). Thin sugar over `bigdl_tpu.nn` + `Optimizer` —
+everything lowers to the same jitted training path.
+"""
+
+from bigdl_tpu.keras.layers import (
+    Activation, AveragePooling2D, BatchNormalization, Conv2D, Convolution2D,
+    Dense, Dropout, Embedding, Flatten, GlobalAveragePooling2D, InputLayer,
+    LSTM, MaxPooling2D, Reshape,
+)
+from bigdl_tpu.keras.models import Sequential
+
+__all__ = [
+    "Sequential", "Dense", "Conv2D", "Convolution2D", "MaxPooling2D",
+    "AveragePooling2D", "GlobalAveragePooling2D", "Flatten", "Activation",
+    "Dropout", "Embedding", "BatchNormalization", "LSTM", "Reshape",
+    "InputLayer",
+]

@@ -91,6 +91,7 @@ class Optimizer:
         self._resume = False
         self.mesh = None
         self.mesh_axis = "data"
+        self.precision = None  # None → full fp32; Policy → mixed precision
 
     # ------------------------------------------------------- builder surface
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
@@ -143,6 +144,21 @@ class Optimizer:
         self.validation_summary = self._coerce_summary(summary, ValidationSummary)
         return self
 
+    def set_precision(self, policy) -> "Optimizer":
+        """Enable mixed precision. `policy` is a `utils.precision.Policy`,
+        or one of "bf16"/"mixed" (bf16 compute, fp32 master weights) /
+        "fp32" (TPU-first replacement for the reference's FP16 gradient
+        wire compression — see utils/precision.py)."""
+        from bigdl_tpu.utils.precision import DEFAULT_MIXED, Policy
+
+        if isinstance(policy, str):
+            policy = {"bf16": DEFAULT_MIXED, "mixed": DEFAULT_MIXED,
+                      "fp32": None}[policy]
+        elif policy is not None and not isinstance(policy, Policy):
+            raise TypeError(f"expected Policy or str, got {type(policy)}")
+        self.precision = policy
+        return self
+
     def set_constant_gradient_clipping(self, min_v: float, max_v: float) -> "Optimizer":
         self.grad_clip_const = (min_v, max_v)
         return self
@@ -183,12 +199,20 @@ class LocalOptimizer:
     def _make_step(self) -> Callable:
         model, criterion, method = self.o.model, self.o.criterion, self.o.optim_method
         clip_const, clip_norm = self.o.grad_clip_const, self.o.grad_clip_norm
+        precision = self.o.precision
 
         def step(params, mod_state, slots, bx, by, lr, stepno, rng):
             def loss_fn(p):
+                x = bx
+                if precision is not None:
+                    p = precision.cast_to_compute(p)
+                    x = precision.cast_to_compute(x)
                 out, new_state = model.apply(
-                    {"params": p, "state": mod_state}, bx,
+                    {"params": p, "state": mod_state}, x,
                     training=True, rng=rng)
+                if precision is not None:
+                    out = precision.cast_to_output(out)
+                    new_state = precision.cast_to_output(new_state)
                 return criterion(out, by), new_state
 
             (loss, new_state), grads = jax.value_and_grad(
@@ -209,10 +233,16 @@ class LocalOptimizer:
 
     def _make_eval(self) -> Callable:
         model, methods = self.o.model, self.o.validation_methods
+        precision = self.o.precision
 
         def eval_step(params, mod_state, bx, by, real_size):
+            if precision is not None:
+                params = precision.cast_to_compute(params)
+                bx = precision.cast_to_compute(bx)
             out, _ = model.apply({"params": params, "state": mod_state}, bx,
                                  training=False)
+            if precision is not None:
+                out = precision.cast_to_output(out)
             return [m.stats(out, by, real_size) for m in methods]
 
         return jax.jit(eval_step, static_argnums=(4,))

@@ -1,0 +1,61 @@
+"""Mixed-precision policy utilities.
+
+TPU-first replacement for the reference's FP16 wire compression
+(parameters/FP16CompressedTensor.scala): on TPU the MXU computes natively
+in bfloat16, so instead of compressing gradients for the network we run
+the whole forward/backward in bf16 while keeping fp32 master weights and
+optimizer state — the standard mixed-precision recipe. bf16 shares
+fp32's exponent range, so no loss scaling is needed (unlike fp16).
+
+Usage::
+
+    params32 = ...                      # master weights, float32
+    def loss_fn(p32, x, y):
+        p16 = cast_floats(p32, jnp.bfloat16)
+        out, _ = model.apply({"params": p16, "state": state},
+                             cast_floats(x, jnp.bfloat16))
+        return criterion(jnp.asarray(out, jnp.float32), y)
+    grads = jax.grad(loss_fn)(params32, x, y)   # grads are float32
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def cast_floats(tree: Any, dtype) -> Any:
+    """Cast every floating-point leaf of a pytree to `dtype`; non-float
+    leaves (int labels, rng keys, …) pass through untouched."""
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return jnp.asarray(x, dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+class Policy:
+    """A jmp-style precision policy: what dtype to store parameters in,
+    compute in, and emit outputs in."""
+
+    def __init__(self, param_dtype=jnp.float32, compute_dtype=jnp.bfloat16,
+                 output_dtype=jnp.float32):
+        self.param_dtype = param_dtype
+        self.compute_dtype = compute_dtype
+        self.output_dtype = output_dtype
+
+    def cast_to_compute(self, tree):
+        return cast_floats(tree, self.compute_dtype)
+
+    def cast_to_param(self, tree):
+        return cast_floats(tree, self.param_dtype)
+
+    def cast_to_output(self, tree):
+        return cast_floats(tree, self.output_dtype)
+
+
+DEFAULT_MIXED = Policy()
+FULL_PRECISION = Policy(compute_dtype=jnp.float32)

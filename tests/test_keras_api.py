@@ -1,0 +1,83 @@
+"""Keras-style API tests (reference: the nn/keras layer wrappers +
+Sequential compile/fit/evaluate/predict surface)."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import keras
+
+
+def _toy_data(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    ys = rng.randint(0, 2, n).astype(np.int32)
+    xs = (rng.rand(n, 8, 8, 1) * 0.4 +
+          ys[:, None, None, None] * 0.6).astype(np.float32)
+    return xs, ys
+
+
+class TestBuild:
+    def test_shape_inference_chain(self):
+        m = keras.Sequential([
+            keras.Conv2D(4, 3, input_shape=(8, 8, 1), activation="relu"),
+            keras.MaxPooling2D(2),
+            keras.Flatten(),
+            keras.Dense(10, activation="softmax"),
+        ])
+        module = m.build()
+        assert m.output_shape == (10,)
+        out = module.build().evaluate().forward(
+            np.zeros((2, 8, 8, 1), np.float32))
+        assert out.shape == (2, 10)
+
+    def test_same_padding_conv(self):
+        m = keras.Sequential([
+            keras.Conv2D(3, 3, padding="same", input_shape=(7, 7, 2)),
+        ])
+        m.build()
+        assert m.output_shape == (7, 7, 3)
+
+    def test_first_layer_needs_shape(self):
+        with pytest.raises(ValueError):
+            keras.Sequential([keras.Dense(4)])
+
+    def test_embedding_lstm(self):
+        m = keras.Sequential([
+            keras.Embedding(50, 8, input_length=12),
+            keras.LSTM(16),
+            keras.Dense(2, activation="log_softmax"),
+        ])
+        m.build()
+        assert m.output_shape == (2,)
+        out = m.module.build().evaluate().forward(
+            np.zeros((3, 12), np.int32))
+        assert out.shape == (3, 2)
+
+    def test_summary(self):
+        m = keras.Sequential([
+            keras.Flatten(input_shape=(4, 4, 1)),
+            keras.Dense(5),
+        ])
+        s = m.summary()
+        assert "Flatten" in s and "(None, 5)" in s
+
+
+class TestFit:
+    def test_fit_evaluate_predict(self):
+        xs, ys = _toy_data()
+        m = keras.Sequential([
+            keras.Conv2D(4, 3, input_shape=(8, 8, 1), activation="relu"),
+            keras.MaxPooling2D(2),
+            keras.Flatten(),
+            keras.Dense(2),
+        ])
+        m.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        m.fit(xs[:192], ys[:192], batch_size=64, epochs=30,
+              validation_data=(xs[192:], ys[192:]))
+        scores = m.evaluate(xs[192:], ys[192:])
+        acc = scores["Top1Accuracy"]
+        assert acc > 0.9, f"keras-API training failed: {acc}"
+        preds = m.predict_classes(xs[192:200])
+        assert preds.shape == (8,)
+        assert (preds == ys[192:200]).mean() > 0.8
