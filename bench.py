@@ -9,6 +9,16 @@ absolute numbers (BASELINE.md); vs_baseline is computed against
 REF_THROUGHPUT below — the reference-era BigDL CPU figure for ResNet-50
 training (~10 img/s on a 2-socket Xeon node, from the qualitative record
 in the BigDL paper line of work; see BASELINE.md provenance).
+
+Measurement notes:
+- mixed precision (bf16 compute, fp32 master weights) on TPU — the
+  framework's production training configuration (Optimizer.set_precision);
+- the timed region is fenced by fetching the final loss to the host: the
+  last step depends on every prior step's params, so the fetch cannot
+  complete before all timed work does (block_until_ready alone can be
+  optimistic through remote-device transports);
+- input batches rotate through a small pool so no two consecutive steps
+  are byte-identical executions.
 """
 
 from __future__ import annotations
@@ -28,9 +38,11 @@ def main() -> None:
     from bigdl_tpu import nn
     from bigdl_tpu.models import resnet
     from bigdl_tpu.optim import SGD
+    from bigdl_tpu.utils.precision import DEFAULT_MIXED as POLICY
 
     platform = jax.devices()[0].platform
-    batch = 64 if platform == "tpu" else 8
+    on_tpu = platform == "tpu"
+    batch = 256 if on_tpu else 8
     model = resnet.build_imagenet(50, 1000)
     variables = model.init(jax.random.PRNGKey(0))
     method = SGD(learningrate=0.1, momentum=0.9, dampening=0.0)
@@ -40,9 +52,12 @@ def main() -> None:
     @jax.jit
     def train_step(params, state, slots, bx, by):
         def loss_fn(p):
-            out, new_state = model.apply({"params": p, "state": state}, bx,
-                                         training=True)
-            return criterion(out, by), new_state
+            p16 = POLICY.cast_to_compute(p)
+            x16 = POLICY.cast_to_compute(bx)
+            out, new_state = model.apply({"params": p16, "state": state},
+                                         x16, training=True)
+            return (criterion(POLICY.cast_to_output(out), by),
+                    POLICY.cast_to_output(new_state))
 
         (loss, new_state), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
@@ -51,24 +66,30 @@ def main() -> None:
         return new_params, new_state, new_slots, loss
 
     rng = np.random.RandomState(0)
-    bx = jnp.asarray(rng.rand(batch, 224, 224, 3).astype(np.float32))
-    by = jnp.asarray(rng.randint(0, 1000, batch).astype(np.int32))
+    pool = 4
+    bxs = [jnp.asarray(rng.rand(batch, 224, 224, 3).astype(np.float32))
+           for _ in range(pool)]
+    bys = [jnp.asarray(rng.randint(0, 1000, batch).astype(np.int32))
+           for _ in range(pool)]
 
     params, state = variables["params"], variables["state"]
-    # warmup/compile
-    params, state, slots, loss = train_step(params, state, slots, bx, by)
-    jax.block_until_ready(loss)
+    # warmup/compile, fenced by a host fetch
+    params, state, slots, loss = train_step(params, state, slots,
+                                            bxs[0], bys[0])
+    float(loss)
 
-    n_iters = 20 if platform == "tpu" else 3
+    n_iters = 24 if on_tpu else 3
     t0 = time.perf_counter()
-    for _ in range(n_iters):
-        params, state, slots, loss = train_step(params, state, slots, bx, by)
-    jax.block_until_ready(loss)
+    for i in range(n_iters):
+        params, state, slots, loss = train_step(params, state, slots,
+                                                bxs[i % pool], bys[i % pool])
+    final_loss = float(loss)  # fences the whole serial chain
     dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
 
     value = n_iters * batch / dt
     print(json.dumps({
-        "metric": f"resnet50_train_images_per_sec_per_chip[{platform}]",
+        "metric": f"resnet50_bf16_train_images_per_sec_per_chip[{platform}]",
         "value": round(value, 2),
         "unit": "images/sec",
         "vs_baseline": round(value / REF_THROUGHPUT, 2),

@@ -52,13 +52,28 @@ class Engine:
 
         Wraps `jax.distributed.initialize`, which wires the PJRT process
         group over DCN; collectives inside `jit` then span all hosts' chips.
+        Off-cloud, scripts/launch_pod.sh exports BIGDL_COORDINATOR /
+        BIGDL_NUM_PROCESSES / BIGDL_PROCESS_ID, picked up here; on Cloud
+        TPU VMs everything is discovered from the metadata server and
+        plain `Engine.init_distributed()` suffices.
         """
+        if coordinator_address is None:
+            coordinator_address = os.environ.get("BIGDL_COORDINATOR")
+            if coordinator_address is not None:
+                num_processes = int(os.environ["BIGDL_NUM_PROCESSES"])
+                process_id = int(os.environ["BIGDL_PROCESS_ID"])
         if coordinator_address is not None:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,
                 process_id=process_id,
             )
+        elif jax.process_count() == 1 and os.environ.get("TPU_NAME"):
+            # Cloud TPU VM: topology from metadata, no flags needed
+            try:
+                jax.distributed.initialize()
+            except Exception:  # single-host slice: nothing to wire
+                pass
         cls.init()
 
     @classmethod

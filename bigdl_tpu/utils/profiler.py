@@ -1,0 +1,91 @@
+"""Profiling / tracing.
+
+Reference parity: SURVEY.md §5.1 — the reference has no tracer, only
+per-iteration `optim/Metrics` counters and the `*OptimizerPerf` harness;
+its TPU equivalent is `jax.profiler` TensorBoard traces plus fenced
+per-step timing, both provided here.
+
+Usage::
+
+    with profiler.trace("/tmp/tb"):            # XLA+host trace
+        for batch in data:
+            with profiler.step(i):             # marks step boundaries
+                step_fn(...)
+
+    t = profiler.FencedTimer()
+    with t:
+        out = step_fn(...)
+        t.fence(out)                           # device-honest timing
+    print(t.elapsed)
+
+View traces in TensorBoard's Profile tab (the trace dir also contains
+`.xplane.pb` files usable with `xprof`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Iterator, Optional
+
+import jax
+
+__all__ = ["trace", "step", "annotate", "FencedTimer", "device_sync"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace (device + host) into `log_dir`."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def step(step_num: int):
+    """Annotate one training step inside a trace() region; shows up as a
+    step marker in the TensorBoard profile."""
+    return jax.profiler.StepTraceAnnotation("train_step", step_num=step_num)
+
+
+def annotate(name: str):
+    """Named host-side trace region (TraceAnnotation)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def device_sync(*values: Any) -> None:
+    """Block until device work producing `values` is complete. Fetches one
+    scalar-sized element per array to force a real device→host round-trip
+    (plain block_until_ready can be optimistic through remote-device
+    transports)."""
+    import numpy as np
+
+    for leaf in jax.tree_util.tree_leaves(values):
+        if hasattr(leaf, "addressable_shards") or hasattr(leaf, "device"):
+            arr = jax.numpy.ravel(leaf)[:1] if getattr(leaf, "size", 1) else leaf
+            np.asarray(arr)
+
+
+class FencedTimer:
+    """Wall-clock timer whose stop is fenced by a real device fetch, so it
+    measures completed device work, not dispatch."""
+
+    def __init__(self):
+        self.elapsed: Optional[float] = None
+        self._t0: Optional[float] = None
+        self._fenced = False
+
+    def __enter__(self) -> "FencedTimer":
+        self._t0 = time.perf_counter()
+        self._fenced = False
+        return self
+
+    def fence(self, *values: Any) -> None:
+        device_sync(*values)
+        self.elapsed = time.perf_counter() - self._t0
+        self._fenced = True
+
+    def __exit__(self, *exc) -> None:
+        if not self._fenced:
+            self.elapsed = time.perf_counter() - self._t0

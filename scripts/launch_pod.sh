@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Multi-host TPU pod launcher (reference parity: scripts/bigdl.sh +
+# dist/conf/spark-bigdl.conf — there: OpenMP env + required Spark confs;
+# here: the env every TPU pod host needs, then one python process per
+# host, exactly as the reference ran one Spark executor per node).
+#
+# Usage, run ON EACH HOST of the pod slice (or via
+# `gcloud compute tpus tpu-vm ssh ... --worker=all --command=...`):
+#
+#   ./scripts/launch_pod.sh python -m bigdl_tpu.models.train \
+#       --model resnet50 --synthetic -b 1024 --mesh data=32
+#
+# On Cloud TPU VMs, JAX discovers the pod topology from the metadata
+# server and `jax.distributed.initialize()` (called by Engine.init_distributed
+# with no args) needs no flags. Off-cloud, set:
+#   BIGDL_COORDINATOR   host:port of process 0
+#   BIGDL_NUM_PROCESSES total process count
+#   BIGDL_PROCESS_ID    this process's rank
+set -euo pipefail
+
+# --- performance env (counterpart of bigdl.sh's OMP_NUM_THREADS etc.) ---
+# Donated-buffer reuse + async dispatch are defaults; these keep the host
+# input pipeline from fighting XLA's compilation threads.
+export TPU_MEGACORE="${TPU_MEGACORE:-}"
+export JAX_ENABLE_COMPILATION_CACHE="${JAX_ENABLE_COMPILATION_CACHE:-1}"
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$HOME/.cache/jax_comp}"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
+# --- distributed bring-up flags consumed by Engine.init_distributed ---
+if [[ -n "${BIGDL_COORDINATOR:-}" ]]; then
+  export BIGDL_COORDINATOR BIGDL_NUM_PROCESSES BIGDL_PROCESS_ID
+fi
+
+exec "$@"
