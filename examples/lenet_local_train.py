@@ -1,0 +1,64 @@
+"""LeNet-5 local training (reference: models/lenet/Train.scala).
+
+Runs on MNIST if `-f <folder>` points at the idx files, else on a
+synthetic stand-in. Shows the full Optimizer surface: SGD+momentum,
+epoch triggers, validation, checkpointing, TensorBoard summaries.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DataSet, Sample, mnist
+from bigdl_tpu.models import lenet
+from bigdl_tpu.optim import Optimizer, SGD, Top1Accuracy, Trigger
+from bigdl_tpu.visualization import TrainSummary
+
+
+def synthetic(n=2048, seed=0):
+    rng = np.random.RandomState(seed)
+    ys = rng.randint(0, 10, n).astype(np.int32)
+    xs = rng.rand(n, 28, 28, 1).astype(np.float32) * 0.1
+    for i, y in enumerate(ys):  # class-dependent bright square
+        r, c = divmod(int(y), 4)
+        xs[i, 3 + 5 * r:8 + 5 * r, 3 + 5 * c:8 + 5 * c] += 0.8
+    return [Sample(x, int(y)) for x, y in zip(xs, ys)]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-f", "--dataFolder", default=None)
+    ap.add_argument("-b", "--batchSize", type=int, default=128)
+    ap.add_argument("--maxEpoch", type=int, default=3)
+    ap.add_argument("--checkpoint", default="/tmp/lenet_ckpt")
+    args = ap.parse_args()
+
+    if args.dataFolder:
+        train = DataSet.array(mnist.load_mnist(args.dataFolder, train=True))
+        val = DataSet.array(mnist.load_mnist(args.dataFolder, train=False))
+    else:
+        samples = synthetic()
+        train = DataSet.array(samples[:1792])
+        val = DataSet.array(samples[1792:])
+
+    trained = (
+        Optimizer(lenet.build(10), train, nn.ClassNLLCriterion(),
+                  batch_size=args.batchSize)
+        .set_optim_method(SGD(learningrate=0.05, momentum=0.9))
+        .set_end_when(Trigger.max_epoch(args.maxEpoch))
+        .set_validation(Trigger.every_epoch(), val, [Top1Accuracy()])
+        .set_checkpoint(args.checkpoint, Trigger.every_epoch())
+        .set_train_summary(TrainSummary("/tmp/lenet_tb", "lenet"))
+        .optimize()
+    )
+    print("done; checkpoints in", args.checkpoint)
+    return trained
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""TensorFlow frozen-graph import (reference: example/loadmodel TF path).
+Writes a GraphDef with our saver (stand-in for a downloaded frozen .pb),
+imports it, and computes gradients into the imported weights."""
+
+import os, sys, tempfile
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils import tf as tf_interop
+
+
+def main():
+    m = nn.Sequential(
+        nn.SpatialConvolution(1, 4, 5, 5).set_name("c1"), nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2, 2, 2), nn.Reshape([4 * 12 * 12]),
+        nn.Linear(4 * 12 * 12, 10).set_name("fc"), nn.LogSoftMax())
+    variables = m.init(jax.random.PRNGKey(0))
+    path = os.path.join(tempfile.mkdtemp(), "frozen.pb")
+    tf_interop.save(m, variables, path, (1, 28, 28, 1))
+
+    model, params = tf_interop.load(path)
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 28, 28, 1),
+                    jnp.float32)
+    out, _ = model.apply(params, x, training=False)
+    print("imported TF model output:", out.shape)
+
+    y = jnp.asarray([1, 2], jnp.int32)
+    crit = nn.ClassNLLCriterion()
+
+    def loss(p):
+        o, _ = model.apply({"params": p, "state": params["state"]}, x,
+                           training=False)
+        return crit(o, y)
+
+    g = jax.grad(loss)(params["params"])
+    print("grad leaves:", len(jax.tree_util.tree_leaves(g)))
+    return model
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""NCF recommendation (the BigDL paper's NCF benchmark): GMF+MLP towers,
+evaluated with HitRatio@10 / NDCG@10."""
+
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DataSet, Sample
+from bigdl_tpu.models import ncf
+from bigdl_tpu.optim import (
+    Evaluator, HitRatio, NDCG, Optimizer, Adam, Top1Accuracy, Trigger,
+)
+
+USERS, ITEMS = 32, 64
+
+
+def synthetic(n=2048, seed=0):
+    rng = np.random.RandomState(seed)
+    users = rng.randint(0, USERS, n)
+    items = rng.randint(0, ITEMS, n)
+    labels = ((users * 7 + items) % 5).astype(np.int32)  # rating 0..4
+    return [Sample(np.stack([u, i]).astype(np.int32), int(l))
+            for u, i, l in zip(users, items, labels)]
+
+
+def main():
+    samples = synthetic()
+    model = ncf.build(USERS, ITEMS, class_num=5, user_embed=16,
+                      item_embed=16, hidden_layers=(32, 16), mf_embed=16)
+    trained = (
+        Optimizer(model, DataSet.array(samples[:1792]),
+                  nn.ClassNLLCriterion(), batch_size=128)
+        .set_optim_method(Adam(learningrate=3e-3))
+        .set_end_when(Trigger.max_epoch(8))
+        .optimize()
+    )
+    res = Evaluator(trained).test(
+        DataSet.array(samples[1792:]),
+        [Top1Accuracy(), HitRatio(k=2), NDCG(k=2)], batch_size=128)
+    for name, r in res.items():
+        print(name, r.result()[0])
+    return trained
+
+
+if __name__ == "__main__":
+    main()

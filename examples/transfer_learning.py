@@ -1,0 +1,40 @@
+"""Transfer learning through the estimator API (reference:
+example/MLPipeline DLClassifier transfer-learning demo): take a
+"pretrained" conv backbone, attach a fresh head, fit on a DataFrame."""
+
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.ml import DLClassifier
+
+H = W = 8
+
+
+def main():
+    rng = np.random.RandomState(0)
+    ys = rng.randint(0, 2, 256).astype(np.int32)
+    xs = (rng.rand(256, H, W, 1) * 0.4 +
+          ys[:, None, None, None] * 0.6).astype(np.float32)
+
+    # "pretrained" backbone (weights would come from load_caffe/load_tf)
+    backbone = nn.Sequential(
+        nn.SpatialConvolution(1, 4, 3, 3), nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2, 2, 2), nn.Reshape([4 * 3 * 3]))
+    head = nn.Sequential(nn.Linear(4 * 3 * 3, 2), nn.LogSoftMax())
+    model = nn.Sequential(backbone, head)
+
+    df = {"features": list(xs.reshape(256, -1)), "label": list(ys)}
+    clf = (DLClassifier(model, nn.ClassNLLCriterion(), [H, W, 1])
+           .set_batch_size(64).set_max_epoch(12).set_learning_rate(0.3))
+    fitted = clf.fit(df)
+    out = fitted.transform(df)
+    acc = np.mean(np.asarray(out["prediction"]) == ys)
+    print("transfer-learning accuracy:", acc)
+    return fitted
+
+
+if __name__ == "__main__":
+    main()
