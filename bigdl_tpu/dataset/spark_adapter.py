@@ -1,0 +1,68 @@
+"""Optional Spark adapter.
+
+Reference parity: the reference's entire L0 substrate is Spark — RDDs
+carry the data, BlockManager carries the gradients (SURVEY.md §1). Here
+Spark is deliberately OUT of the core (the TPU data plane is per-host
+host-RAM + ICI collectives); this adapter is the bridge for users whose
+data already lives in Spark: pull an RDD/DataFrame of (feature, label)
+into this framework's `DataSet`, sharded per host.
+
+pyspark is NOT a dependency — everything is duck-typed against the RDD
+surface (`collect`, optionally `getNumPartitions`/`glom`) so plain lists
+of rows and test fakes work identically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import DataSet, LocalDataSet
+from bigdl_tpu.dataset.sample import Sample
+
+__all__ = ["rdd_to_dataset", "dataframe_to_dataset"]
+
+
+def _to_sample(row: Any) -> Sample:
+    if isinstance(row, Sample):
+        return row
+    if isinstance(row, dict):
+        return Sample(np.asarray(row["features"]),
+                      np.asarray(row["label"]))
+    feature, label = row
+    return Sample(np.asarray(feature), np.asarray(label))
+
+
+def rdd_to_dataset(rdd: Any, process_id: Optional[int] = None,
+                   num_processes: Optional[int] = None) -> LocalDataSet:
+    """Materialize an RDD of (feature, label) rows / dicts / Samples into
+    a LocalDataSet. In a multi-host job, pass this host's
+    `jax.process_index()`/`jax.process_count()` (defaulted when jax is
+    initialized) and each host keeps only its shard — mirroring the
+    reference's partition-per-executor layout without Spark executors
+    doing the training."""
+    rows = rdd.collect() if hasattr(rdd, "collect") else list(rdd)
+    if process_id is None:
+        try:
+            import jax
+
+            process_id = jax.process_index()
+            num_processes = jax.process_count()
+        except Exception:
+            process_id, num_processes = 0, 1
+    if num_processes and num_processes > 1:
+        rows = rows[process_id::num_processes]
+    return DataSet.array([_to_sample(r) for r in rows])
+
+
+def dataframe_to_dataset(df: Any, features_col: str = "features",
+                         label_col: str = "label", **kw) -> LocalDataSet:
+    """Spark DataFrame → DataSet via its RDD of Rows (duck-typed: any
+    object with `.select(...).rdd` or dict-like rows)."""
+    if hasattr(df, "select"):
+        rdd = df.select(features_col, label_col).rdd
+        return rdd_to_dataset(rdd, **kw)
+    # plain dict-of-columns (the estimator API's DataFrame stand-in)
+    rows = list(zip(df[features_col], df[label_col]))
+    return rdd_to_dataset(rows, **kw)

@@ -1,0 +1,55 @@
+"""Numerical-debug helpers.
+
+Reference parity: SURVEY.md §5.2 — the reference has no sanitizers
+(JVM memory safety + tensor confinement); the functional-JAX equivalents
+are NaN trapping and deterministic seeding, provided here.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Iterator
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["debug_nans", "assert_all_finite", "deterministic"]
+
+
+@contextlib.contextmanager
+def debug_nans(enable: bool = True) -> Iterator[None]:
+    """Trap NaNs at their producing op (jax_debug_nans): any jitted
+    computation that produces a NaN re-runs un-jitted and raises with the
+    exact primitive. Expensive — test/debug only."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", enable)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def assert_all_finite(tree: Any, name: str = "tree") -> None:
+    """Eager finite-ness check over a pytree (params, grads, …)."""
+    bad = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                     jnp.floating):
+            if not bool(jnp.isfinite(leaf).all()):
+                bad.append(jax.tree_util.keystr(path))
+    if bad:
+        raise FloatingPointError(
+            f"non-finite values in {name} at: {', '.join(bad)}")
+
+
+@contextlib.contextmanager
+def deterministic(seed: int = 0) -> Iterator[jax.Array]:
+    """Deterministic-seed test mode: yields a PRNG key and pins the
+    threefry partitionable implementation so the stream is identical
+    across shardings/devices."""
+    prev = jax.config.jax_threefry_partitionable
+    jax.config.update("jax_threefry_partitionable", True)
+    try:
+        yield jax.random.PRNGKey(seed)
+    finally:
+        jax.config.update("jax_threefry_partitionable", prev)

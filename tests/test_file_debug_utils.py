@@ -1,0 +1,85 @@
+"""utils.file (File.save/load parity) + utils.debug tests."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu.utils import debug, file as bfile
+
+
+class TestFile:
+    def test_object_roundtrip(self, tmp_path):
+        obj = {"a": 1, "b": [1.5, "x"]}
+        p = str(tmp_path / "sub" / "obj.bin")
+        bfile.save(obj, p)
+        assert bfile.load(p) == obj
+
+    def test_no_overwrite(self, tmp_path):
+        p = str(tmp_path / "o.bin")
+        bfile.save(1, p)
+        with pytest.raises(FileExistsError):
+            bfile.save(2, p, overwrite=False)
+
+    def test_tensor_tree_roundtrip(self, tmp_path):
+        tree = {"layer1": {"weight": np.arange(6.0).reshape(2, 3),
+                           "bias": np.zeros(3)},
+                "top": np.ones(2)}
+        p = str(tmp_path / "t.npz")
+        bfile.save_tensors(tree, p)
+        back = bfile.load_tensors(p)
+        np.testing.assert_array_equal(back["layer1"]["weight"],
+                                      tree["layer1"]["weight"])
+        np.testing.assert_array_equal(back["top"], tree["top"])
+
+
+class TestDebug:
+    def test_assert_all_finite_passes(self):
+        debug.assert_all_finite({"w": jnp.ones(3)})
+
+    def test_assert_all_finite_names_bad_leaf(self):
+        with pytest.raises(FloatingPointError, match="bad"):
+            debug.assert_all_finite(
+                {"ok": jnp.ones(2), "bad": jnp.asarray([1.0, jnp.nan])},
+                name="grads")
+
+    def test_debug_nans_traps(self):
+        import jax
+
+        with debug.debug_nans():
+            with pytest.raises(FloatingPointError):
+                jax.jit(lambda x: 0.0 / x)(jnp.asarray(0.0))
+
+    def test_deterministic_repeats(self):
+        import jax
+
+        with debug.deterministic(7) as k1:
+            a = jax.random.normal(k1, (4,))
+        with debug.deterministic(7) as k2:
+            b = jax.random.normal(k2, (4,))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestSparkAdapter:
+    def test_rdd_like_and_sharding(self):
+        from bigdl_tpu.dataset.spark_adapter import rdd_to_dataset
+
+        class FakeRDD:
+            def __init__(self, rows):
+                self.rows = rows
+
+            def collect(self):
+                return list(self.rows)
+
+        rows = [(np.ones(3) * i, i % 2) for i in range(10)]
+        ds = rdd_to_dataset(FakeRDD(rows), process_id=1, num_processes=2)
+        assert ds.size() == 5  # odd indices only
+        feats = [s.feature[0] for s in ds.elements]
+        assert feats == [1.0, 3.0, 5.0, 7.0, 9.0]
+
+    def test_dataframe_stand_in(self):
+        from bigdl_tpu.dataset.spark_adapter import dataframe_to_dataset
+
+        df = {"features": [np.zeros(2), np.ones(2)], "label": [0, 1]}
+        ds = dataframe_to_dataset(df, process_id=0, num_processes=1)
+        assert ds.size() == 2
