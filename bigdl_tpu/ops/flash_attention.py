@@ -156,7 +156,10 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
         lse = m_scr[:, :1] + jnp.log(safe_l)
         lse = jnp.where(l == 0.0, _NEG_INF, lse)             # (bq, 1)
-        lse_ref[0, :] = lse[:, 0]
+        # lane-broadcast: Mosaic requires the minor-most two block dims be
+        # (8k, 128)-tileable, so lse rides a (bq, 128) block; the caller
+        # reads lane 0
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _pad_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
@@ -197,11 +200,11 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, dp), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -210,7 +213,7 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k,
         ],
         interpret=interpret,
     )(qp, kp, vp)
-    return out_p[:, :seq_q, :dim], lse_p[:, :seq_q]
+    return out_p[:, :seq_q, :dim], lse_p[:, :seq_q, 0]
 
 
 # --------------------------------------------------------------------------

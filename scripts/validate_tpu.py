@@ -1,0 +1,85 @@
+"""Hardware validation — run on a real TPU (not CPU sim) to check the
+paths the CPU test suite can only exercise in interpret/simulation mode:
+the Pallas flash-attention kernel lowering, bf16 training numerics, and
+fenced throughput sanity. Usage: python scripts/validate_tpu.py"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print("device:", dev, dev.platform)
+    if dev.platform != "tpu":
+        print("not a TPU — nothing to validate here")
+        return 1
+
+    from bigdl_tpu import nn, ops
+    from bigdl_tpu.ops.flash_attention import attention_reference
+
+    # --- pallas flash attention lowers, matches, and is competitive ---
+    rng = np.random.RandomState(0)
+    B, H, S, D = 2, 4, 1024, 64
+    q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+    out = ops.flash_attention(q, k, v, causal=True)
+    ref = attention_reference(q, k, v, causal=True)
+    err = float(jnp.abs(out - ref).max())
+    print(f"flash_attention pallas err={err:.4g}")
+    assert err < 0.05, "pallas kernel diverges from reference"
+
+    f = jax.jit(lambda q: ops.flash_attention(q, k, v, causal=True))
+    r = jax.jit(lambda q: attention_reference(q, k, v, causal=True))
+    float(f(q).sum()); float(r(q).sum())
+    for name, fn in (("pallas", f), ("xla-ref", r)):
+        t0 = time.perf_counter()
+        acc = None
+        for _ in range(20):
+            acc = fn(q)
+        float(acc.sum())
+        print(f"  {name}: {(time.perf_counter() - t0) / 20 * 1e3:.2f} ms")
+
+    # --- bf16 train step is finite and fast ---
+    from bigdl_tpu.models import lenet
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.utils.precision import DEFAULT_MIXED as P
+
+    model = lenet.build(10)
+    variables = model.init(jax.random.PRNGKey(0))
+    method = SGD(learningrate=0.1)
+    slots = method.init_slots(variables["params"])
+    crit = nn.ClassNLLCriterion()
+
+    mod_state = variables["state"]
+
+    @jax.jit
+    def step(params, slots, bx, by):
+        def lf(p):
+            o, _ = model.apply(
+                {"params": P.cast_to_compute(p), "state": mod_state},
+                P.cast_to_compute(bx), training=False)
+            return crit(P.cast_to_output(o), by)
+        loss, g = jax.value_and_grad(lf)(params)
+        params, slots = method.update(g, params, slots,
+                                      jnp.asarray(0.1), jnp.asarray(0))
+        return params, slots, loss
+
+    bx = jnp.asarray(rng.rand(128, 28, 28, 1), jnp.float32)
+    by = jnp.asarray(rng.randint(0, 10, 128), jnp.int32)
+    params = variables["params"]
+    for _ in range(3):
+        params, slots, loss = step(params, slots, bx, by)
+    assert np.isfinite(float(loss))
+    print(f"bf16 train step ok, loss={float(loss):.4f}")
+    print("ALL TPU VALIDATIONS PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
