@@ -12,11 +12,16 @@ from bigdl_tpu.keras.layers import (
     Dense, Dropout, Embedding, Flatten, GlobalAveragePooling2D, InputLayer,
     LSTM, MaxPooling2D, Reshape,
 )
+from bigdl_tpu.keras.layers_extra import (
+    Bidirectional, Conv3D, GRU, GlobalMaxPooling2D, MaxPooling3D,
+    SimpleRNN, UpSampling2D,
+)
 from bigdl_tpu.keras.models import Sequential
 
 __all__ = [
     "Sequential", "Dense", "Conv2D", "Convolution2D", "MaxPooling2D",
     "AveragePooling2D", "GlobalAveragePooling2D", "Flatten", "Activation",
     "Dropout", "Embedding", "BatchNormalization", "LSTM", "Reshape",
-    "InputLayer",
+    "InputLayer", "Conv3D", "MaxPooling3D", "UpSampling2D",
+    "GlobalMaxPooling2D", "SimpleRNN", "GRU", "Bidirectional",
 ]

@@ -1,0 +1,126 @@
+"""Keras layer wrappers, tranche 2: 3-D conv/pool, upsampling, global
+max-pool, recurrent variants (reference parity: the nn/keras layer set)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from bigdl_tpu import nn
+from bigdl_tpu.keras.layers import KerasLayer, activation_module
+
+
+class Conv3D(KerasLayer):
+    """3-D conv over (D, H, W, C) input."""
+
+    def __init__(self, filters: int, kernel_size, strides=(1, 1, 1),
+                 padding: str = "valid", activation: Optional[str] = None,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.filters = filters
+        self.kernel = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.strides = (strides,) * 3 if isinstance(strides, int) \
+            else tuple(strides)
+        self.padding = padding
+        self.activation = activation
+
+    def build(self, input_shape):
+        d, h, w, c = input_shape
+        pad = -1 if self.padding == "same" else 0
+        m = self._named(nn.VolumetricConvolution(
+            c, self.filters, self.kernel[0], self.kernel[2], self.kernel[1],
+            self.strides[0], self.strides[2], self.strides[1],
+            pad_t=pad, pad_w=pad, pad_h=pad))
+        out = self._infer_out(m, input_shape)
+        act = activation_module(self.activation)
+        if act is not None:
+            m = nn.Sequential(m, act)
+        return m, out
+
+
+class MaxPooling3D(KerasLayer):
+    def __init__(self, pool_size=(2, 2, 2), strides=None,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.pool = (pool_size,) * 3 if isinstance(pool_size, int) \
+            else tuple(pool_size)
+        self.strides = tuple(strides) if strides is not None else self.pool
+
+    def build(self, input_shape):
+        m = self._named(nn.VolumetricMaxPooling(
+            self.pool[0], self.pool[2], self.pool[1],
+            self.strides[0], self.strides[2], self.strides[1]))
+        return m, self._infer_out(m, input_shape)
+
+
+class UpSampling2D(KerasLayer):
+    def __init__(self, size: int = 2, interpolation: str = "nearest",
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.size = size
+        self.interpolation = interpolation
+
+    def build(self, input_shape):
+        if self.interpolation == "nearest":
+            m = nn.SpatialUpSamplingNearest(self.size)
+        else:
+            m = nn.SpatialUpSamplingBilinear(self.size,
+                                             align_corners=False)
+        h, w, c = input_shape
+        return self._named(m), (h * self.size, w * self.size, c)
+
+
+class GlobalMaxPooling2D(KerasLayer):
+    def build(self, input_shape):
+        m = self._named(nn.Sequential(
+            nn.Max(dimension=2, squeeze=True),
+            nn.Max(dimension=2, squeeze=True)))
+        return m, (input_shape[-1],)
+
+
+class SimpleRNN(KerasLayer):
+    def __init__(self, units: int, return_sequences: bool = False,
+                 input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.units = units
+        self.return_sequences = return_sequences
+
+    def _cell(self, feat):
+        return nn.RnnCell(feat, self.units)
+
+    def build(self, input_shape):
+        seq_len, feat = input_shape
+        m = nn.Recurrent(self._cell(feat))
+        if not self.return_sequences:
+            m = nn.Sequential(m, nn.Select(2, -1))
+            return self._named(m), (self.units,)
+        return self._named(m), (seq_len, self.units)
+
+
+class GRU(SimpleRNN):
+    def _cell(self, feat):
+        return nn.GRU(feat, self.units)
+
+
+class Bidirectional(KerasLayer):
+    """Wrap an LSTM/GRU/SimpleRNN layer config to run both directions
+    (concat merge, like the reference's BiRecurrent)."""
+
+    def __init__(self, layer, input_shape=None, name=None):
+        super().__init__(input_shape or layer.input_shape, name)
+        self.layer = layer
+
+    def build(self, input_shape):
+        seq_len, feat = input_shape
+        units = self.layer.units
+        if isinstance(self.layer, GRU):
+            cell = lambda: nn.GRU(feat, units)
+        elif isinstance(self.layer, SimpleRNN):
+            cell = lambda: nn.RnnCell(feat, units)
+        else:  # keras.LSTM config from layers.py
+            cell = lambda: nn.LSTM(feat, units)
+        m = nn.BiRecurrent(cell(), cell())
+        if not getattr(self.layer, "return_sequences", False):
+            m = nn.Sequential(m, nn.Select(2, -1))
+            return self._named(m), (2 * units,)
+        return self._named(m), (seq_len, 2 * units)

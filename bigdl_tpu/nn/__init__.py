@@ -13,13 +13,21 @@ from bigdl_tpu.nn.initialization import (
     InitializationMethod, Xavier, MsraFiller, RandomUniform, RandomNormal,
     Zeros, Ones, ConstInitMethod,
 )
-from bigdl_tpu.nn.linear import Linear, Bilinear, CMul, CAdd
+from bigdl_tpu.nn.linear import (
+    Linear, Bilinear, CMul, CAdd, Cosine, Euclidean,
+)
 from bigdl_tpu.nn.conv import (
     SpatialConvolution, SpatialShareConvolution, SpatialDilatedConvolution,
     SpatialFullConvolution, TemporalConvolution,
 )
 from bigdl_tpu.nn.pooling import (
     SpatialMaxPooling, SpatialAveragePooling, TemporalMaxPooling,
+)
+from bigdl_tpu.nn.volumetric import (
+    VolumetricConvolution, VolumetricMaxPooling, VolumetricAveragePooling,
+)
+from bigdl_tpu.nn.upsampling import (
+    SpatialUpSamplingNearest, SpatialUpSamplingBilinear,
 )
 from bigdl_tpu.nn.normalization import (
     BatchNormalization, SpatialBatchNormalization, SpatialCrossMapLRN, Normalize,
@@ -28,14 +36,15 @@ from bigdl_tpu.nn.normalization import (
 from bigdl_tpu.nn.activation import (
     ReLU, ReLU6, Tanh, Sigmoid, SoftMax, LogSoftMax, SoftPlus, SoftSign,
     ELU, GELU, LeakyReLU, HardTanh, Clamp, Abs, Power, Square, Sqrt, Log, Exp,
-    PReLU,
+    PReLU, HardSigmoid, Swish, Mish, SReLU, RReLU,
 )
 from bigdl_tpu.nn.dropout import (
     Dropout, SpatialDropout2D, GaussianNoise, GaussianDropout,
 )
 from bigdl_tpu.nn.reshape import (
     Reshape, View, Squeeze, Unsqueeze, Select, Narrow, Transpose, Contiguous,
-    Identity, Echo, SpatialZeroPadding, Padding,
+    Identity, Echo, SpatialZeroPadding, Padding, AddConstant, MulConstant,
+    Replicate, Masking, GradientReversal,
 )
 from bigdl_tpu.nn.table_ops import (
     CAddTable, CMulTable, CSubTable, CDivTable, CMaxTable, CMinTable,
@@ -57,5 +66,6 @@ from bigdl_tpu.nn.criterion import (
     BCECriterion, SmoothL1Criterion, MarginCriterion, MultiLabelMarginCriterion,
     HingeEmbeddingCriterion, CosineEmbeddingCriterion, DistKLDivCriterion,
     KLDCriterion, L1Cost, ClassSimplexCriterion, ParallelCriterion,
-    MultiCriterion, TimeDistributedCriterion,
+    MultiCriterion, TimeDistributedCriterion, MultiMarginCriterion,
+    MarginRankingCriterion, CosineProximityCriterion,
 )

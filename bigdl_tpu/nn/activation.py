@@ -167,3 +167,75 @@ class PReLU(Module):
         w = variables["params"]["weight"]
         # shared slope broadcasts; per-channel slope rides the trailing C axis
         return jnp.where(x >= 0, x, w * x), variables["state"]
+
+
+class HardSigmoid(_Elementwise):
+    """clip(0.2x + 0.5, 0, 1) (reference: nn/HardSigmoid.scala)."""
+
+    def _fn(self, x):
+        return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+class Swish(_Elementwise):
+    """x·sigmoid(x) — SiLU (post-reference addition; torch.nn.SiLU is the
+    oracle)."""
+
+    def _fn(self, x):
+        return x * jax.nn.sigmoid(x)
+
+
+class Mish(_Elementwise):
+    """x·tanh(softplus(x)) (reference line's nn/Mish)."""
+
+    def _fn(self, x):
+        return x * jnp.tanh(jax.nn.softplus(x))
+
+
+class SReLU(Module):
+    """S-shaped ReLU with four learnable per-channel params
+    (reference: nn/SReLU.scala; keras-1 SReLU):
+    y = t_r + a_r (x - t_r)  if x >= t_r
+        x                    if t_l < x < t_r
+        t_l + a_l (x - t_l)  if x <= t_l
+    """
+
+    def __init__(self, shape, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.shape = tuple(shape)
+
+    def init_params(self, rng):
+        return {
+            "t_left": jnp.zeros(self.shape, jnp.float32),
+            "a_left": jnp.full(self.shape, 0.2, jnp.float32),
+            "t_right": jnp.ones(self.shape, jnp.float32),
+            "a_right": jnp.full(self.shape, 0.2, jnp.float32),
+        }
+
+    def apply(self, variables, x, training=False, rng=None):
+        p = variables["params"]
+        tl, al, tr, ar = (p["t_left"], p["a_left"], p["t_right"],
+                          p["a_right"])
+        y = jnp.where(x >= tr, tr + ar * (x - tr), x)
+        y = jnp.where(x <= tl, tl + al * (x - tl), y)
+        return y, variables["state"]
+
+
+class RReLU(Module):
+    """Randomized leaky ReLU (reference: nn/RReLU.scala): negative slope
+    ~U(lower, upper) during training, fixed mean slope at eval."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.lower = lower
+        self.upper = upper
+
+    def apply(self, variables, x, training=False, rng=None):
+        if training:
+            if rng is None:
+                raise ValueError("RReLU in training mode needs rng")
+            a = jax.random.uniform(rng, x.shape, x.dtype, self.lower,
+                                   self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(x >= 0, x, a * x), variables["state"]

@@ -294,3 +294,55 @@ class TimeDistributedCriterion(Criterion):
         elif not inner_avg and self.size_average:
             loss = loss / t
         return loss
+
+
+class MultiMarginCriterion(Criterion):
+    """Multi-class margin loss (reference: nn/MultiMarginCriterion.scala;
+    torch.nn.MultiMarginLoss is the oracle). target: (N,) class ids."""
+
+    def __init__(self, p: int = 1, margin: float = 1.0,
+                 size_average: bool = True):
+        if p not in (1, 2):
+            raise ValueError("p must be 1 or 2")
+        self.p = p
+        self.margin = margin
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        n, c = input.shape
+        tgt = jnp.take_along_axis(
+            input, target[:, None].astype(jnp.int32), axis=1)
+        h = jnp.maximum(0.0, self.margin - tgt + input)
+        if self.p == 2:
+            h = h * h
+        mask = jax.nn.one_hot(target, c, dtype=input.dtype)
+        per_sample = jnp.sum(h * (1.0 - mask), axis=1) / c
+        return jnp.mean(per_sample) if self.size_average \
+            else jnp.sum(per_sample)
+
+
+class MarginRankingCriterion(Criterion):
+    """Ranking margin over a pair table (reference:
+    nn/MarginRankingCriterion.scala). input: (x1, x2); target ±1."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        self.margin = margin
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        x1, x2 = input[0], input[1]
+        y = target if not isinstance(target, (tuple, list)) else target[0]
+        h = jnp.maximum(0.0, -y * (x1 - x2) + self.margin)
+        return _reduce(h, self.size_average)
+
+
+class CosineProximityCriterion(Criterion):
+    """Negative mean cosine proximity (reference:
+    nn/CosineProximityCriterion.scala; keras cosine_proximity)."""
+
+    def forward(self, input, target):
+        xn = input / jnp.maximum(
+            jnp.linalg.norm(input, axis=-1, keepdims=True), 1e-12)
+        tn = target / jnp.maximum(
+            jnp.linalg.norm(target, axis=-1, keepdims=True), 1e-12)
+        return -jnp.mean(jnp.sum(xn * tn, axis=-1))

@@ -118,3 +118,50 @@ class Bilinear(Module):
         if self.with_bias:
             y = y + variables["params"]["bias"]
         return y, variables["state"]
+
+
+class Cosine(Module):
+    """Cosine similarity of the input to each of `output_size` learned
+    templates (reference: nn/Cosine.scala; weight (out, in))."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.input_size = input_size
+        self.output_size = output_size
+
+    def init_params(self, rng):
+        lim = 1.0 / (self.input_size ** 0.5)
+        return {"weight": jax.random.uniform(
+            rng, (self.output_size, self.input_size), jnp.float32,
+            -lim, lim)}
+
+    def apply(self, variables, x, training=False, rng=None):
+        w = variables["params"]["weight"]
+        xn = x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True),
+                             1e-12)
+        wn = w / jnp.maximum(jnp.linalg.norm(w, axis=-1, keepdims=True),
+                             1e-12)
+        return xn @ wn.T, variables["state"]
+
+
+class Euclidean(Module):
+    """Euclidean distance of the input to each learned template
+    (reference: nn/Euclidean.scala; weight (in, out))."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.input_size = input_size
+        self.output_size = output_size
+
+    def init_params(self, rng):
+        lim = 1.0 / (self.input_size ** 0.5)
+        return {"weight": jax.random.uniform(
+            rng, (self.input_size, self.output_size), jnp.float32,
+            -lim, lim)}
+
+    def apply(self, variables, x, training=False, rng=None):
+        w = variables["params"]["weight"]  # (in, out)
+        diff = x[..., :, None] - w[None, :, :]
+        return jnp.linalg.norm(diff, axis=-2), variables["state"]

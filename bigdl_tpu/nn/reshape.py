@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 
 from bigdl_tpu.nn.module import Module
@@ -169,3 +170,77 @@ class Padding(Module):
         pads = [(0, 0)] * x.ndim
         pads[ax] = (-self.pad, 0) if self.pad < 0 else (0, self.pad)
         return jnp.pad(x, pads, constant_values=self.value), variables["state"]
+
+
+class AddConstant(Module):
+    """x + c (reference: nn/AddConstant.scala)."""
+
+    def __init__(self, constant_scalar: float, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.constant_scalar = constant_scalar
+
+    def apply(self, variables, x, training=False, rng=None):
+        return x + self.constant_scalar, variables["state"]
+
+
+class MulConstant(Module):
+    """x * c (reference: nn/MulConstant.scala)."""
+
+    def __init__(self, scalar: float, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.scalar = scalar
+
+    def apply(self, variables, x, training=False, rng=None):
+        return x * self.scalar, variables["state"]
+
+
+class Replicate(Module):
+    """Insert a new dim of size n_features at (1-based) dim
+    (reference: nn/Replicate.scala)."""
+
+    def __init__(self, n_features: int, dim: int = 1,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.n_features = n_features
+        self.dim = dim
+
+    def apply(self, variables, x, training=False, rng=None):
+        y = jnp.expand_dims(x, self.dim - 1)
+        reps = [1] * y.ndim
+        reps[self.dim - 1] = self.n_features
+        return jnp.tile(y, reps), variables["state"]
+
+
+class Masking(Module):
+    """Zero every timestep equal to mask_value across features
+    (reference: nn/Masking.scala; keras Masking)."""
+
+    def __init__(self, mask_value: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.mask_value = mask_value
+
+    def apply(self, variables, x, training=False, rng=None):
+        keep = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        return jnp.where(keep, x, 0.0), variables["state"]
+
+
+class GradientReversal(Module):
+    """Identity forward, -lambda·grad backward (reference:
+    nn/GradientReversal.scala — domain-adversarial training)."""
+
+    def __init__(self, the_lambda: float = 1.0,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.the_lambda = the_lambda
+
+    def apply(self, variables, x, training=False, rng=None):
+        lam = self.the_lambda
+
+        @jax.custom_vjp
+        def rev(v):
+            return v
+
+        rev.defvjp(lambda v: (v, None),
+                   lambda _, g: (jnp.negative(g) * lam,))
+        return rev(x), variables["state"]

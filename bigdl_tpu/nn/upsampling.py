@@ -1,0 +1,59 @@
+"""Spatial upsampling.
+
+Reference parity: nn/SpatialUpSamplingNearest.scala,
+nn/SpatialUpSamplingBilinear.scala (integer scale; bilinear supports
+align_corners). NHWC; lowered to gather/resize ops XLA vectorizes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+class SpatialUpSamplingNearest(Module):
+    def __init__(self, scale: int, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.scale = int(scale)
+
+    def apply(self, variables, x, training=False, rng=None):
+        s = self.scale
+        y = jnp.repeat(jnp.repeat(x, s, axis=1), s, axis=2)
+        return y, variables["state"]
+
+
+class SpatialUpSamplingBilinear(Module):
+    """Bilinear ×scale upsampling; align_corners=True matches the
+    reference's (torch-style) default."""
+
+    def __init__(self, scale: int, align_corners: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.scale = int(scale)
+        self.align_corners = align_corners
+
+    def apply(self, variables, x, training=False, rng=None):
+        n, h, w, c = x.shape
+        oh, ow = h * self.scale, w * self.scale
+        if self.align_corners and oh > 1 and ow > 1:
+            ys = jnp.linspace(0.0, h - 1.0, oh)
+            xs = jnp.linspace(0.0, w - 1.0, ow)
+        else:
+            ys = (jnp.arange(oh) + 0.5) * (h / oh) - 0.5
+            xs = (jnp.arange(ow) + 0.5) * (w / ow) - 0.5
+            ys = jnp.clip(ys, 0.0, h - 1.0)
+            xs = jnp.clip(xs, 0.0, w - 1.0)
+        y0 = jnp.floor(ys).astype(jnp.int32)
+        x0 = jnp.floor(xs).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        wy = (ys - y0)[None, :, None, None]
+        wx = (xs - x0)[None, None, :, None]
+        g = lambda yi, xi: x[:, yi][:, :, xi]
+        top = g(y0, x0) * (1 - wx) + g(y0, x1) * wx
+        bot = g(y1, x0) * (1 - wx) + g(y1, x1) * wx
+        return top * (1 - wy) + bot * wy, variables["state"]

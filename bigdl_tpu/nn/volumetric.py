@@ -1,0 +1,110 @@
+"""Volumetric (3-D) convolution and pooling.
+
+Reference parity: nn/VolumetricConvolution.scala,
+nn/VolumetricMaxPooling.scala, nn/VolumetricAveragePooling.scala
+(arg order kT,kW,kH,dT,dW,dH,padT,padW,padH). Data layout here is
+NDHWC (depth/time major of the spatial dims) with DHWIO kernels —
+the direct 3-D extension of this framework's NHWC/HWIO convention, which
+XLA:TPU tiles onto the MXU without relayout.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.initialization import InitializationMethod, Xavier, Zeros
+from bigdl_tpu.nn.module import Module
+
+
+class VolumetricConvolution(Module):
+    """3-D conv over (N, D, H, W, C) input."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 k_t: int, k_w: int, k_h: int,
+                 d_t: int = 1, d_w: int = 1, d_h: int = 1,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 with_bias: bool = True,
+                 w_init: Optional[InitializationMethod] = None,
+                 b_init: Optional[InitializationMethod] = None,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.k_t, self.k_w, self.k_h = k_t, k_w, k_h
+        self.d_t, self.d_w, self.d_h = d_t, d_w, d_h
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+        self.with_bias = with_bias
+        self.w_init = w_init or Xavier()
+        self.b_init = b_init or Zeros()
+
+    def init_params(self, rng):
+        wk, bk = jax.random.split(rng)
+        fan_in = self.n_input_plane * self.k_t * self.k_h * self.k_w
+        fan_out = self.n_output_plane * self.k_t * self.k_h * self.k_w
+        p = {"weight": self.w_init(
+            wk, (self.k_t, self.k_h, self.k_w, self.n_input_plane,
+                 self.n_output_plane),
+            fan_in=fan_in, fan_out=fan_out)}
+        if self.with_bias:
+            p["bias"] = self.b_init(bk, (self.n_output_plane,),
+                                    fan_in=fan_in, fan_out=fan_out)
+        return p
+
+    def apply(self, variables, x, training=False, rng=None):
+        p = variables["params"]
+        if self.pad_w == -1:  # SAME (reference -1 convention)
+            padding = "SAME"
+        else:
+            padding = [(self.pad_t, self.pad_t), (self.pad_h, self.pad_h),
+                       (self.pad_w, self.pad_w)]
+        dn = lax.conv_dimension_numbers(
+            x.shape, p["weight"].shape, ("NDHWC", "DHWIO", "NDHWC"))
+        y = lax.conv_general_dilated(
+            x, p["weight"],
+            window_strides=(self.d_t, self.d_h, self.d_w),
+            padding=padding, dimension_numbers=dn)
+        if self.with_bias:
+            y = y + p["bias"]
+        return y, variables["state"]
+
+
+class _VolumetricPool(Module):
+    def __init__(self, k_t: int, k_w: int, k_h: int,
+                 d_t: Optional[int] = None, d_w: Optional[int] = None,
+                 d_h: Optional[int] = None,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.k_t, self.k_w, self.k_h = k_t, k_w, k_h
+        self.d_t = d_t if d_t is not None else k_t
+        self.d_w = d_w if d_w is not None else k_w
+        self.d_h = d_h if d_h is not None else k_h
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+
+    def _pads(self):
+        return [(0, 0), (self.pad_t, self.pad_t), (self.pad_h, self.pad_h),
+                (self.pad_w, self.pad_w), (0, 0)]
+
+    def _dims(self):
+        return ((1, self.k_t, self.k_h, self.k_w, 1),
+                (1, self.d_t, self.d_h, self.d_w, 1))
+
+
+class VolumetricMaxPooling(_VolumetricPool):
+    def apply(self, variables, x, training=False, rng=None):
+        dims, strides = self._dims()
+        y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides,
+                              self._pads())
+        return y, variables["state"]
+
+
+class VolumetricAveragePooling(_VolumetricPool):
+    def apply(self, variables, x, training=False, rng=None):
+        dims, strides = self._dims()
+        s = lax.reduce_window(x, 0.0, lax.add, dims, strides, self._pads())
+        y = s / (self.k_t * self.k_h * self.k_w)
+        return y, variables["state"]

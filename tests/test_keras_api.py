@@ -81,3 +81,51 @@ class TestFit:
         preds = m.predict_classes(xs[192:200])
         assert preds.shape == (8,)
         assert (preds == ys[192:200]).mean() > 0.8
+
+
+class TestExtraLayers:
+    def test_conv3d_chain(self):
+        m = keras.Sequential([
+            keras.Conv3D(4, 2, input_shape=(4, 6, 6, 1),
+                         activation="relu"),
+            keras.MaxPooling3D(2),
+            keras.Flatten(),
+            keras.Dense(3),
+        ])
+        m.build()
+        out = m.module.build().evaluate().forward(
+            np.zeros((2, 4, 6, 6, 1), np.float32))
+        assert out.shape == (2, 3)
+
+    def test_upsampling(self):
+        m = keras.Sequential([
+            keras.UpSampling2D(2, input_shape=(3, 3, 2)),
+        ])
+        m.build()
+        assert m.output_shape == (6, 6, 2)
+
+    def test_global_max_pool(self):
+        m = keras.Sequential([
+            keras.GlobalMaxPooling2D(input_shape=(5, 5, 7)),
+        ])
+        m.build()
+        assert m.output_shape == (7,)
+
+    def test_gru_and_bidirectional(self):
+        m = keras.Sequential([
+            keras.Embedding(30, 8, input_length=10),
+            keras.Bidirectional(keras.LSTM(12)),
+            keras.Dense(2),
+        ])
+        m.build()
+        assert m.output_shape == (2,)
+        out = m.module.build().evaluate().forward(
+            np.zeros((3, 10), np.int32))
+        assert out.shape == (3, 2)
+
+        m2 = keras.Sequential([
+            keras.Embedding(30, 8, input_length=10),
+            keras.GRU(6, return_sequences=True),
+        ])
+        m2.build()
+        assert m2.output_shape == (10, 6)

@@ -1,0 +1,248 @@
+"""Layer-catalog tranche 2: volumetric conv/pool, upsampling, extended
+activations, misc utility layers, similarity layers, margin criterions —
+torch-CPU as numeric oracle (reference: the corresponding nn/*Spec.scala
+files, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+
+KEY = jax.random.PRNGKey(0)
+
+
+def sv(m):
+    return m.init(KEY)
+
+
+class TestVolumetric:
+    def test_conv3d_vs_torch(self):
+        m = nn.VolumetricConvolution(3, 5, 2, 3, 3, 2, 1, 1, 0, 1, 1)
+        v = sv(m)
+        x = np.random.RandomState(0).randn(2, 6, 7, 8, 3).astype(np.float32)
+        y, _ = m.apply(v, jnp.asarray(x))
+        w = np.asarray(v["params"]["weight"])  # (T,H,W,I,O)
+        conv = torch.nn.Conv3d(3, 5, (2, 3, 3), stride=(2, 1, 1),
+                               padding=(0, 1, 1))
+        conv.weight.data = torch.tensor(w.transpose(4, 3, 0, 1, 2))
+        conv.bias.data = torch.tensor(np.asarray(v["params"]["bias"]))
+        # torch: NCDHW
+        ref = conv(torch.tensor(x.transpose(0, 4, 1, 2, 3)))
+        ref = ref.detach().numpy().transpose(0, 2, 3, 4, 1)
+        np.testing.assert_allclose(np.asarray(y), ref, atol=2e-4)
+
+    def test_maxpool3d_vs_torch(self):
+        m = nn.VolumetricMaxPooling(2, 2, 2)
+        x = np.random.RandomState(1).randn(1, 4, 6, 6, 2).astype(np.float32)
+        y, _ = m.apply({"params": {}, "state": {}}, jnp.asarray(x))
+        ref = torch.nn.functional.max_pool3d(
+            torch.tensor(x.transpose(0, 4, 1, 2, 3)), 2)
+        ref = ref.numpy().transpose(0, 2, 3, 4, 1)
+        np.testing.assert_allclose(np.asarray(y), ref, atol=1e-6)
+
+    def test_avgpool3d(self):
+        m = nn.VolumetricAveragePooling(2, 2, 2)
+        x = np.random.RandomState(2).randn(1, 4, 4, 4, 3).astype(np.float32)
+        y, _ = m.apply({"params": {}, "state": {}}, jnp.asarray(x))
+        ref = torch.nn.functional.avg_pool3d(
+            torch.tensor(x.transpose(0, 4, 1, 2, 3)), 2)
+        ref = ref.numpy().transpose(0, 2, 3, 4, 1)
+        np.testing.assert_allclose(np.asarray(y), ref, atol=1e-6)
+
+
+class TestUpsampling:
+    def test_nearest_vs_torch(self):
+        m = nn.SpatialUpSamplingNearest(3)
+        x = np.random.RandomState(0).randn(2, 4, 5, 3).astype(np.float32)
+        y, _ = m.apply({"params": {}, "state": {}}, jnp.asarray(x))
+        ref = torch.nn.functional.interpolate(
+            torch.tensor(x.transpose(0, 3, 1, 2)), scale_factor=3,
+            mode="nearest")
+        ref = ref.numpy().transpose(0, 2, 3, 1)
+        np.testing.assert_allclose(np.asarray(y), ref, atol=1e-6)
+
+    @pytest.mark.parametrize("align", [True, False])
+    def test_bilinear_vs_torch(self, align):
+        m = nn.SpatialUpSamplingBilinear(2, align_corners=align)
+        x = np.random.RandomState(1).randn(1, 5, 4, 2).astype(np.float32)
+        y, _ = m.apply({"params": {}, "state": {}}, jnp.asarray(x))
+        ref = torch.nn.functional.interpolate(
+            torch.tensor(x.transpose(0, 3, 1, 2)), scale_factor=2,
+            mode="bilinear", align_corners=align)
+        ref = ref.numpy().transpose(0, 2, 3, 1)
+        np.testing.assert_allclose(np.asarray(y), ref, atol=1e-5)
+
+
+class TestActivations2:
+    def _x(self):
+        return np.random.RandomState(0).randn(3, 7).astype(np.float32) * 3
+
+    def test_hard_sigmoid_vs_torch(self):
+        x = self._x()
+        y, _ = nn.HardSigmoid().apply({"params": {}, "state": {}},
+                                      jnp.asarray(x))
+        # torch hardsigmoid uses slope 1/6; reference BigDL uses 0.2 (keras)
+        ref = np.clip(0.2 * x + 0.5, 0, 1)
+        np.testing.assert_allclose(np.asarray(y), ref, atol=1e-6)
+
+    def test_swish_vs_torch(self):
+        x = self._x()
+        y, _ = nn.Swish().apply({"params": {}, "state": {}}, jnp.asarray(x))
+        ref = torch.nn.functional.silu(torch.tensor(x)).numpy()
+        np.testing.assert_allclose(np.asarray(y), ref, atol=1e-6)
+
+    def test_mish_vs_torch(self):
+        x = self._x()
+        y, _ = nn.Mish().apply({"params": {}, "state": {}}, jnp.asarray(x))
+        ref = torch.nn.functional.mish(torch.tensor(x)).numpy()
+        np.testing.assert_allclose(np.asarray(y), ref, atol=1e-5)
+
+    def test_rrelu_eval_matches_torch(self):
+        x = self._x()
+        m = nn.RReLU()
+        y, _ = m.apply({"params": {}, "state": {}}, jnp.asarray(x),
+                       training=False)
+        ref = torch.nn.functional.rrelu(torch.tensor(x),
+                                        training=False).numpy()
+        np.testing.assert_allclose(np.asarray(y), ref, atol=1e-6)
+
+    def test_rrelu_training_needs_rng(self):
+        m = nn.RReLU()
+        with pytest.raises(ValueError):
+            m.apply({"params": {}, "state": {}}, jnp.ones((2, 2)),
+                    training=True)
+        y, _ = m.apply({"params": {}, "state": {}}, -jnp.ones((64,)),
+                       training=True, rng=KEY)
+        vals = -np.asarray(y)
+        assert (vals >= 1 / 8 - 1e-6).all() and (vals <= 1 / 3 + 1e-6).all()
+        assert np.unique(np.round(vals, 6)).size > 1  # actually random
+
+    def test_srelu_identity_inside_thresholds(self):
+        m = nn.SReLU((5,))
+        v = sv(m)
+        x = jnp.asarray(np.linspace(0.1, 0.9, 5), jnp.float32)[None]
+        y, _ = m.apply(v, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=1e-6)
+        # outside: kinked
+        x2 = jnp.asarray([[-1.0, 2.0, 0.5, 3.0, -2.0]], jnp.float32)
+        y2, _ = m.apply(v, x2)
+        np.testing.assert_allclose(
+            np.asarray(y2)[0, [0, 4]], [-0.2, -0.4], atol=1e-6)
+
+
+class TestMiscLayers:
+    def test_add_mul_constant(self):
+        x = jnp.ones((2, 3))
+        y, _ = nn.AddConstant(2.5).apply({"params": {}, "state": {}}, x)
+        np.testing.assert_allclose(np.asarray(y), 3.5)
+        y, _ = nn.MulConstant(-2.0).apply({"params": {}, "state": {}}, x)
+        np.testing.assert_allclose(np.asarray(y), -2.0)
+
+    def test_replicate(self):
+        x = jnp.asarray(np.arange(6, dtype=np.float32).reshape(2, 3))
+        y, _ = nn.Replicate(4, dim=2).apply({"params": {}, "state": {}}, x)
+        assert y.shape == (2, 4, 3)
+        np.testing.assert_allclose(np.asarray(y[:, 0]), np.asarray(x))
+        np.testing.assert_allclose(np.asarray(y[:, 3]), np.asarray(x))
+
+    def test_masking(self):
+        x = jnp.asarray([[[1.0, 2.0], [0.0, 0.0], [0.0, 3.0]]])
+        y, _ = nn.Masking(0.0).apply({"params": {}, "state": {}}, x)
+        np.testing.assert_allclose(np.asarray(y[0, 1]), [0.0, 0.0])
+        np.testing.assert_allclose(np.asarray(y[0, 2]), [0.0, 3.0])
+
+    def test_gradient_reversal(self):
+        m = nn.GradientReversal(2.0)
+
+        def f(x):
+            y, _ = m.apply({"params": {}, "state": {}}, x)
+            return jnp.sum(y ** 2)
+
+        x = jnp.asarray([1.0, -2.0])
+        g = jax.grad(f)(x)
+        np.testing.assert_allclose(np.asarray(g), [-4.0, 8.0], atol=1e-6)
+        y, _ = m.apply({"params": {}, "state": {}}, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x))
+
+
+class TestSimilarity:
+    def test_cosine_rows_are_cosines(self):
+        m = nn.Cosine(6, 4)
+        v = sv(m)
+        x = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+        y, _ = m.apply(v, jnp.asarray(x))
+        w = np.asarray(v["params"]["weight"])
+        ref = (x / np.linalg.norm(x, axis=1, keepdims=True)) @ \
+            (w / np.linalg.norm(w, axis=1, keepdims=True)).T
+        np.testing.assert_allclose(np.asarray(y), ref, atol=1e-5)
+
+    def test_euclidean_distances(self):
+        m = nn.Euclidean(5, 3)
+        v = sv(m)
+        x = np.random.RandomState(1).randn(2, 5).astype(np.float32)
+        y, _ = m.apply(v, jnp.asarray(x))
+        w = np.asarray(v["params"]["weight"])  # (in, out)
+        ref = np.stack([np.linalg.norm(x - w[:, j], axis=1)
+                        for j in range(3)], axis=1)
+        np.testing.assert_allclose(np.asarray(y), ref, atol=1e-5)
+
+
+class TestCriterions2:
+    def test_multi_margin_vs_torch(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 6).astype(np.float32)
+        t = rng.randint(0, 6, 4)
+        for p in (1, 2):
+            c = nn.MultiMarginCriterion(p=p)
+            got = float(c(jnp.asarray(x), jnp.asarray(t)))
+            ref = torch.nn.functional.multi_margin_loss(
+                torch.tensor(x), torch.tensor(t), p=p).item()
+            assert abs(got - ref) < 1e-5
+
+    def test_margin_ranking_vs_torch(self):
+        rng = np.random.RandomState(1)
+        x1 = rng.randn(8).astype(np.float32)
+        x2 = rng.randn(8).astype(np.float32)
+        y = rng.choice([-1.0, 1.0], 8).astype(np.float32)
+        c = nn.MarginRankingCriterion(margin=0.5)
+        got = float(c((jnp.asarray(x1), jnp.asarray(x2)), jnp.asarray(y)))
+        ref = torch.nn.functional.margin_ranking_loss(
+            torch.tensor(x1), torch.tensor(x2), torch.tensor(y),
+            margin=0.5).item()
+        assert abs(got - ref) < 1e-6
+
+    def test_cosine_proximity(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(4, 5).astype(np.float32)
+        c = nn.CosineProximityCriterion()
+        got = float(c(jnp.asarray(x), jnp.asarray(x)))
+        assert abs(got + 1.0) < 1e-5  # identical vectors → -1
+
+
+class TestGradsFlow:
+    @pytest.mark.parametrize("builder", [
+        lambda: nn.VolumetricConvolution(2, 3, 2, 2, 2),
+        lambda: nn.Cosine(4, 2),
+        lambda: nn.Euclidean(4, 2),
+        lambda: nn.SReLU((4,)),
+    ])
+    def test_param_grads_nonzero(self, builder):
+        m = builder()
+        v = m.init(KEY)
+        shape = {"VolumetricConvolution": (1, 3, 4, 4, 2)}.get(
+            type(m).__name__, (2, 4))
+        x = jnp.asarray(np.random.RandomState(0).randn(*shape),
+                        jnp.float32)
+
+        def loss(p):
+            y, _ = m.apply({"params": p, "state": {}}, x)
+            return jnp.sum(y ** 2)
+
+        g = jax.grad(loss)(v["params"])
+        total = sum(float(jnp.abs(l).sum())
+                    for l in jax.tree_util.tree_leaves(g))
+        assert total > 0
