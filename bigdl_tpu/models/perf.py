@@ -40,17 +40,24 @@ def _build_model(name: str, class_num: int):
 
 def run_perf(model_name: str = "resnet50", batch_size: int = 32,
              iterations: int = 10, mesh_axes: Optional[str] = None,
-             optimizer: str = "sgd", class_num: int = 1000) -> dict:
+             optimizer: str = "sgd", class_num: int = 1000,
+             precision: Optional[str] = None) -> dict:
     """Steady-state throughput of the jitted train step: one warmup step
-    (compile), then `iterations` timed steps fenced with
-    block_until_ready (the jax.profiler-compatible timing discipline —
-    SURVEY.md §5.1)."""
+    (compile), then `iterations` timed steps. Timing is fenced by a real
+    device-to-host fetch of the final loss — the last step depends on
+    every prior step's params, and plain block_until_ready can be
+    optimistic through remote-device transports (SURVEY.md §5.1;
+    see also bench.py). `precision="bf16"` runs the mixed-precision
+    configuration (bf16 compute, fp32 master weights)."""
     import jax
     import jax.numpy as jnp
 
     from bigdl_tpu import nn
     from bigdl_tpu.optim import Adam, SGD
 
+    from bigdl_tpu.utils.precision import DEFAULT_MIXED
+
+    policy = DEFAULT_MIXED if precision in ("bf16", "mixed") else None
     model, shape, classes = _build_model(model_name, class_num)
     variables = model.init(jax.random.PRNGKey(0))
     method = (SGD(learningrate=0.01, momentum=0.9, dampening=0.0)
@@ -102,8 +109,15 @@ def run_perf(model_name: str = "resnet50", batch_size: int = 32,
         @jax.jit
         def step(params, state, slots, i):
             def loss_fn(p):
+                x = bx
+                if policy is not None:
+                    p = policy.cast_to_compute(p)
+                    x = policy.cast_to_compute(x)
                 out, new_state = model.apply({"params": p, "state": state},
-                                             bx, training=True)
+                                             x, training=True)
+                if policy is not None:
+                    out = policy.cast_to_output(out)
+                    new_state = policy.cast_to_output(new_state)
                 return criterion(out, by), new_state
 
             (loss, new_state), grads = jax.value_and_grad(
@@ -119,14 +133,14 @@ def run_perf(model_name: str = "resnet50", batch_size: int = 32,
             return loss
 
     t0 = time.perf_counter()
-    jax.block_until_ready(run_one(0))  # warmup + compile
+    float(run_one(0))  # warmup + compile; host fetch = honest fence
     compile_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
     loss = None
     for i in range(1, iterations + 1):
         loss = run_one(i)
-    jax.block_until_ready(loss)
+    float(loss)  # final loss depends on every step: fences the chain
     steady = time.perf_counter() - t0
 
     return {
@@ -148,9 +162,13 @@ def main(argv=None):
                     help="e.g. data=8 to benchmark the DP path")
     ap.add_argument("--optimizer", default="sgd", choices=["sgd", "adam"])
     ap.add_argument("--class-num", type=int, default=1000)
+    ap.add_argument("--precision", default=None,
+                    choices=[None, "bf16", "mixed", "fp32"],
+                    help="bf16 → mixed precision (fp32 master weights)")
     args = ap.parse_args(argv)
     result = run_perf(args.model, args.batch_size, args.iterations,
-                      args.mesh, args.optimizer, args.class_num)
+                      args.mesh, args.optimizer, args.class_num,
+                      args.precision)
     print(json.dumps(result))
 
 

@@ -21,7 +21,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--model", default="lenet",
                     help="lenet | resnet20-cifar | resnet50 | resnet18 | "
-                         "inception-v1 | vgg16 | alexnet")
+                         "inception-v1 | vgg16 | alexnet | "
+                         "textclassifier | ncf | bilstm")
     ap.add_argument("-f", "--dataFolder", default=None)
     ap.add_argument("-b", "--batchSize", type=int, default=128)
     ap.add_argument("--learningRate", type=float, default=0.01)
@@ -35,6 +36,9 @@ def main(argv=None):
     ap.add_argument("--mesh", default=None, help="e.g. data=8")
     ap.add_argument("--synthetic", action="store_true",
                     help="use synthetic data (no dataset folder needed)")
+    ap.add_argument("--precision", default=None,
+                    choices=["bf16", "mixed", "fp32"],
+                    help="bf16 → mixed-precision training")
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO,
@@ -70,6 +74,37 @@ def main(argv=None):
         else:
             train = load_cifar10(args.dataFolder, train=True)
             val = load_cifar10(args.dataFolder, train=False)
+    elif args.model in ("textclassifier", "ncf", "bilstm"):
+        import numpy as np
+        from bigdl_tpu.dataset import Sample
+
+        rng = np.random.RandomState(0)
+        n = args.batchSize * 4
+        if args.model == "textclassifier":
+            from bigdl_tpu.models import textclassifier
+
+            model = textclassifier.build(class_num=4, vocab_size=200,
+                                         sequence_len=200)
+            ys = rng.randint(0, 4, n)
+            train = [Sample(rng.randint(y * 50, y * 50 + 50,
+                                        200).astype(np.int32), int(y))
+                     for y in ys]
+        elif args.model == "ncf":
+            from bigdl_tpu.models import ncf
+
+            model = ncf.build(64, 128, class_num=5)
+            train = [Sample(np.asarray(
+                [rng.randint(64), rng.randint(128)], np.int32),
+                np.int32(rng.randint(5))) for _ in range(n)]
+        else:  # bilstm sentiment
+            from bigdl_tpu.models import rnn
+
+            model = rnn.bilstm_sentiment(100, embed_dim=32, hidden_size=32)
+            ys = rng.randint(0, 2, n)
+            train = [Sample(rng.randint(y * 40, y * 40 + 40,
+                                        24).astype(np.int32), int(y))
+                     for y in ys]
+        val = train[:args.batchSize]
     else:
         from bigdl_tpu.models.perf import _build_model
         import numpy as np
@@ -106,6 +141,8 @@ def main(argv=None):
     if args.summary:
         opt.set_train_summary(TrainSummary(args.summary, args.model))
         opt.set_validation_summary(ValidationSummary(args.summary, args.model))
+    if args.precision and args.precision != "fp32":
+        opt.set_precision("bf16")
     if args.mesh:
         from bigdl_tpu.parallel import make_mesh, parse_axes
 
