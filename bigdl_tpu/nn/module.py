@@ -175,10 +175,31 @@ class Module(_SpecCaptured):
         self._training = True
         return self
 
-    def evaluate(self) -> "Module":
-        """Switch eager facade to eval mode (reference: AbstractModule.evaluate)."""
-        self._training = False
-        return self
+    def evaluate(self, dataset=None, methods=None, batch_size: int = 32):
+        """No arguments: switch the eager facade to eval mode. With a
+        dataset + validation methods: run distributed evaluation and
+        return {name: ValidationResult} — both overloads mirror the
+        reference's AbstractModule.evaluate / evaluate(rdd, methods)."""
+        if dataset is None:
+            self._training = False
+            return self
+        from bigdl_tpu.optim.evaluator import Evaluator
+
+        return Evaluator(self).test(dataset, methods,
+                                    batch_size=batch_size)
+
+    def predict(self, dataset, batch_size: int = 32):
+        """Batch inference over a dataset → stacked outputs (reference:
+        AbstractModule.predict / optim/Predictor.scala)."""
+        from bigdl_tpu.optim.evaluator import Predictor
+
+        return Predictor(self, batch_size=batch_size).predict(dataset)
+
+    def predict_class(self, dataset, batch_size: int = 32):
+        """Argmax class ids (reference: AbstractModule.predictClass)."""
+        from bigdl_tpu.optim.evaluator import Predictor
+
+        return Predictor(self, batch_size=batch_size).predict_class(dataset)
 
     def is_training(self) -> bool:
         return self._training

@@ -118,3 +118,40 @@ class TestGraph:
 
         out = f(m.variables, jnp.ones((2, 4)))
         assert out.shape == (2, 4)
+
+
+class TestModuleEvaluatePredict:
+    """AbstractModule.evaluate(dataset, methods) / predict parity."""
+
+    def _fixture(self):
+        import numpy as np
+        from bigdl_tpu import nn
+        from bigdl_tpu.dataset import DataSet, Sample
+
+        m = nn.Sequential(nn.Linear(4, 3), nn.LogSoftMax())
+        m.build(jax.random.PRNGKey(0)).evaluate()
+        rng = np.random.RandomState(0)
+        samples = [Sample(rng.rand(4).astype(np.float32),
+                          np.int32(rng.randint(3))) for _ in range(10)]
+        return m, DataSet.array(samples)
+
+    def test_evaluate_overload(self):
+        from bigdl_tpu.optim import Top1Accuracy
+
+        m, ds = self._fixture()
+        res = m.evaluate(ds, [Top1Accuracy()], batch_size=4)
+        (name, r), = res.items()
+        assert name == "Top1Accuracy"
+        assert 0.0 <= r.result()[0] <= 1.0
+        # no-arg overload still mode-switches
+        assert m.evaluate() is m
+
+    def test_predict_and_predict_class(self):
+        import numpy as np
+
+        m, ds = self._fixture()
+        out = m.predict(ds, batch_size=4)
+        assert out.shape == (10, 3)
+        cls = m.predict_class(ds, batch_size=4)
+        assert cls.shape == (10,)
+        np.testing.assert_array_equal(cls, np.argmax(out, axis=1))
