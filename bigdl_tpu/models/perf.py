@@ -113,8 +113,9 @@ def run_perf(model_name: str = "resnet50", batch_size: int = 32,
                 if policy is not None:
                     p = policy.cast_to_compute(p)
                     x = policy.cast_to_compute(x)
-                out, new_state = model.apply({"params": p, "state": state},
-                                             x, training=True)
+                out, new_state = model.apply(
+                    {"params": p, "state": state}, x, training=True,
+                    rng=jax.random.fold_in(jax.random.PRNGKey(7), i))
                 if policy is not None:
                     out = policy.cast_to_output(out)
                     new_state = policy.cast_to_output(new_state)
