@@ -43,6 +43,10 @@ def rdd_to_dataset(rdd: Any, process_id: Optional[int] = None,
     reference's partition-per-executor layout without Spark executors
     doing the training."""
     rows = rdd.collect() if hasattr(rdd, "collect") else list(rdd)
+    if (process_id is None) != (num_processes is None):
+        raise ValueError(
+            "pass process_id and num_processes together (or neither, to "
+            "read them from the jax process group)")
     if process_id is None:
         try:
             import jax
@@ -51,7 +55,7 @@ def rdd_to_dataset(rdd: Any, process_id: Optional[int] = None,
             num_processes = jax.process_count()
         except Exception:
             process_id, num_processes = 0, 1
-    if num_processes and num_processes > 1:
+    if num_processes > 1:
         rows = rows[process_id::num_processes]
     return DataSet.array([_to_sample(r) for r in rows])
 

@@ -44,7 +44,11 @@ class MaxPooling3D(KerasLayer):
         super().__init__(input_shape, name)
         self.pool = (pool_size,) * 3 if isinstance(pool_size, int) \
             else tuple(pool_size)
-        self.strides = tuple(strides) if strides is not None else self.pool
+        if strides is None:
+            self.strides = self.pool
+        else:
+            self.strides = (strides,) * 3 if isinstance(strides, int) \
+                else tuple(strides)
 
     def build(self, input_shape):
         m = self._named(nn.VolumetricMaxPooling(
@@ -54,10 +58,16 @@ class MaxPooling3D(KerasLayer):
 
 
 class UpSampling2D(KerasLayer):
-    def __init__(self, size: int = 2, interpolation: str = "nearest",
+    def __init__(self, size=2, interpolation: str = "nearest",
                  input_shape=None, name=None):
         super().__init__(input_shape, name)
-        self.size = size
+        if isinstance(size, (tuple, list)):  # keras's (2, 2) form
+            if len(set(size)) != 1:
+                raise NotImplementedError(
+                    "UpSampling2D needs a uniform scale, got "
+                    f"size={tuple(size)}")
+            size = size[0]
+        self.size = int(size)
         self.interpolation = interpolation
 
     def build(self, input_shape):

@@ -83,7 +83,8 @@ def run_perf(model_name: str = "resnet50", batch_size: int = 32,
         mesh = make_mesh(axes)
         n = mesh.shape["data"]
         spec = FlatParamSpec(variables["params"], n)
-        step = make_dp_train_step(model, criterion, method, mesh, spec)
+        step = make_dp_train_step(model, criterion, method, mesh, spec,
+                                  precision=policy)
         repl = NamedSharding(mesh, P())
         w = jax.device_put(spec.flatten(variables["params"]), repl)
         slots = jax.tree_util.tree_map(
@@ -95,7 +96,8 @@ def run_perf(model_name: str = "resnet50", batch_size: int = 32,
         by = jax.device_put(by_np, NamedSharding(mesh, P("data")))
         args = lambda i: (w, slots, state, bx, by,
                           jnp.asarray(0.01, jnp.float32),
-                          jnp.asarray(i, jnp.int32), jax.random.PRNGKey(0))
+                          jnp.asarray(i, jnp.int32),
+                          jax.random.fold_in(jax.random.PRNGKey(7), i))
 
         def run_one(i):
             nonlocal w, slots, state

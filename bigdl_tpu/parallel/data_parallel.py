@@ -90,6 +90,7 @@ def make_dp_train_step(
     grad_dtype: Optional[str] = "bfloat16",
     clip_const: Optional[Tuple[float, float]] = None,
     clip_norm: Optional[float] = None,
+    precision=None,
 ) -> Callable:
     """Build the jitted SPMD train step.
 
@@ -97,7 +98,9 @@ def make_dp_train_step(
              -> (flat_w', slots', mod_state', mean_loss)
 
     Shardings: flat_w replicated; slots sharded on `axis` (ZeRO-1);
-    mod_state replicated; batch sharded on `axis`.
+    mod_state replicated; batch sharded on `axis`. `precision` is a
+    utils.precision.Policy for bf16-compute mixed precision (master
+    weights stay fp32 in flat_w).
     """
     n = mesh.shape[axis]
     other_axes = [a for a in mesh.axis_names if a != axis]
@@ -108,9 +111,16 @@ def make_dp_train_step(
         local_rng = jax.random.fold_in(rng, my_index)
 
         def loss_fn(p):
+            x = bx
+            if precision is not None:
+                p = precision.cast_to_compute(p)
+                x = precision.cast_to_compute(x)
             out, new_state = model.apply(
-                {"params": p, "state": mod_state}, bx,
+                {"params": p, "state": mod_state}, x,
                 training=True, rng=local_rng)
+            if precision is not None:
+                out = precision.cast_to_output(out)
+                new_state = precision.cast_to_output(new_state)
             return criterion(out, by), new_state
 
         (loss, new_state), grads = jax.value_and_grad(

@@ -115,7 +115,8 @@ class DistriOptimizer(LocalOptimizer):
         step_fn = make_dp_train_step(
             o.model, o.criterion, o.optim_method, self.mesh, spec,
             axis=self.axis, grad_dtype=self.grad_dtype,
-            clip_const=o.grad_clip_const, clip_norm=o.grad_clip_norm)
+            clip_const=o.grad_clip_const, clip_norm=o.grad_clip_norm,
+            precision=o.precision)
         if o.validation_methods:
             eval_fn = make_dp_eval_step(o.model, o.validation_methods,
                                         self.mesh, self.axis)

@@ -68,12 +68,21 @@ class Engine:
                 num_processes=num_processes,
                 process_id=process_id,
             )
-        elif jax.process_count() == 1 and os.environ.get("TPU_NAME"):
-            # Cloud TPU VM: topology from metadata, no flags needed
+        elif os.environ.get("TPU_NAME"):
+            # Cloud TPU VM: topology from metadata, no flags needed.
+            # IMPORTANT: nothing may touch a jax backend before this call
+            # (backend init would make initialize() fail) — so no
+            # process_count() precheck here.
             try:
                 jax.distributed.initialize()
-            except Exception:  # single-host slice: nothing to wire
-                pass
+            except Exception as e:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "jax.distributed.initialize() failed (%s) — continuing "
+                    "single-process; on a multi-host pod call "
+                    "Engine.init_distributed() before any other jax use",
+                    e)
         cls.init()
 
     @classmethod
