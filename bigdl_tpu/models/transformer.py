@@ -89,6 +89,10 @@ class TransformerConfig:
     dropout: float = 0.0
     causal: bool = True
     tie_embeddings: bool = True
+    # rematerialize each block's activations in backward (jax.checkpoint):
+    # memory O(layers + one block) instead of O(layers × acts) — the knob
+    # that makes long-context training fit HBM (SURVEY.md §7 hard parts)
+    remat: bool = False
 
 
 class TransformerLM(Module):
@@ -232,6 +236,8 @@ class TransformerLM(Module):
             bp, lrng = layer
             return self._block(x, bp, lrng, training), None
 
+        if c.remat:
+            body = jax.checkpoint(body)
         layer_rngs = jax.random.split(base_rng, c.num_layers)
         x, _ = lax.scan(body, x, (p["blocks"], layer_rngs))
 

@@ -88,3 +88,31 @@ def test_sequence_parallel_matches_single_device():
              jax.device_put(toks, NamedSharding(mesh, P(None, "seq"))))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_remat_matches_no_remat():
+    """jax.checkpoint must not change values or grads, only memory."""
+    import numpy as np
+
+    from bigdl_tpu.models.transformer import (
+        TransformerConfig, TransformerLM)
+
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, 50, (2, 16)), jnp.int32)
+    base = dict(vocab_size=50, max_len=16, dim=32, num_heads=4,
+                num_layers=2)
+    m1 = TransformerLM(TransformerConfig(**base, remat=False))
+    m2 = TransformerLM(TransformerConfig(**base, remat=True))
+    v = m1.init(jax.random.PRNGKey(0))
+
+    def loss(model, p):
+        out, _ = model.apply({"params": p, "state": {}}, toks)
+        return jnp.mean(out ** 2)
+
+    l1, g1 = jax.value_and_grad(lambda p: loss(m1, p))(v["params"])
+    l2, g2 = jax.value_and_grad(lambda p: loss(m2, p))(v["params"])
+    assert abs(float(l1) - float(l2)) < 1e-6
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
