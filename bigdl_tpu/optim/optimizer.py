@@ -291,6 +291,7 @@ class LocalOptimizer:
 
         dataset_size = o.dataset.size()
         batches = _batch_iterator(o.dataset, True, o.batch_size)
+        pending = None  # deferred (epoch, neval, loss, lr, thr, vars)
         epoch_start = time.perf_counter()
         iter_start = time.perf_counter()
 
@@ -308,7 +309,9 @@ class LocalOptimizer:
                     step_rng)
             # NOTE: `loss` stays a device array — converting here would
             # block the host on every step and kill async dispatch
-            # pipelining; it is materialized only on log/summary paths.
+            # pipelining. Log/summary emission for step N happens after
+            # step N+1 is dispatched (see _emit below), so the loss fetch
+            # overlaps the next step's device compute instead of stalling.
             real = getattr(mb, "real_size", mb.size)
             train_state["neval"] += 1
             train_state["records"] += real
@@ -319,21 +322,10 @@ class LocalOptimizer:
             self.metrics.add("iter_s", iter_wall)
             throughput = real / max(iter_wall, 1e-9)
 
-            if o.train_summary is not None:
-                s = o.train_summary
-                s.add_scalar("Loss", float(loss), train_state["neval"])
-                s.add_scalar("Throughput", throughput, train_state["neval"])
-                s.add_scalar("LearningRate", lr, train_state["neval"])
-                pt = s.get_summary_trigger("Parameters")
-                if pt is not None and pt(train_state):
-                    for name, leaf in o.model.parameters(variables):
-                        s.add_histogram(name, np.asarray(leaf), train_state["neval"])
-
-            if train_state["neval"] % self.o.log_every == 0:
-                logger.info(
-                    "epoch %d iter %d loss %.6f lr %.5g %.1f rec/s [%s]",
-                    train_state["epoch"], train_state["neval"], float(loss), lr,
-                    throughput, self.metrics.summary())
+            if pending is not None:
+                self._emit(pending)
+            pending = (train_state["epoch"], train_state["neval"], loss,
+                       lr, throughput, variables)
 
             # ---- epoch rollover (the reference counts records vs dataset size)
             if train_state["records"] >= dataset_size:
@@ -368,8 +360,30 @@ class LocalOptimizer:
                                           ("epoch", "neval", "records")})
                 logger.info("checkpoint -> %s", path)
 
+        if pending is not None:
+            self._emit(pending)
         for summary in (o.train_summary, o.validation_summary):
             if summary is not None:
                 summary.writer.flush()
         o.model.variables = variables
         return o.model
+
+    def _emit(self, pending) -> None:
+        """Write log line + TB scalars for an already-dispatched step;
+        called one step late so the loss fetch overlaps device compute."""
+        o = self.o
+        epoch, neval, loss, lr, throughput, variables = pending
+        if o.train_summary is not None:
+            s = o.train_summary
+            s.add_scalar("Loss", float(loss), neval)
+            s.add_scalar("Throughput", throughput, neval)
+            s.add_scalar("LearningRate", lr, neval)
+            pt = s.get_summary_trigger("Parameters")
+            if pt is not None and pt({"epoch": epoch, "neval": neval}):
+                for name, leaf in o.model.parameters(variables):
+                    s.add_histogram(name, np.asarray(leaf), neval)
+        if neval % o.log_every == 0:
+            logger.info(
+                "epoch %d iter %d loss %.6f lr %.5g %.1f rec/s [%s]",
+                epoch, neval, float(loss), lr, throughput,
+                self.metrics.summary())
