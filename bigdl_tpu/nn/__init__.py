@@ -54,7 +54,7 @@ from bigdl_tpu.nn.table_ops import (
 from bigdl_tpu.nn.embedding import LookupTable
 from bigdl_tpu.nn.recurrent import (
     Cell, RnnCell, LSTM, LSTMPeephole, GRU, Recurrent, BiRecurrent,
-    TimeDistributed,
+    TimeDistributed, ConvLSTMPeephole,
 )
 from bigdl_tpu.nn.attention import MultiHeadAttention
 from bigdl_tpu.nn.quantized import (

@@ -204,7 +204,12 @@ class Recurrent(Module):
 
     def apply(self, variables, x, training=False, rng=None):
         cell_params = variables["params"]["cell"]
-        carry0 = self.cell.init_carry(x.shape[0])
+        if hasattr(self.cell, "init_carry_like"):
+            # cells with input-shape-dependent state (ConvLSTM: spatial
+            # dims come from the frame, not the constructor)
+            carry0 = self.cell.init_carry_like(x[:, 0])
+        else:
+            carry0 = self.cell.init_carry(x.shape[0])
         xs = jnp.swapaxes(x, 0, 1)  # (T, N, D) scan-major
         ts = jnp.arange(xs.shape[0])
 
@@ -287,3 +292,66 @@ class TimeDistributed(Module):
             flat, training=training, rng=rng)
         out = out.reshape((n, t) + out.shape[1:])
         return out, {"inner": s}
+
+
+class ConvLSTMPeephole(Cell):
+    """Convolutional LSTM cell over image sequences
+    (reference: nn/ConvLSTMPeephole.scala — gates are convolutions over
+    [x_t, h], optional per-channel peephole connections to c).
+
+    Frames are NHWC; gates come from ONE fused conv producing 4·C_out
+    channels (single MXU op, like the fused-matmul LSTM above); SAME
+    padding and stride 1 keep state spatial dims equal to the frame's.
+    Use inside `Recurrent` over (N, T, H, W, C) input.
+    """
+
+    def __init__(self, input_size: int, output_size: int,
+                 kernel: int = 3, with_peephole: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.kernel = kernel
+        self.with_peephole = with_peephole
+        self.hidden_size = output_size
+
+    def init_params(self, rng):
+        k, ci, co = self.kernel, self.input_size, self.output_size
+        wk, bk = jax.random.split(rng)
+        fan_in = (ci + co) * k * k
+        p = {
+            "weight": Xavier()(wk, (k, k, ci + co, 4 * co),
+                               fan_in=fan_in, fan_out=4 * co * k * k),
+            "bias": jnp.zeros((4 * co,), jnp.float32),
+        }
+        if self.with_peephole:
+            p["w_ci"] = jnp.zeros((co,), jnp.float32)
+            p["w_cf"] = jnp.zeros((co,), jnp.float32)
+            p["w_co"] = jnp.zeros((co,), jnp.float32)
+        return p
+
+    def init_carry_like(self, x_t):
+        b, h, w, _ = x_t.shape
+        z = jnp.zeros((b, h, w, self.output_size), x_t.dtype)
+        return (z, z)  # (h, c)
+
+    def step(self, params, carry, x_t, training=False, rng=None):
+        from jax import lax
+
+        h_prev, c_prev = carry
+        z = lax.conv_general_dilated(
+            jnp.concatenate([x_t, h_prev], axis=-1), params["weight"],
+            window_strides=(1, 1), padding="SAME",
+            dimension_numbers=lax.conv_dimension_numbers(
+                (1, 1, 1, self.input_size + self.output_size),
+                params["weight"].shape, ("NHWC", "HWIO", "NHWC")),
+        ) + params["bias"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        if self.with_peephole:
+            i = i + params["w_ci"] * c_prev
+            f = f + params["w_cf"] * c_prev
+        c = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
+        if self.with_peephole:
+            o = o + params["w_co"] * c
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h

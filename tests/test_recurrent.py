@@ -120,3 +120,44 @@ class TestTimeDistributed:
         w = m.variables["params"]["inner"]["weight"]
         b = m.variables["params"]["inner"]["bias"]
         np.testing.assert_allclose(out, np.asarray(x @ w + b), rtol=1e-5)
+
+
+class TestConvLSTMPeephole:
+    def test_shapes_through_recurrent(self):
+        m = nn.Recurrent(nn.ConvLSTMPeephole(2, 4, kernel=3))
+        v = m.init(KEY)
+        x = jnp.asarray(
+            np.random.RandomState(0).randn(2, 5, 8, 8, 2), jnp.float32)
+        y, _ = m.apply(v, x)
+        assert y.shape == (2, 5, 8, 8, 4)
+
+    def test_temporal_dependence(self):
+        """Swapping two frames must change subsequent outputs."""
+        m = nn.Recurrent(nn.ConvLSTMPeephole(1, 2, kernel=3))
+        v = m.init(KEY)
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(1, 4, 6, 6, 1), jnp.float32)
+        x_swapped = x.at[:, 0].set(x[:, 1]).at[:, 1].set(x[:, 0])
+        y1, _ = m.apply(v, x)
+        y2, _ = m.apply(v, x_swapped)
+        assert not np.allclose(np.asarray(y1[:, -1]),
+                               np.asarray(y2[:, -1]), atol=1e-6)
+
+    def test_no_peephole_param_set(self):
+        cell = nn.ConvLSTMPeephole(1, 2, with_peephole=False)
+        p = cell.init_params(KEY)
+        assert "w_ci" not in p
+
+    def test_grads_flow(self):
+        m = nn.Recurrent(nn.ConvLSTMPeephole(1, 2, kernel=3))
+        v = m.init(KEY)
+        x = jnp.ones((1, 3, 4, 4, 1))
+
+        def loss(p):
+            y, _ = m.apply({"params": p, "state": {}}, x)
+            return jnp.sum(y ** 2)
+
+        g = jax.grad(loss)(v["params"])
+        total = sum(float(jnp.abs(l).sum())
+                    for l in jax.tree_util.tree_leaves(g))
+        assert total > 0
