@@ -13,8 +13,9 @@ from bigdl_tpu.keras.layers import (
     LSTM, MaxPooling2D, Reshape,
 )
 from bigdl_tpu.keras.layers_extra import (
-    Bidirectional, Conv3D, GRU, GlobalMaxPooling2D, MaxPooling3D,
-    SimpleRNN, UpSampling2D,
+    Bidirectional, Conv3D, Cropping2D, GRU, GlobalMaxPooling2D,
+    MaxPooling3D, Permute, RepeatVector, SimpleRNN, UpSampling2D,
+    ZeroPadding2D,
 )
 from bigdl_tpu.keras.models import Sequential
 
@@ -24,4 +25,5 @@ __all__ = [
     "Dropout", "Embedding", "BatchNormalization", "LSTM", "Reshape",
     "InputLayer", "Conv3D", "MaxPooling3D", "UpSampling2D",
     "GlobalMaxPooling2D", "SimpleRNN", "GRU", "Bidirectional",
+    "ZeroPadding2D", "Cropping2D", "Permute", "RepeatVector",
 ]

@@ -134,3 +134,69 @@ class Bidirectional(KerasLayer):
             m = nn.Sequential(m, nn.Select(2, -1))
             return self._named(m), (2 * units,)
         return self._named(m), (seq_len, 2 * units)
+
+
+class ZeroPadding2D(KerasLayer):
+    def __init__(self, padding=(1, 1), input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        if isinstance(padding, int):
+            padding = (padding, padding)
+        self.padding = tuple(padding)  # (pad_h, pad_w)
+
+    def build(self, input_shape):
+        h, w, c = input_shape
+        ph, pw = self.padding
+        m = self._named(nn.SpatialZeroPadding(pw, pw, ph, ph))
+        return m, (h + 2 * ph, w + 2 * pw, c)
+
+
+class Cropping2D(KerasLayer):
+    def __init__(self, cropping=((1, 1), (1, 1)), input_shape=None,
+                 name=None):
+        super().__init__(input_shape, name)
+        if isinstance(cropping, int):
+            cropping = ((cropping, cropping), (cropping, cropping))
+        self.cropping = tuple(tuple(c) for c in cropping)
+
+    def build(self, input_shape):
+        h, w, c = input_shape
+        (t, b), (l, r) = self.cropping
+        m = self._named(nn.Sequential(
+            nn.Narrow(2, t + 1, h - t - b),
+            nn.Narrow(3, l + 1, w - l - r)))
+        return m, (h - t - b, w - l - r, c)
+
+
+class Permute(KerasLayer):
+    """Permute non-batch dims, keras-style 1-based `dims`."""
+
+    def __init__(self, dims, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.dims = tuple(dims)
+
+    def build(self, input_shape):
+        # decompose the permutation into swaps for nn.Transpose
+        # (1-based over full tensor: +1 for the batch dim)
+        perm = [d - 1 for d in self.dims]   # 0-based over features
+        cur = list(range(len(perm)))
+        swaps = []
+        for i, want in enumerate(perm):
+            j = cur.index(want)
+            if j != i:
+                swaps.append((i + 2, j + 2))  # 1-based incl. batch
+                cur[i], cur[j] = cur[j], cur[i]
+        m = self._named(nn.Transpose(swaps)) if swaps else None
+        out = tuple(input_shape[d - 1] for d in self.dims)
+        return m, out
+
+
+class RepeatVector(KerasLayer):
+    """(B, F) → (B, n, F)."""
+
+    def __init__(self, n: int, input_shape=None, name=None):
+        super().__init__(input_shape, name)
+        self.n = n
+
+    def build(self, input_shape):
+        m = self._named(nn.Replicate(self.n, dim=2))
+        return m, (self.n,) + tuple(input_shape)

@@ -77,6 +77,18 @@ def main():
         params, slots, loss = step(params, slots, bx, by)
     assert np.isfinite(float(loss))
     print(f"bf16 train step ok, loss={float(loss):.4f}")
+
+    # --- int8 quantized path lowers on TPU ---
+    lin = nn.Linear(256, 128)
+    lv = lin.init(jax.random.PRNGKey(1))
+    qm, qv = nn.QuantizedLinear.from_float(lin, lv)
+    xq = jnp.asarray(rng.randn(16, 256), jnp.float32)
+    yq, _ = jax.jit(lambda v, x: qm.apply(v, x))(qv, xq)
+    yf, _ = lin.apply(lv, xq)
+    rel = float(jnp.abs(yq - yf).max() / jnp.abs(yf).max())
+    print(f"int8 quantized linear rel err={rel:.4g}")
+    assert rel < 0.05
+
     print("ALL TPU VALIDATIONS PASSED")
     return 0
 

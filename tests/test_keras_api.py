@@ -129,3 +129,46 @@ class TestExtraLayers:
         ])
         m2.build()
         assert m2.output_shape == (10, 6)
+
+
+class TestShapeLayers:
+    def test_zero_padding_and_cropping(self):
+        m = keras.Sequential([
+            keras.ZeroPadding2D((1, 2), input_shape=(4, 4, 3)),
+            keras.Cropping2D(((1, 0), (2, 1))),
+        ])
+        m.build()
+        assert m.output_shape == (5, 5, 3)
+        x = np.random.RandomState(0).rand(2, 4, 4, 3).astype(np.float32)
+        out = m.module.build().evaluate().forward(x)
+        assert out.shape == (2, 5, 5, 3)
+
+    def test_permute(self):
+        m = keras.Sequential([
+            keras.Permute((2, 1, 3), input_shape=(3, 4, 5)),
+        ])
+        m.build()
+        assert m.output_shape == (4, 3, 5)
+        x = np.random.RandomState(0).rand(2, 3, 4, 5).astype(np.float32)
+        out = np.asarray(m.module.build().evaluate().forward(x))
+        np.testing.assert_allclose(out, x.transpose(0, 2, 1, 3))
+
+    def test_permute_3cycle(self):
+        m = keras.Sequential([
+            keras.Permute((3, 1, 2), input_shape=(3, 4, 5)),
+        ])
+        m.build()
+        assert m.output_shape == (5, 3, 4)
+        x = np.random.RandomState(1).rand(1, 3, 4, 5).astype(np.float32)
+        out = np.asarray(m.module.build().evaluate().forward(x))
+        np.testing.assert_allclose(out, x.transpose(0, 3, 1, 2))
+
+    def test_repeat_vector(self):
+        m = keras.Sequential([
+            keras.RepeatVector(5, input_shape=(7,)),
+        ])
+        m.build()
+        assert m.output_shape == (5, 7)
+        x = np.random.RandomState(0).rand(2, 7).astype(np.float32)
+        out = np.asarray(m.module.build().evaluate().forward(x))
+        np.testing.assert_allclose(out[:, 3], x)
