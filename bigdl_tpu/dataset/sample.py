@@ -47,6 +47,30 @@ class Sample:
         return f"Sample(feature={self.feature_size()}, label={self.label_size()})"
 
 
+def _stack_padded(arrays, pad_value, target_len=None):
+    """np.stack, right-padding each array's first axis with `pad_value`
+    to the common (or `target_len`) length when pad_value is given."""
+    if pad_value is None:
+        return np.stack(arrays)
+    arrays = [np.asarray(a) for a in arrays]
+    if arrays[0].ndim == 0:
+        return np.stack(arrays)
+    length = target_len if target_len is not None \
+        else max(a.shape[0] for a in arrays)
+
+    def pad(a):
+        if a.shape[0] > length:
+            raise ValueError(
+                f"sample length {a.shape[0]} exceeds padding_length "
+                f"{length}")
+        if a.shape[0] == length:
+            return a
+        widths = [(0, length - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths, constant_values=pad_value)
+
+    return np.stack([pad(a) for a in arrays])
+
+
 class MiniBatch:
     """A batch of stacked samples (reference: dataset/MiniBatch.scala).
 
@@ -60,24 +84,37 @@ class MiniBatch:
 
     @staticmethod
     def from_samples(samples: Sequence[Sample],
-                     pad_to: Optional[int] = None) -> "MiniBatch":
+                     pad_to: Optional[int] = None,
+                     feature_padding: Optional[float] = None,
+                     label_padding: Optional[float] = None,
+                     padding_length: Optional[int] = None) -> "MiniBatch":
         """Stack samples; optionally right-pad the batch dim to `pad_to` by
         repeating the last sample (keeps jit shapes static for the final
-        partial batch — the reference instead drops or shrinks)."""
+        partial batch — the reference instead drops or shrinks).
+
+        `feature_padding`/`label_padding` enable variable-length stacking
+        (reference: dataset/PaddingParam.scala via SampleToMiniBatch):
+        each array is right-padded along its first axis with the given
+        value to the batch max — or to `padding_length` when set (fixed
+        length keeps jit shapes static across batches)."""
         n = len(samples)
         if pad_to is not None and n < pad_to:
             samples = list(samples) + [samples[-1]] * (pad_to - n)
 
-        def stack(get):
+        def stack(get, pad_value):
             first = get(samples[0])
             if first is None:
                 return None
             if isinstance(first, tuple):
-                return tuple(np.stack([get(s)[i] for s in samples])
-                             for i in range(len(first)))
-            return np.stack([get(s) for s in samples])
+                return tuple(
+                    _stack_padded([get(s)[i] for s in samples], pad_value,
+                                  padding_length)
+                    for i in range(len(first)))
+            return _stack_padded([get(s) for s in samples], pad_value,
+                                 padding_length)
 
-        mb = MiniBatch(stack(lambda s: s.feature), stack(lambda s: s.label))
+        mb = MiniBatch(stack(lambda s: s.feature, feature_padding),
+                       stack(lambda s: s.label, label_padding))
         mb.real_size = n
         return mb
 

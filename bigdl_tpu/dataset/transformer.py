@@ -71,10 +71,19 @@ class SampleToMiniBatch(Transformer):
     partial="drop" mirrors dropping it.
     """
 
-    def __init__(self, batch_size: int, partial: str = "pad"):
+    def __init__(self, batch_size: int, partial: str = "pad",
+                 feature_padding=None, label_padding=None,
+                 padding_length=None):
+        """`feature_padding`/`label_padding`/`padding_length` stack
+        variable-length samples by right-padding their first axis
+        (reference: SampleToMiniBatch's featurePaddingParam /
+        labelPaddingParam, dataset/PaddingParam.scala)."""
         assert partial in ("pad", "drop")
         self.batch_size = batch_size
         self.partial = partial
+        self.feature_padding = feature_padding
+        self.label_padding = label_padding
+        self.padding_length = padding_length
 
     def apply(self, it):
         while True:
@@ -83,4 +92,8 @@ class SampleToMiniBatch(Transformer):
                 return
             if len(group) < self.batch_size and self.partial == "drop":
                 return
-            yield MiniBatch.from_samples(group, pad_to=self.batch_size)
+            yield MiniBatch.from_samples(
+                group, pad_to=self.batch_size,
+                feature_padding=self.feature_padding,
+                label_padding=self.label_padding,
+                padding_length=self.padding_length)

@@ -1,5 +1,6 @@
 """Data plane tests (reference: dataset/DataSetSpec, transformer specs)."""
 
+import pytest
 import numpy as np
 
 from bigdl_tpu.dataset import (
@@ -146,3 +147,41 @@ class TestTextPipeline:
         s = synthetic_mnist(8)
         assert s[0].feature.shape == (28, 28, 1)
         assert 0 <= int(s[0].label) < 10
+
+
+class TestPaddedBatching:
+    """Variable-length stacking (reference: dataset/PaddingParam.scala)."""
+
+    def test_pad_to_batch_max(self):
+        samples = [Sample(np.arange(3, dtype=np.int32), 0),
+                   Sample(np.arange(5, dtype=np.int32), 1)]
+        mb = MiniBatch.from_samples(samples, feature_padding=0)
+        assert mb.input.shape == (2, 5)
+        np.testing.assert_array_equal(mb.input[0], [0, 1, 2, 0, 0])
+
+    def test_fixed_padding_length(self):
+        samples = [Sample(np.ones(2, np.float32), np.ones(2, np.int32)),
+                   Sample(np.ones(4, np.float32), np.ones(4, np.int32))]
+        mb = MiniBatch.from_samples(samples, feature_padding=-1.0,
+                                    label_padding=0,
+                                    padding_length=6)
+        assert mb.input.shape == (2, 6)
+        assert mb.target.shape == (2, 6)
+        assert mb.input[0, 5] == -1.0
+        assert mb.target[1, 5] == 0
+
+    def test_too_long_raises(self):
+        samples = [Sample(np.ones(9, np.float32), 0)]
+        with pytest.raises(ValueError, match="padding_length"):
+            MiniBatch.from_samples(samples, feature_padding=0.0,
+                                   padding_length=4)
+
+    def test_through_transformer_chain(self):
+        from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+
+        samples = [Sample(np.arange(n, dtype=np.int32), n % 2)
+                   for n in (2, 4, 3, 5)]
+        batcher = SampleToMiniBatch(2, feature_padding=0,
+                                    padding_length=5)
+        batches = list(batcher.apply(iter(samples)))
+        assert [b.input.shape for b in batches] == [(2, 5), (2, 5)]
