@@ -92,6 +92,7 @@ class Optimizer:
         self.mesh = None
         self.mesh_axis = "data"
         self.precision = None  # None → full fp32; Policy → mixed precision
+        self.grad_accum = 1
 
     # ------------------------------------------------------- builder surface
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
@@ -142,6 +143,17 @@ class Optimizer:
         from bigdl_tpu.visualization import ValidationSummary
 
         self.validation_summary = self._coerce_summary(summary, ValidationSummary)
+        return self
+
+    def set_gradient_accumulation(self, n: int) -> "Optimizer":
+        """Accumulate gradients over `n` micro-batches before each
+        optimizer update (effective batch = n × batch_size). TPU-first
+        addition (absent in the reference, which scales batch via Spark
+        partitions): lets a single chip train at pod-scale batch sizes
+        without holding the activations of the full batch."""
+        if n < 1:
+            raise ValueError("accumulation steps must be >= 1")
+        self.grad_accum = n
         return self
 
     def set_precision(self, policy) -> "Optimizer":
@@ -200,8 +212,9 @@ class LocalOptimizer:
         model, criterion, method = self.o.model, self.o.criterion, self.o.optim_method
         clip_const, clip_norm = self.o.grad_clip_const, self.o.grad_clip_norm
         precision = self.o.precision
+        accum = self.o.grad_accum
 
-        def step(params, mod_state, slots, bx, by, lr, stepno, rng):
+        def grads_of(params, mod_state, bx, by, rng):
             def loss_fn(p):
                 x = bx
                 if precision is not None:
@@ -215,8 +228,9 @@ class LocalOptimizer:
                     new_state = precision.cast_to_output(new_state)
                 return criterion(out, by), new_state
 
-            (loss, new_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+            return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+        def clip_and_update(grads, params, slots, lr, stepno):
             if clip_const is not None:
                 lo, hi = clip_const
                 grads = jax.tree_util.tree_map(
@@ -226,10 +240,43 @@ class LocalOptimizer:
                                      for g in jax.tree_util.tree_leaves(grads)))
                 scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gnorm, 1e-12))
                 grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
-            new_params, new_slots = method.update(grads, params, slots, lr, stepno)
-            return new_params, new_state, new_slots, loss
+            return method.update(grads, params, slots, lr, stepno)
 
-        return jax.jit(step, donate_argnums=(0, 2))
+        if accum == 1:
+            def step(params, mod_state, slots, bx, by, lr, stepno, rng):
+                (loss, new_state), grads = grads_of(params, mod_state, bx,
+                                                    by, rng)
+                new_params, new_slots = clip_and_update(grads, params,
+                                                        slots, lr, stepno)
+                return new_params, new_state, new_slots, loss
+
+            return jax.jit(step, donate_argnums=(0, 2))
+
+        # gradient accumulation: grads-only micro-steps, update every
+        # `accum`-th call (Optimizer.set_gradient_accumulation)
+        grad_fn = jax.jit(grads_of)
+        add_fn = jax.jit(lambda a, g: jax.tree_util.tree_map(
+            jnp.add, a, g), donate_argnums=(0,))
+        upd_fn = jax.jit(
+            lambda acc, params, slots, lr, stepno: clip_and_update(
+                jax.tree_util.tree_map(lambda g: g / accum, acc),
+                params, slots, lr, stepno),
+            donate_argnums=(0, 1, 2))
+        micro = {"acc": None, "n": 0}
+
+        def step(params, mod_state, slots, bx, by, lr, stepno, rng):
+            (loss, new_state), grads = grad_fn(params, mod_state, bx, by,
+                                               rng)
+            micro["acc"] = grads if micro["acc"] is None \
+                else add_fn(micro["acc"], grads)
+            micro["n"] += 1
+            if micro["n"] == accum:
+                params, slots = upd_fn(micro["acc"], params, slots, lr,
+                                       stepno)
+                micro["acc"], micro["n"] = None, 0
+            return params, new_state, slots, loss
+
+        return step
 
     def _make_eval(self) -> Callable:
         model, methods = self.o.model, self.o.validation_methods

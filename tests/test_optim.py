@@ -209,3 +209,46 @@ class TestValidationMethods:
         a = ValidationResult(3, 4)
         b = ValidationResult(1, 4)
         assert (a + b).result() == (0.5, 8)
+
+
+class TestGradientAccumulation:
+    def test_matches_large_batch_sgd(self):
+        """4 micro-batches of 8 with accumulation == one batch of 32."""
+        from bigdl_tpu import nn
+        from bigdl_tpu.dataset import DataSet, Sample
+        from bigdl_tpu.optim import Optimizer
+
+        rng = np.random.RandomState(0)
+        xs = rng.rand(32, 4).astype(np.float32)
+        ys = rng.randint(0, 2, 32).astype(np.int32)
+
+        def train(batch_size, accum):
+            model = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+            ds = DataSet.array(
+                [Sample(x, int(y)) for x, y in zip(xs, ys)], seed=7)
+            opt = (Optimizer(model, ds, nn.ClassNLLCriterion(),
+                             batch_size=batch_size, seed=3)
+                   .set_optim_method(SGD(learningrate=0.5))
+                   .set_end_when(Trigger.max_iteration(32 // batch_size)))
+            if accum > 1:
+                opt.set_gradient_accumulation(accum)
+            m = opt.optimize()
+            return [np.asarray(p) for _, p in m.parameters()]
+
+        # same epoch of data either way; shuffle order is seed-fixed, and
+        # mean-reduced criterion + grad averaging make the updates equal
+        big = train(32, 1)
+        small = train(8, 4)
+        for a, b in zip(big, small):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    def test_validates_n(self):
+        from bigdl_tpu import nn
+        from bigdl_tpu.dataset import DataSet, Sample
+        from bigdl_tpu.optim import Optimizer
+
+        model = nn.Sequential(nn.Linear(2, 2))
+        ds = DataSet.array([Sample(np.zeros(2, np.float32), 0)])
+        with pytest.raises(ValueError):
+            Optimizer(model, ds, nn.ClassNLLCriterion(),
+                      batch_size=1).set_gradient_accumulation(0)
