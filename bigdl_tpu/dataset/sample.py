@@ -98,6 +98,11 @@ class MiniBatch:
         value to the batch max — or to `padding_length` when set (fixed
         length keeps jit shapes static across batches)."""
         n = len(samples)
+        if padding_length is not None and feature_padding is None \
+                and label_padding is None:
+            raise ValueError(
+                "padding_length needs feature_padding and/or "
+                "label_padding to supply the pad value")
         if pad_to is not None and n < pad_to:
             samples = list(samples) + [samples[-1]] * (pad_to - n)
 

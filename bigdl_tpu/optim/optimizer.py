@@ -190,6 +190,11 @@ class Optimizer:
     # ------------------------------------------------------------- dispatch
     def optimize(self) -> Module:
         if self.mesh is not None:
+            if self.grad_accum != 1:
+                raise NotImplementedError(
+                    "gradient accumulation is not yet wired into the "
+                    "mesh (DistriOptimizer) path — scale the per-chip "
+                    "batch or the mesh instead")
             from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
 
             return DistriOptimizer(self, self.mesh, self.mesh_axis).run()
@@ -346,13 +351,18 @@ class LocalOptimizer:
             with Timer(self.metrics, "data_fetch_s"):
                 mb = next(batches)
             step_rng = jax.random.fold_in(rng, train_state["neval"])
-            lr = o.optim_method.current_rate(train_state)
+            # under gradient accumulation, schedules and the optimizer's
+            # step counter advance per UPDATE, not per micro-batch
+            eff_step = train_state["neval"] // o.grad_accum
+            lr_state = train_state if o.grad_accum == 1 \
+                else {**train_state, "neval": eff_step}
+            lr = o.optim_method.current_rate(lr_state)
             with Timer(self.metrics, "dispatch_s"):
                 variables["params"], variables["state"], slots, loss = self._step(
                     variables["params"], variables["state"], slots,
                     _to_device(mb.input), _to_device(mb.target),
                     jnp.asarray(lr, jnp.float32),
-                    jnp.asarray(train_state["neval"], jnp.int32),
+                    jnp.asarray(eff_step, jnp.int32),
                     step_rng)
             # NOTE: `loss` stays a device array — converting here would
             # block the host on every step and kill async dispatch
@@ -371,8 +381,10 @@ class LocalOptimizer:
 
             if pending is not None:
                 self._emit(pending)
-            pending = (train_state["epoch"], train_state["neval"], loss,
-                       lr, throughput, variables)
+            # snapshot the dicts: the loop reassigns variables["params"]
+            # next iteration, and _emit must see step-N state, not N+1
+            pending = (dict(train_state), loss, lr, throughput,
+                       dict(variables))
 
             # ---- epoch rollover (the reference counts records vs dataset size)
             if train_state["records"] >= dataset_size:
@@ -419,14 +431,15 @@ class LocalOptimizer:
         """Write log line + TB scalars for an already-dispatched step;
         called one step late so the loss fetch overlaps device compute."""
         o = self.o
-        epoch, neval, loss, lr, throughput, variables = pending
+        state, loss, lr, throughput, variables = pending
+        epoch, neval = state["epoch"], state["neval"]
         if o.train_summary is not None:
             s = o.train_summary
             s.add_scalar("Loss", float(loss), neval)
             s.add_scalar("Throughput", throughput, neval)
             s.add_scalar("LearningRate", lr, neval)
             pt = s.get_summary_trigger("Parameters")
-            if pt is not None and pt({"epoch": epoch, "neval": neval}):
+            if pt is not None and pt(state):
                 for name, leaf in o.model.parameters(variables):
                     s.add_histogram(name, np.asarray(leaf), neval)
         if neval % o.log_every == 0:

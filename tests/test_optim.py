@@ -252,3 +252,45 @@ class TestGradientAccumulation:
         with pytest.raises(ValueError):
             Optimizer(model, ds, nn.ClassNLLCriterion(),
                       batch_size=1).set_gradient_accumulation(0)
+
+    def test_adam_stepno_counts_updates_not_microbatches(self):
+        """Bias correction must see update t, not micro-batch index."""
+        from bigdl_tpu import nn
+        from bigdl_tpu.dataset import DataSet, Sample
+        from bigdl_tpu.optim import Optimizer
+
+        rng = np.random.RandomState(0)
+        xs = rng.rand(32, 4).astype(np.float32)
+        ys = rng.randint(0, 2, 32).astype(np.int32)
+
+        def train(batch_size, accum):
+            model = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+            ds = DataSet.array(
+                [Sample(x, int(y)) for x, y in zip(xs, ys)], seed=7)
+            opt = (Optimizer(model, ds, nn.ClassNLLCriterion(),
+                             batch_size=batch_size, seed=3)
+                   .set_optim_method(Adam(learningrate=0.05))
+                   .set_end_when(Trigger.max_iteration(32 // batch_size)))
+            if accum > 1:
+                opt.set_gradient_accumulation(accum)
+            m = opt.optimize()
+            return [np.asarray(p) for _, p in m.parameters()]
+
+        big = train(32, 1)
+        small = train(8, 4)
+        for a, b in zip(big, small):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    def test_mesh_plus_accumulation_rejected(self):
+        from bigdl_tpu import nn
+        from bigdl_tpu.dataset import DataSet, Sample
+        from bigdl_tpu.optim import Optimizer
+        from bigdl_tpu.parallel import make_mesh
+
+        model = nn.Sequential(nn.Linear(2, 2))
+        ds = DataSet.array([Sample(np.zeros(2, np.float32), 0)] * 8)
+        opt = (Optimizer(model, ds, nn.ClassNLLCriterion(), batch_size=8)
+               .set_gradient_accumulation(2)
+               .set_mesh(make_mesh({"data": 8})))
+        with pytest.raises(NotImplementedError):
+            opt.optimize()
