@@ -9,6 +9,7 @@ from bigdl_tpu.optim.lr_schedule import (
 )
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
+    MAE,
     ValidationMethod, ValidationResult, Top1Accuracy, Top5Accuracy, Loss,
     TreeNNAccuracy, HitRatio, NDCG,
 )

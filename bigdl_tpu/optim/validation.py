@@ -165,3 +165,21 @@ class NDCG(ValidationMethod):
         gain = jnp.where(has_hit, 1.0 / jnp.log2(ranks.astype(jnp.float32) + 2.0), 0.0)
         mask = _row_mask(gain.shape[0], real_size)
         return jnp.sum(gain * mask), jnp.sum(mask)
+
+
+class MAE(ValidationMethod):
+    """Mean absolute error for regression outputs
+    (reference: optim/ValidationMethod.scala#MAE)."""
+
+    name = "MAE"
+
+    def stats(self, output, target, real_size=None):
+        n = output.shape[0]
+        err = jnp.mean(jnp.abs(output - target.reshape(output.shape)),
+                       axis=tuple(range(1, output.ndim)))
+        if real_size is None:
+            return jnp.sum(err), jnp.asarray(float(n))
+        if isinstance(real_size, (int, np.integer)):
+            return jnp.sum(err[:real_size]), jnp.asarray(float(real_size))
+        mask = jnp.asarray(real_size, jnp.float32)
+        return jnp.sum(err * mask), jnp.sum(mask)

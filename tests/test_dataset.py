@@ -185,3 +185,8 @@ class TestPaddedBatching:
                                     padding_length=5)
         batches = list(batcher.apply(iter(samples)))
         assert [b.input.shape for b in batches] == [(2, 5), (2, 5)]
+
+    def test_padding_length_without_value_raises(self):
+        samples = [Sample(np.ones(2, np.float32), 0)]
+        with pytest.raises(ValueError, match="pad value"):
+            MiniBatch.from_samples(samples, padding_length=4)

@@ -294,3 +294,21 @@ class TestGradientAccumulation:
                .set_mesh(make_mesh({"data": 8})))
         with pytest.raises(NotImplementedError):
             opt.optimize()
+
+
+class TestMAE:
+    def test_mae_values(self):
+        from bigdl_tpu.optim import MAE
+
+        out = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        tgt = jnp.asarray([[1.5, 2.0], [2.0, 4.0]])
+        s, c = MAE().stats(out, tgt)
+        assert abs(float(s) / float(c) - 0.375) < 1e-6
+
+    def test_mae_respects_real_size(self):
+        from bigdl_tpu.optim import MAE
+
+        out = jnp.asarray([[2.0], [100.0]])
+        tgt = jnp.asarray([[1.0], [0.0]])
+        s, c = MAE().stats(out, tgt, real_size=1)
+        assert float(c) == 1.0 and abs(float(s) - 1.0) < 1e-6
