@@ -112,6 +112,29 @@ class GRU(SimpleRNN):
         return nn.GRU(feat, self.units)
 
 
+class _BiLastState(nn.Module):
+    """Keras 'last state' of a concat-merged BiRecurrent output
+    (reference: nn/keras/Bidirectional.scala with returnSequences=false,
+    over nn/BiRecurrent.scala output).
+
+    (N, T, 2H) → (N, 2H): forward half at t=-1, backward half at t=0.
+    BiRecurrent re-flips the backward stream to input order, so the
+    backward RNN's FINAL step (all frames seen) sits at input position
+    0 — Select(2, -1) on the joint output would take the backward
+    RNN's first step instead, which is not Keras semantics."""
+
+    def __init__(self, units: int, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.units = units
+
+    def apply(self, variables, x, training=False, rng=None):
+        import jax.numpy as jnp
+
+        h = self.units
+        out = jnp.concatenate([x[:, -1, :h], x[:, 0, h:]], axis=-1)
+        return out, variables["state"]
+
+
 class Bidirectional(KerasLayer):
     """Wrap an LSTM/GRU/SimpleRNN layer config to run both directions
     (concat merge, like the reference's BiRecurrent)."""
@@ -131,7 +154,7 @@ class Bidirectional(KerasLayer):
             cell = lambda: nn.LSTM(feat, units)
         m = nn.BiRecurrent(cell(), cell())
         if not getattr(self.layer, "return_sequences", False):
-            m = nn.Sequential(m, nn.Select(2, -1))
+            m = nn.Sequential(m, _BiLastState(units))
             return self._named(m), (2 * units,)
         return self._named(m), (seq_len, 2 * units)
 

@@ -216,7 +216,13 @@ class TransformerLM(Module):
                           y, 0.0) / keep
         return x + y
 
-    def apply(self, variables, tokens, training=False, rng=None):
+    def apply_hidden(self, variables, tokens, training=False, rng=None):
+        """Forward up to the final LayerNorm: (B, S) int → (B, S, E).
+
+        The training hot path: pair with `head(variables)` and
+        `ops.losses.softmax_cross_entropy_chunked` so the (B, S, V)
+        log-prob tensor is never materialized (the full `apply` keeps
+        the reference-parity LogSoftMax output for eval/predict)."""
         c = self.cfg
         p = variables["params"]
         s = tokens.shape[-1]
@@ -241,9 +247,29 @@ class TransformerLM(Module):
         layer_rngs = jax.random.split(base_rng, c.num_layers)
         x, _ = lax.scan(body, x, (p["blocks"], layer_rngs))
 
-        x = self._ln(x, p["lnf_g"], p["lnf_b"])
-        head = p["embed"].T if c.tie_embeddings else p["head"]
-        logits = x @ head
+        return self._ln(x, p["lnf_g"], p["lnf_b"])
+
+    def head(self, variables):
+        """The (E, V) output projection (weight-tied to the embedding
+        unless cfg.tie_embeddings=False)."""
+        p = variables["params"]
+        return p["embed"].T if self.cfg.tie_embeddings else p["head"]
+
+    def loss(self, variables, tokens, targets, training=False, rng=None,
+             chunk: int = 256):
+        """Fused mean-NLL training loss — never materializes (B, S, V)
+        log-probs (ops/losses.softmax_cross_entropy_chunked)."""
+        from bigdl_tpu.ops.losses import softmax_cross_entropy_chunked
+
+        hidden = self.apply_hidden(variables, tokens, training=training,
+                                   rng=rng)
+        return softmax_cross_entropy_chunked(hidden, self.head(variables),
+                                             targets, chunk=chunk)
+
+    def apply(self, variables, tokens, training=False, rng=None):
+        x = self.apply_hidden(variables, tokens, training=training,
+                              rng=rng)
+        logits = x @ self.head(variables)
         return jax.nn.log_softmax(logits, axis=-1), variables["state"]
 
 

@@ -4,7 +4,8 @@ No reference counterpart: the reference's sequence stack tops out at
 BiRecurrent/LSTM (SURVEY.md §5.7 — "no ring attention, no
 context/sequence parallel ... nothing to port"). Attention is this
 framework's TPU-first extension of that subsystem: MultiHeadAttention
-rides the Pallas flash kernel (bigdl_tpu/ops/flash_attention.py) on TPU
+rides the flash-attention op (bigdl_tpu/ops/flash_attention.py — the
+blockwise-XLA forward by default on TPU, Mosaic/Pallas selectable)
 and composes with the sequence-parallel plane
 (bigdl_tpu/parallel/ring_attention.py) for long contexts.
 """
@@ -26,8 +27,9 @@ class MultiHeadAttention(Module):
     apply(variables, x)            → self-attention
     apply(variables, [q_in, kv_in]) → cross-attention (kv_in keys/values)
 
-    `impl` selects the attention math: None → auto (Pallas flash on TPU,
-    jnp reference elsewhere); see bigdl_tpu.ops.flash_attention.
+    `impl` selects the attention math: None → auto (blockwise-XLA
+    flash on TPU, jnp reference elsewhere); explicit: 'xla' | 'pallas'
+    | 'interpret' | 'reference' — see bigdl_tpu.ops.flash_attention.
     Attention-probability dropout only exists on the reference impl (the
     flash kernel never materializes probabilities); output-projection
     dropout works everywhere.

@@ -103,6 +103,15 @@ class Module(_SpecCaptured):
         return {}
 
     def init_state(self) -> Dict[str, Any]:
+        """Non-parameter buffers (BN running stats, ...).
+
+        Data-parallel contract: float state leaves are averaged across
+        replicas every step (parallel/data_parallel._reduce_state) so
+        replicated state stays replicated. A leaf that must NOT be
+        averaged — e.g. a float step counter — must use a dict key
+        starting with '_' or one of parallel.data_parallel.
+        NON_REDUCIBLE_STATE_KEYS; such leaves are kept as-is (all
+        replicas advance them identically under SPMD)."""
         return {}
 
     def init(self, rng: jax.Array) -> Dict[str, Any]:

@@ -1,0 +1,56 @@
+"""Fused large-vocabulary losses.
+
+The reference pairs `nn/LogSoftMax.scala` with `nn/ClassNLLCriterion.
+scala` — fine at its vocabulary sizes. For a TPU LM head the pair is
+the single largest HBM sink in the training step: materializing
+(B, S, V) log-probs in fp32 at V=32k costs ~2 GB per copy and OOMs a
+16 GB chip at batch 8 (measured, scripts/profile_lm.py round 2).
+
+`softmax_cross_entropy_chunked` computes the same mean NLL directly
+from hidden states and the head matrix, scanning over sequence chunks:
+each chunk materializes only (B, chunk, V) logits, takes the LSE and
+the picked logit, and is rematerialized in the backward
+(`jax.checkpoint`). Peak memory drops from O(B·S·V) to O(B·chunk·V)
+with the same numerics (fp32 logits inside the chunk).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def softmax_cross_entropy_chunked(hidden: jax.Array, head: jax.Array,
+                                  targets: jax.Array,
+                                  chunk: int = 256) -> jax.Array:
+    """Mean token NLL of `softmax(hidden @ head)` vs int targets.
+
+    hidden: (B, S, E); head: (E, V); targets: (B, S) int. `chunk` must
+    divide S (pad the sequence otherwise — LM training shapes are
+    static multiples of 128).
+    """
+    b, s, e = hidden.shape
+    if s % chunk:
+        if s < chunk:
+            chunk = s
+        else:
+            raise ValueError(f"chunk {chunk} must divide sequence {s}")
+    n = s // chunk
+    hc = hidden.reshape(b, n, chunk, e).transpose(1, 0, 2, 3)
+    tc = targets.reshape(b, n, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def one(h, t):
+        logits = (h @ head).astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, t[..., None],
+                                     axis=-1)[..., 0]
+        return (lse - picked).sum()
+
+    def body(acc, xt):
+        h, t = xt
+        return acc + one(h, t), None
+
+    tot, _ = lax.scan(body, jnp.zeros((), jnp.float32), (hc, tc))
+    return tot / (b * s)

@@ -22,14 +22,24 @@ from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
 
 
 class Evaluator:
-    """(reference: optim/Evaluator.scala#Evaluator.test)"""
+    """(reference: optim/Evaluator.scala#Evaluator.test)
 
-    def __init__(self, model: Module):
+    `mesh`: evaluate SPMD over a device mesh (forward on each device's
+    batch shard, psum the stats). Uneven/final batches are padded up to
+    a multiple of the mesh axis and masked out per row — the same
+    padded-row guard DistriOptimizer._validate_mesh applies, so the
+    standalone Evaluator has no divisibility requirement."""
+
+    def __init__(self, model: Module, mesh=None, axis: str = "data"):
         self.model = model
+        self.mesh = mesh
+        self.axis = axis
 
     def test(self, dataset: AbstractDataSet,
              methods: Sequence[ValidationMethod],
              batch_size: int = 32) -> Dict[str, ValidationResult]:
+        if self.mesh is not None:
+            return self._test_mesh(dataset, methods, batch_size)
         model = self.model
         variables = model.variables
 
@@ -46,6 +56,42 @@ class Evaluator:
             tgt = _to_device(mb.target)
             for i, m in enumerate(methods):
                 s, c = m.stats(out, tgt, real)
+                results[i] = results[i] + ValidationResult(float(s), float(c))
+        return {m.name: r for m, r in zip(methods, results)}
+
+    def _test_mesh(self, dataset, methods, batch_size):
+        from bigdl_tpu.parallel.data_parallel import make_dp_eval_step
+        from bigdl_tpu.parallel.mesh import host_to_global
+        from jax.sharding import PartitionSpec as P
+
+        model, mesh, axis = self.model, self.mesh, self.axis
+        n = mesh.shape[axis]
+        variables = model.variables
+        eval_fn = make_dp_eval_step(model, methods, mesh, axis)
+
+        def pad_rows(x, rows):
+            x = np.asarray(x)
+            if x.shape[0] == rows:
+                return x
+            widths = [(0, rows - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+            return np.pad(x, widths)
+
+        def place(x, rows):
+            if isinstance(x, tuple):
+                return tuple(place(e, rows) for e in x)
+            arr = pad_rows(x, rows)
+            return host_to_global(
+                mesh, P(axis, *([None] * (arr.ndim - 1))), arr)
+
+        results = [ValidationResult(0.0, 0.0, m.name) for m in methods]
+        for mb in _batch_iterator(dataset, False, batch_size):
+            real = getattr(mb, "real_size", mb.size)
+            rows = ((mb.size + n - 1) // n) * n
+            mask = (np.arange(rows) < real).astype(np.float32)
+            stats = eval_fn(variables["params"], variables["state"],
+                            place(mb.input, rows), place(mb.target, rows),
+                            place(mask, rows))
+            for i, (s, c) in enumerate(stats):
                 results[i] = results[i] + ValidationResult(float(s), float(c))
         return {m.name: r for m, r in zip(methods, results)}
 

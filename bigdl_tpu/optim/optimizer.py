@@ -190,11 +190,6 @@ class Optimizer:
     # ------------------------------------------------------------- dispatch
     def optimize(self) -> Module:
         if self.mesh is not None:
-            if self.grad_accum != 1:
-                raise NotImplementedError(
-                    "gradient accumulation is not yet wired into the "
-                    "mesh (DistriOptimizer) path — scale the per-chip "
-                    "batch or the mesh instead")
             from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
 
             return DistriOptimizer(self, self.mesh, self.mesh_axis).run()
@@ -263,8 +258,8 @@ class LocalOptimizer:
         add_fn = jax.jit(lambda a, g: jax.tree_util.tree_map(
             jnp.add, a, g), donate_argnums=(0,))
         upd_fn = jax.jit(
-            lambda acc, params, slots, lr, stepno: clip_and_update(
-                jax.tree_util.tree_map(lambda g: g / accum, acc),
+            lambda acc, params, slots, lr, stepno, n: clip_and_update(
+                jax.tree_util.tree_map(lambda g: g / n, acc),
                 params, slots, lr, stepno),
             donate_argnums=(0, 1, 2))
         micro = {"acc": None, "n": 0}
@@ -277,10 +272,27 @@ class LocalOptimizer:
             micro["n"] += 1
             if micro["n"] == accum:
                 params, slots = upd_fn(micro["acc"], params, slots, lr,
-                                       stepno)
+                                       stepno,
+                                       jnp.asarray(accum, jnp.float32))
                 micro["acc"], micro["n"] = None, 0
             return params, new_state, slots, loss
 
+        def flush(params, slots, lr, stepno):
+            """Apply a pending partial accumulator (end trigger fired
+            mid-cycle): mean over the micro-batches actually seen, so no
+            gradient work is silently discarded. The micro accumulator
+            itself is deliberately NOT checkpointed — checkpoints are
+            taken at update boundaries (see run())."""
+            if micro["n"] == 0:
+                return params, slots
+            params, slots = upd_fn(micro["acc"], params, slots, lr,
+                                   stepno,
+                                   jnp.asarray(micro["n"], jnp.float32))
+            micro["acc"], micro["n"] = None, 0
+            return params, slots
+
+        step.flush = flush
+        step.micro_n = lambda: micro["n"]
         return step
 
     def _make_eval(self) -> Callable:
@@ -382,9 +394,19 @@ class LocalOptimizer:
             if pending is not None:
                 self._emit(pending)
             # snapshot the dicts: the loop reassigns variables["params"]
-            # next iteration, and _emit must see step-N state, not N+1
-            pending = (dict(train_state), loss, lr, throughput,
-                       dict(variables))
+            # next iteration, and _emit must see step-N state, not N+1.
+            # Histograms are materialized HERE (np.asarray = host fetch):
+            # step-N's param buffers are donated to step N+1's dispatch,
+            # so by _emit time the arrays would already be deleted. The
+            # fetch blocks until step N finishes — acceptable for a
+            # histogram trigger that fires rarely.
+            hists = None
+            if o.train_summary is not None:
+                pt = o.train_summary.get_summary_trigger("Parameters")
+                if pt is not None and pt(train_state):
+                    hists = [(name, np.asarray(leaf)) for name, leaf
+                             in o.model.parameters(variables)]
+            pending = (dict(train_state), loss, lr, throughput, hists)
 
             # ---- epoch rollover (the reference counts records vs dataset size)
             if train_state["records"] >= dataset_size:
@@ -414,10 +436,29 @@ class LocalOptimizer:
             # ---- checkpoint
             if (o.checkpoint is not None and o.checkpoint_trigger is not None
                     and o.checkpoint_trigger(train_state)):
+                micro_n = getattr(self._step, "micro_n", lambda: 0)()
+                if micro_n:
+                    logger.warning(
+                        "checkpoint taken mid-accumulation-cycle (%d of %d "
+                        "micro-batches pending); the partial gradient "
+                        "accumulator is not checkpointed — on resume the "
+                        "cycle restarts", micro_n, o.grad_accum)
                 path = o.checkpoint.save(train_state["neval"], variables, slots,
                                          {k: train_state[k] for k in
                                           ("epoch", "neval", "records")})
                 logger.info("checkpoint -> %s", path)
+
+        # end trigger may fire mid-accumulation-cycle: flush the partial
+        # accumulator so those micro-batches' gradients aren't discarded
+        flush = getattr(self._step, "flush", None)
+        if flush is not None:
+            eff_step = train_state["neval"] // o.grad_accum
+            lr = o.optim_method.current_rate(
+                {**train_state, "neval": eff_step})
+            variables["params"], slots = flush(
+                variables["params"], slots,
+                jnp.asarray(lr, jnp.float32),
+                jnp.asarray(eff_step, jnp.int32))
 
         if pending is not None:
             self._emit(pending)
@@ -429,19 +470,19 @@ class LocalOptimizer:
 
     def _emit(self, pending) -> None:
         """Write log line + TB scalars for an already-dispatched step;
-        called one step late so the loss fetch overlaps device compute."""
+        called one step late so the loss fetch overlaps device compute.
+        Histogram data arrives pre-materialized (see run()): the live
+        param buffers are donated to the next step before _emit runs."""
         o = self.o
-        state, loss, lr, throughput, variables = pending
+        state, loss, lr, throughput, hists = pending
         epoch, neval = state["epoch"], state["neval"]
         if o.train_summary is not None:
             s = o.train_summary
             s.add_scalar("Loss", float(loss), neval)
             s.add_scalar("Throughput", throughput, neval)
             s.add_scalar("LearningRate", lr, neval)
-            pt = s.get_summary_trigger("Parameters")
-            if pt is not None and pt(state):
-                for name, leaf in o.model.parameters(variables):
-                    s.add_histogram(name, np.asarray(leaf), neval)
+            for name, data in (hists or ()):
+                s.add_histogram(name, data, neval)
         if neval % o.log_every == 0:
             logger.info(
                 "epoch %d iter %d loss %.6f lr %.5g %.1f rec/s [%s]",

@@ -5,7 +5,8 @@ from bigdl_tpu.parallel.mesh import (
     make_mesh, parse_axes, replicated, sharded, host_to_global,
 )
 from bigdl_tpu.parallel.data_parallel import (
-    FlatParamSpec, make_dp_train_step, make_dp_eval_step,
+    FlatParamSpec, make_dp_accum_steps, make_dp_train_step,
+    make_dp_eval_step,
 )
 from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
 from bigdl_tpu.parallel.ring_attention import (

@@ -80,32 +80,16 @@ class FlatParamSpec:
         return jax.tree_util.tree_unflatten(self.treedef, out)
 
 
-def make_dp_train_step(
-    model: Module,
-    criterion: Criterion,
-    method,
-    mesh: Mesh,
-    spec: FlatParamSpec,
-    axis: str = "data",
-    grad_dtype: Optional[str] = "bfloat16",
-    clip_const: Optional[Tuple[float, float]] = None,
-    clip_norm: Optional[float] = None,
-    precision=None,
-) -> Callable:
-    """Build the jitted SPMD train step.
+def _make_scattered_grads(model, criterion, spec, axis, grad_dtype,
+                          precision):
+    """Per-device closure: local fwd/bwd on the batch shard, then
+    reduce-scatter of the flat gradient — the putGradients/
+    aggregateGradientPartition half of the reference's iteration.
+    Returns (g_my (shard_size,) f32 mean-over-global-batch, new_state,
+    local loss)."""
+    n = spec.num_shards
 
-    Signature: (flat_w, slots, mod_state, bx, by, lr, stepno, rng)
-             -> (flat_w', slots', mod_state', mean_loss)
-
-    Shardings: flat_w replicated; slots sharded on `axis` (ZeRO-1);
-    mod_state replicated; batch sharded on `axis`. `precision` is a
-    utils.precision.Policy for bf16-compute mixed precision (master
-    weights stay fp32 in flat_w).
-    """
-    n = mesh.shape[axis]
-    other_axes = [a for a in mesh.axis_names if a != axis]
-
-    def body(flat_w, slots, mod_state, bx, by, lr, stepno, rng):
+    def scattered_grads(flat_w, mod_state, bx, by, rng):
         params = spec.unflatten(flat_w)
         my_index = lax.axis_index(axis)
         local_rng = jax.random.fold_in(rng, my_index)
@@ -143,28 +127,94 @@ def make_dp_train_step(
             # exact path: fused f32 reduce-scatter
             g_my = lax.psum_scatter(flat_g, axis, scatter_dimension=0,
                                     tiled=True) / n
-        if clip_const is not None:
-            g_my = jnp.clip(g_my, clip_const[0], clip_const[1])
-        if clip_norm is not None:
-            # global grad norm needs the full (pre-scatter) vector; compute
-            # from the scattered shards with a psum — mathematically equal
-            sq = lax.psum(jnp.sum(g_my * g_my), axis)
-            scale = jnp.minimum(1.0, clip_norm / jnp.maximum(jnp.sqrt(sq), 1e-12))
-            g_my = g_my * scale
+        return g_my, new_state, loss
 
+    return scattered_grads
+
+
+def _clip_shard(g_my, clip_const, clip_norm, axis):
+    if clip_const is not None:
+        g_my = jnp.clip(g_my, clip_const[0], clip_const[1])
+    if clip_norm is not None:
+        # global grad norm needs the full (pre-scatter) vector; compute
+        # from the scattered shards with a psum — mathematically equal
+        sq = lax.psum(jnp.sum(g_my * g_my), axis)
+        scale = jnp.minimum(1.0, clip_norm / jnp.maximum(jnp.sqrt(sq), 1e-12))
+        g_my = g_my * scale
+    return g_my
+
+
+NON_REDUCIBLE_STATE_KEYS = frozenset({"num_batches", "step", "counter"})
+
+
+def _non_reducible_key(k) -> bool:
+    return isinstance(k, str) and (k.startswith("_")
+                                   or k in NON_REDUCIBLE_STATE_KEYS)
+
+
+def _reduce_state(new_state, axis, non_reducible: bool = False):
+    """BN running stats etc. diverge per shard of the batch; average them
+    so replicated state stays replicated (documented divergence: the
+    reference keeps per-replica stats — SURVEY.md §7 hard parts).
+
+    NOT every float leaf is averaged: state entries whose dict key starts
+    with '_' or appears in NON_REDUCIBLE_STATE_KEYS (e.g. a float step
+    counter) are taken from the local shard unchanged — the contract is
+    documented on nn.Module.init_state. All shards advance such leaves
+    identically under SPMD, so "keep local" is "keep replicated"."""
+    if isinstance(new_state, dict):
+        return {k: _reduce_state(v, axis,
+                                 non_reducible or _non_reducible_key(k))
+                for k, v in new_state.items()}
+    if isinstance(new_state, (list, tuple)):
+        return type(new_state)(_reduce_state(v, axis, non_reducible)
+                               for v in new_state)
+    if non_reducible:
+        return new_state
+    if jnp.issubdtype(jnp.asarray(new_state).dtype, jnp.floating):
+        return lax.pmean(new_state, axis)
+    return new_state
+
+
+def make_dp_train_step(
+    model: Module,
+    criterion: Criterion,
+    method,
+    mesh: Mesh,
+    spec: FlatParamSpec,
+    axis: str = "data",
+    grad_dtype: Optional[str] = "bfloat16",
+    clip_const: Optional[Tuple[float, float]] = None,
+    clip_norm: Optional[float] = None,
+    precision=None,
+) -> Callable:
+    """Build the jitted SPMD train step.
+
+    Signature: (flat_w, slots, mod_state, bx, by, lr, stepno, rng)
+             -> (flat_w', slots', mod_state', mean_loss)
+
+    Shardings: flat_w replicated; slots sharded on `axis` (ZeRO-1);
+    mod_state replicated; batch sharded on `axis`. `precision` is a
+    utils.precision.Policy for bf16-compute mixed precision (master
+    weights stay fp32 in flat_w).
+    """
+    other_axes = [a for a in mesh.axis_names if a != axis]
+    scattered_grads = _make_scattered_grads(model, criterion, spec, axis,
+                                            grad_dtype, precision)
+
+    def body(flat_w, slots, mod_state, bx, by, lr, stepno, rng):
+        g_my, new_state, loss = scattered_grads(flat_w, mod_state, bx, by,
+                                                rng)
+        g_my = _clip_shard(g_my, clip_const, clip_norm, axis)
+
+        my_index = lax.axis_index(axis)
         w_my = lax.dynamic_slice(flat_w, (my_index * spec.shard_size,),
                                  (spec.shard_size,))
         new_w_my, new_slots = method.update(g_my, w_my, slots, lr, stepno)
         new_flat_w = lax.all_gather(new_w_my, axis, axis=0, tiled=True)
 
         mean_loss = lax.pmean(loss, axis)
-        # BN running stats etc. diverge per shard of the batch; average them
-        # so replicated state stays replicated (documented divergence: the
-        # reference keeps per-replica stats — SURVEY.md §7 hard parts)
-        new_state = jax.tree_util.tree_map(
-            lambda s: lax.pmean(s, axis) if jnp.issubdtype(
-                jnp.asarray(s).dtype, jnp.floating) else s,
-            new_state)
+        new_state = _reduce_state(new_state, axis)
         if other_axes:
             mean_loss = lax.pmean(mean_loss, tuple(other_axes))
         return new_flat_w, new_slots, new_state, mean_loss
@@ -177,6 +227,71 @@ def make_dp_train_step(
         check_vma=False,
     )
     return jax.jit(smapped, donate_argnums=(0, 1))
+
+
+def make_dp_accum_steps(
+    model: Module,
+    criterion: Criterion,
+    method,
+    mesh: Mesh,
+    spec: FlatParamSpec,
+    axis: str = "data",
+    grad_dtype: Optional[str] = "bfloat16",
+    clip_const: Optional[Tuple[float, float]] = None,
+    clip_norm: Optional[float] = None,
+    precision=None,
+) -> Tuple[Callable, Callable]:
+    """Gradient accumulation on the mesh: the accumulator lives SHARDED
+    (shard_size,) per device — micro-steps reduce-scatter then add, so
+    accumulation costs one extra f32 vector per shard, never a full
+    gradient replica (cheap exactly as VERDICT r1 #3 prescribes:
+    accumulate the scattered shard, after psum_scatter, before the
+    optimizer step).
+
+    Returns (micro_fn, apply_fn):
+      micro_fn: (flat_w, g_acc, mod_state, bx, by, rng)
+              -> (g_acc', mod_state', mean_loss)
+      apply_fn: (flat_w, slots, g_acc, lr, stepno, n_micro)
+              -> (flat_w', slots', zeroed g_acc)
+    Clipping applies to the averaged accumulated gradient at update time
+    (same semantics as the local path's clip_and_update).
+    """
+    other_axes = [a for a in mesh.axis_names if a != axis]
+    scattered_grads = _make_scattered_grads(model, criterion, spec, axis,
+                                            grad_dtype, precision)
+
+    def micro_body(flat_w, g_acc, mod_state, bx, by, rng):
+        g_my, new_state, loss = scattered_grads(flat_w, mod_state, bx, by,
+                                                rng)
+        mean_loss = lax.pmean(loss, axis)
+        new_state = _reduce_state(new_state, axis)
+        if other_axes:
+            mean_loss = lax.pmean(mean_loss, tuple(other_axes))
+        return g_acc + g_my, new_state, mean_loss
+
+    def apply_body(flat_w, slots, g_acc, lr, stepno, n_micro):
+        g_my = _clip_shard(g_acc / n_micro, clip_const, clip_norm, axis)
+        my_index = lax.axis_index(axis)
+        w_my = lax.dynamic_slice(flat_w, (my_index * spec.shard_size,),
+                                 (spec.shard_size,))
+        new_w_my, new_slots = method.update(g_my, w_my, slots, lr, stepno)
+        new_flat_w = lax.all_gather(new_w_my, axis, axis=0, tiled=True)
+        return new_flat_w, new_slots, jnp.zeros_like(g_acc)
+
+    batch_spec = P(axis)
+    micro_fn = jax.jit(shard_map(
+        micro_body, mesh=mesh,
+        in_specs=(P(), P(axis), P(), batch_spec, batch_spec, P()),
+        out_specs=(P(axis), P(), P()),
+        check_vma=False,
+    ), donate_argnums=(1,))
+    apply_fn = jax.jit(shard_map(
+        apply_body, mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P(), P(), P()),
+        out_specs=(P(), P(axis), P(axis)),
+        check_vma=False,
+    ), donate_argnums=(0, 1, 2))
+    return micro_fn, apply_fn
 
 
 def make_dp_eval_step(model: Module, methods, mesh: Mesh, axis: str = "data"):

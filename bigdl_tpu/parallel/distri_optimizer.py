@@ -32,7 +32,8 @@ from bigdl_tpu.optim.metrics import Metrics, Timer
 from bigdl_tpu.optim.optimizer import LocalOptimizer, Optimizer, _batch_iterator
 from bigdl_tpu.optim.validation import ValidationResult
 from bigdl_tpu.parallel.data_parallel import (
-    FlatParamSpec, make_dp_eval_step, make_dp_train_step,
+    FlatParamSpec, make_dp_accum_steps, make_dp_eval_step,
+    make_dp_train_step,
 )
 from bigdl_tpu.parallel.mesh import host_to_global
 
@@ -112,11 +113,19 @@ class DistriOptimizer(LocalOptimizer):
                     "(padded %d, %d per shard)", n, self.axis, spec.total,
                     spec.padded, spec.shard_size)
 
-        step_fn = make_dp_train_step(
-            o.model, o.criterion, o.optim_method, self.mesh, spec,
-            axis=self.axis, grad_dtype=self.grad_dtype,
-            clip_const=o.grad_clip_const, clip_norm=o.grad_clip_norm,
-            precision=o.precision)
+        accum = o.grad_accum
+        if accum == 1:
+            step_fn = make_dp_train_step(
+                o.model, o.criterion, o.optim_method, self.mesh, spec,
+                axis=self.axis, grad_dtype=self.grad_dtype,
+                clip_const=o.grad_clip_const, clip_norm=o.grad_clip_norm,
+                precision=o.precision)
+        else:
+            micro_fn, apply_fn = make_dp_accum_steps(
+                o.model, o.criterion, o.optim_method, self.mesh, spec,
+                axis=self.axis, grad_dtype=self.grad_dtype,
+                clip_const=o.grad_clip_const, clip_norm=o.grad_clip_norm,
+                precision=o.precision)
         if o.validation_methods:
             eval_fn = make_dp_eval_step(o.model, o.validation_methods,
                                         self.mesh, self.axis)
@@ -129,6 +138,14 @@ class DistriOptimizer(LocalOptimizer):
         # slice: the ZeRO-1 optimizer-state sharding
         slots = self._place_sharded_slots(
             o.optim_method.init_slots(jnp.zeros((spec.padded,), jnp.float32)))
+        sharded = NamedSharding(self.mesh, P(self.axis))
+
+        def fresh_acc():
+            return jax.device_put(jnp.zeros((spec.padded,), jnp.float32),
+                                  sharded)
+
+        g_acc = fresh_acc() if accum > 1 else None
+        micro_n = 0
         train_state: Dict[str, Any] = {"epoch": 1, "neval": 0,
                                        "records": 0, "loss": None, "score": None}
 
@@ -151,15 +168,34 @@ class DistriOptimizer(LocalOptimizer):
             try:
                 with Timer(self.metrics, "data_fetch_s"):
                     mb = next(batches)
-                lr = o.optim_method.current_rate(train_state)
+                # schedules and the optimizer's step counter advance per
+                # UPDATE, not per micro-batch (mirrors LocalOptimizer)
+                eff_step = train_state["neval"] // accum
+                lr = o.optim_method.current_rate(
+                    train_state if accum == 1
+                    else {**train_state, "neval": eff_step})
                 step_rng = jax.random.fold_in(rng, train_state["neval"])
                 with Timer(self.metrics, "dispatch_s"):
-                    flat_w, slots, mod_state, loss = step_fn(
-                        flat_w, slots, mod_state,
-                        self._global(mb.input), self._global(mb.target),
-                        jnp.asarray(lr, jnp.float32),
-                        jnp.asarray(train_state["neval"], jnp.int32),
-                        step_rng)
+                    if accum == 1:
+                        flat_w, slots, mod_state, loss = step_fn(
+                            flat_w, slots, mod_state,
+                            self._global(mb.input), self._global(mb.target),
+                            jnp.asarray(lr, jnp.float32),
+                            jnp.asarray(eff_step, jnp.int32),
+                            step_rng)
+                    else:
+                        g_acc, mod_state, loss = micro_fn(
+                            flat_w, g_acc, mod_state,
+                            self._global(mb.input), self._global(mb.target),
+                            step_rng)
+                        micro_n += 1
+                        if micro_n == accum:
+                            flat_w, slots, g_acc = apply_fn(
+                                flat_w, slots, g_acc,
+                                jnp.asarray(lr, jnp.float32),
+                                jnp.asarray(eff_step, jnp.int32),
+                                jnp.asarray(accum, jnp.float32))
+                            micro_n = 0
             except Exception:
                 if (o.checkpoint is not None and o.checkpoint.latest()
                         and retries < self.max_retries):
@@ -176,6 +212,8 @@ class DistriOptimizer(LocalOptimizer):
                         self._adapt_slots(saved_slots, om, spec))
                     train_state.update(saved_ts)
                     batches = _batch_iterator(o.dataset, True, o.batch_size)
+                    if accum > 1:
+                        g_acc, micro_n = fresh_acc(), 0
                     continue
                 raise
 
@@ -226,6 +264,12 @@ class DistriOptimizer(LocalOptimizer):
 
             if (o.checkpoint is not None and o.checkpoint_trigger is not None
                     and o.checkpoint_trigger(train_state)):
+                if micro_n:
+                    logger.warning(
+                        "checkpoint taken mid-accumulation-cycle (%d of %d "
+                        "micro-batches pending); the partial gradient "
+                        "accumulator is not checkpointed — on resume the "
+                        "cycle restarts", micro_n, accum)
                 saved_variables = {
                     "params": jax.device_get(self._unflatten(flat_w)),
                     "state": jax.device_get(mod_state),
@@ -237,6 +281,19 @@ class DistriOptimizer(LocalOptimizer):
                     optim_meta={"layout": "zero1_flat", "num_shards": n,
                                 "total": spec.total, "padded": spec.padded})
                 logger.info("checkpoint -> %s", path)
+
+        # end trigger may fire mid-accumulation-cycle: flush the partial
+        # accumulator (mean over micro-batches actually seen) so that
+        # gradient work isn't silently discarded — mirrors LocalOptimizer
+        if accum > 1 and micro_n:
+            eff_step = train_state["neval"] // accum
+            lr = o.optim_method.current_rate(
+                {**train_state, "neval": eff_step})
+            flat_w, slots, g_acc = apply_fn(
+                flat_w, slots, g_acc, jnp.asarray(lr, jnp.float32),
+                jnp.asarray(eff_step, jnp.int32),
+                jnp.asarray(micro_n, jnp.float32))
+            micro_n = 0
 
         o.model.variables = {
             "params": jax.device_get(self._unflatten(flat_w)),

@@ -60,8 +60,16 @@ class Engine:
         if coordinator_address is None:
             coordinator_address = os.environ.get("BIGDL_COORDINATOR")
             if coordinator_address is not None:
-                num_processes = int(os.environ["BIGDL_NUM_PROCESSES"])
-                process_id = int(os.environ["BIGDL_PROCESS_ID"])
+                n = os.environ.get("BIGDL_NUM_PROCESSES")
+                pid = os.environ.get("BIGDL_PROCESS_ID")
+                if n is None or pid is None:
+                    raise ValueError(
+                        "BIGDL_COORDINATOR is set but "
+                        f"BIGDL_NUM_PROCESSES={n!r} / "
+                        f"BIGDL_PROCESS_ID={pid!r}; all three must be set "
+                        "together (scripts/launch_pod.sh exports them)")
+                num_processes = int(n)
+                process_id = int(pid)
         if coordinator_address is not None:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,

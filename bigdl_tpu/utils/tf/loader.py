@@ -91,6 +91,19 @@ def _tensor_to_np(t) -> np.ndarray:
     return vals.reshape(shape)
 
 
+def _require_nhwc(tf_node) -> None:
+    """Converters assume NHWC (the framework's native layout). NCHW
+    frozen graphs (GPU-trained) would import with silently wrong
+    results — refuse instead."""
+    fmt = tf_node.attr["data_format"].s if "data_format" in tf_node.attr \
+        else b""
+    if fmt not in (b"", b"NHWC"):
+        raise NotImplementedError(
+            f"{tf_node.name}: data_format={fmt.decode()!r} — only NHWC "
+            "frozen graphs are supported (transpose the graph to NHWC "
+            "before freezing)")
+
+
 def _norm(ref: str) -> Optional[str]:
     """'name:0' → 'name'; '^name' (control dep) → None."""
     if ref.startswith("^"):
@@ -271,6 +284,11 @@ class TensorflowLoader:
                 return wire(nn.MM(trans_a=attr["transpose_a"].b,
                                   trans_b=attr["transpose_b"].b),
                             [x, y], name)
+            if attr["transpose_a"].b:
+                raise NotImplementedError(
+                    f"{name}: MatMul with transpose_a on the const-weight "
+                    "path is not supported (would silently transpose the "
+                    "activations)")
             if attr["transpose_b"].b:
                 w = w.T
             lin = nn.Linear(w.shape[0], w.shape[1], with_bias=False)
@@ -278,6 +296,7 @@ class TensorflowLoader:
                         {"params": {"weight": w.astype(np.float32)},
                          "state": {}})
         if op == "BiasAdd":
+            _require_nhwc(tf_node)
             b = const_of(ins[1])
             if b is None:
                 return wire(nn.CAddTable(), [parent(0), parent(1)], name)
@@ -304,6 +323,7 @@ class TensorflowLoader:
             return wire(_BINARY_OPS[op](), [parent(0), parent(1)], name)
 
         if op in ("MaxPool", "AvgPool"):
+            _require_nhwc(tf_node)
             ks = [int(i) for i in attr["ksize"].list.i]
             st = [int(i) for i in attr["strides"].list.i]
             same = attr["padding"].s == b"SAME"
@@ -319,6 +339,7 @@ class TensorflowLoader:
             return wire(m, [parent()], name)
 
         if op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
+            _require_nhwc(tf_node)
             scale = const_of(ins[1])
             offset = const_of(ins[2])
             mean = const_of(ins[3])
@@ -419,6 +440,7 @@ class TensorflowLoader:
 
     def _conv2d(self, tf_node, ins, const_of, parent, wire):
         attr = tf_node.attr
+        _require_nhwc(tf_node)
         w = const_of(ins[1])  # HWIO — native layout, no transpose
         if w is None:
             raise NotImplementedError(f"{tf_node.name}: non-const filter")
@@ -440,6 +462,7 @@ class TensorflowLoader:
 
     def _depthwise(self, tf_node, ins, const_of, parent, wire):
         attr = tf_node.attr
+        _require_nhwc(tf_node)
         w = const_of(ins[1])  # (H, W, C, mult)
         if w is None:
             raise NotImplementedError(f"{tf_node.name}: non-const filter")

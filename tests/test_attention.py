@@ -166,3 +166,78 @@ class TestMultiHeadAttention:
         ye, _ = m.apply(variables, x, training=False)
         ye2, _ = m.apply(variables, x, training=False)
         np.testing.assert_allclose(np.asarray(ye), np.asarray(ye2))
+
+
+class TestXlaBlockwiseForward:
+    """impl='xla' — the blockwise lax.scan flash forward (default on
+    TPU since round 2; see _flash_fwd_xla)."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_oracle_with_lse(self, causal):
+        rng = np.random.RandomState(3)
+        q = jnp.asarray(rng.randn(3, 100, 16), jnp.float32)
+        k = jnp.asarray(rng.randn(3, 100, 16), jnp.float32)
+        v = jnp.asarray(rng.randn(3, 100, 16), jnp.float32)
+        ref, ref_lse = attention_reference(q, k, v, causal=causal,
+                                           return_lse=True)
+        out, lse = flash_attention_with_lse(q, k, v, causal=causal,
+                                            impl="xla", block_k=32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grads_match_oracle(self):
+        rng = np.random.RandomState(4)
+        q = jnp.asarray(rng.randn(2, 64, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(2, 96, 8), jnp.float32)
+        v = jnp.asarray(rng.randn(2, 96, 8), jnp.float32)
+
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(
+                fn(q, k, v) * jnp.arange(8, dtype=jnp.float32))
+
+        g_x = jax.grad(loss(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, impl="xla", block_k=32)),
+            argnums=(0, 1, 2))(q, k, v)
+        g_r = jax.grad(loss(lambda q, k, v: attention_reference(
+            q, k, v, causal=True)), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_x, g_r):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_uneven_kv_padding(self):
+        rng = np.random.RandomState(5)
+        q = jnp.asarray(rng.randn(2, 33, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(2, 77, 8), jnp.float32)
+        v = jnp.asarray(rng.randn(2, 77, 8), jnp.float32)
+        ref = attention_reference(q, k, v, causal=False)
+        out = flash_attention(q, k, v, causal=False, impl="xla",
+                              block_k=32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestFullyMaskedRows:
+    """Causal with seq_q > seq_k leaves leading query rows with NO
+    visible keys (bottom-right alignment). _NEG_INF is finite, so a bare
+    exp(s - m) would emit 1 per masked column and the row would output
+    mean(V); all impls must emit zeros (the ring-combine convention)."""
+
+    @pytest.mark.parametrize("impl", ["xla", "interpret", "reference"])
+    def test_fully_masked_rows_are_zero(self, impl):
+        rng = np.random.RandomState(6)
+        q = jnp.asarray(rng.randn(2, 8, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(2, 4, 8), jnp.float32)
+        v = jnp.asarray(rng.randn(2, 4, 8), jnp.float32)
+        out, lse = flash_attention_with_lse(q, k, v, causal=True,
+                                            impl=impl, block_q=8,
+                                            block_k=4)
+        # rows 0..3 see no keys (row i sees keys <= i + 4 - 8)
+        np.testing.assert_allclose(np.asarray(out[:, :4]), 0.0, atol=1e-6)
+        assert bool(jnp.all(lse[:, :4] < -1e29))
+        # visible rows must still match the oracle
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out[:, 4:]),
+                                   np.asarray(ref[:, 4:]),
+                                   rtol=1e-5, atol=1e-5)

@@ -83,3 +83,19 @@ class TestSparkAdapter:
         df = {"features": [np.zeros(2), np.ones(2)], "label": [0, 1]}
         ds = dataframe_to_dataset(df, process_id=0, num_processes=1)
         assert ds.size() == 2
+
+
+class TestEngineEnvValidation:
+    def test_partial_pod_env_raises_descriptive(self, monkeypatch):
+        """BIGDL_COORDINATOR without its two companions must raise a
+        ValueError naming all three variables, not a bare KeyError
+        (ADVICE r1)."""
+        import pytest
+
+        from bigdl_tpu.utils.engine import Engine
+
+        monkeypatch.setenv("BIGDL_COORDINATOR", "10.0.0.1:8476")
+        monkeypatch.delenv("BIGDL_NUM_PROCESSES", raising=False)
+        monkeypatch.delenv("BIGDL_PROCESS_ID", raising=False)
+        with pytest.raises(ValueError, match="BIGDL_NUM_PROCESSES"):
+            Engine.init_distributed()

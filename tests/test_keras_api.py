@@ -172,3 +172,65 @@ class TestShapeLayers:
         x = np.random.RandomState(0).rand(2, 7).astype(np.float32)
         out = np.asarray(m.module.build().evaluate().forward(x))
         np.testing.assert_allclose(out[:, 3], x)
+
+
+class TestBidirectionalLastState:
+    def test_backward_half_is_final_state(self):
+        """Regression (ADVICE r1): with return_sequences=False the
+        backward half must be the backward RNN's FINAL step (all frames
+        seen). After BiRecurrent re-flips the backward stream to input
+        order that step sits at t=0 — the old Select(2, -1) took the
+        backward RNN's first step (one frame seen) instead."""
+        import jax
+
+        from bigdl_tpu import nn
+        from bigdl_tpu.keras.layers_extra import _BiLastState
+
+        rng = np.random.RandomState(5)
+        x = rng.randn(3, 7, 4).astype(np.float32)
+
+        bi = nn.BiRecurrent(nn.LSTM(4, 6), nn.LSTM(4, 6))
+        variables = bi.init(jax.random.PRNGKey(9))
+        seq, _ = bi.apply(variables, x)
+        out, _ = _BiLastState(6).apply({"params": {}, "state": {}},
+                                       seq)
+        out = np.asarray(out)
+        assert out.shape == (3, 12)
+
+        # independent oracle: run each direction as a plain Recurrent
+        # with the SAME params; Keras last-state = fwd final step concat
+        # bwd final step (bwd runs on the reversed sequence)
+        fwd = nn.Recurrent(nn.LSTM(4, 6))
+        fwd_seq, _ = fwd.apply(
+            {"params": variables["params"]["fwd"], "state": {}}, x)
+        bwd = nn.Recurrent(nn.LSTM(4, 6))
+        bwd_seq, _ = bwd.apply(
+            {"params": variables["params"]["bwd"], "state": {}},
+            x[:, ::-1])
+        expect = np.concatenate(
+            [np.asarray(fwd_seq)[:, -1], np.asarray(bwd_seq)[:, -1]],
+            axis=-1)
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+        # and it must NOT equal the old Select(2, -1) result
+        wrong = np.asarray(seq)[:, -1, :]
+        assert not np.allclose(out, wrong)
+
+    def test_keras_bidirectional_uses_last_state(self):
+        """The built keras graph must end in _BiLastState, not Select."""
+        from bigdl_tpu.keras.layers_extra import _BiLastState
+
+        m = keras.Sequential([
+            keras.Bidirectional(keras.LSTM(6), input_shape=(7, 4)),
+        ])
+        m.build()
+
+        found = []
+
+        def walk(mod):
+            found.append(type(mod).__name__)
+            for child in getattr(mod, "modules", []):
+                walk(child)
+
+        walk(m.module)
+        assert "_BiLastState" in found
+        assert "Select" not in found

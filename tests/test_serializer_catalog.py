@@ -1,0 +1,318 @@
+"""Reflection-driven serialization spec over the FULL layer catalog.
+
+Reference parity: utils/serializer/SerializerSpec.scala — the reference
+auto-enumerates every layer class via reflection and requires each to
+round-trip through the serializer (SURVEY.md §4 "Serialization
+round-trip"). Here: every concrete Module/Criterion defined under
+`bigdl_tpu.nn` is discovered by reflection; each must either have a
+canonical construction in CANON below (and then round-trip through
+module_serializer with bit-identical forward outputs) or appear in
+SKIP with a reason. A class in neither place FAILS the discovery test —
+adding a layer forces adding its spec.
+"""
+
+import importlib
+import inspect
+import pkgutil
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.module import Criterion, Module
+from bigdl_tpu.nn.recurrent import Cell
+from bigdl_tpu.serialization import load_module, save_module
+from bigdl_tpu.serialization.module_serializer import (
+    module_to_spec, spec_to_module,
+)
+from bigdl_tpu.utils.table import T
+
+# ------------------------------------------------------------- discovery
+
+BASES = {"Module", "Criterion", "Container", "Cell", "Graph"}
+
+
+def discover():
+    """All concrete Module/Criterion classes defined under bigdl_tpu.nn."""
+    import bigdl_tpu.nn as nnpkg
+
+    found = {}
+    for info in pkgutil.iter_modules(nnpkg.__path__):
+        mod = importlib.import_module(f"bigdl_tpu.nn.{info.name}")
+        for name, obj in vars(mod).items():
+            if (inspect.isclass(obj) and obj.__module__ == mod.__name__
+                    and not name.startswith("_")
+                    and issubclass(obj, (Module, Criterion))
+                    and name not in BASES):
+                found[name] = obj
+    return found
+
+
+# ---------------------------------------------------------------- inputs
+
+_r = np.random.default_rng(7)
+x2 = jnp.asarray(_r.normal(size=(4, 8)), jnp.float32)
+x2b = jnp.asarray(_r.normal(size=(4, 8)), jnp.float32)
+xpos = jnp.abs(x2) + 0.1
+xprob = jax.nn.sigmoid(x2)
+img = jnp.asarray(_r.normal(size=(2, 8, 8, 3)), jnp.float32)
+seq = jnp.asarray(_r.normal(size=(2, 5, 6)), jnp.float32)
+vol = jnp.asarray(_r.normal(size=(2, 4, 8, 8, 3)), jnp.float32)
+ids = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+y4 = jnp.asarray([0, 2, 1, 3], jnp.int32)
+
+from bigdl_tpu.nn.sparse import encode_sparse
+
+_sp_idx, _sp_val = encode_sparse(
+    [([1, 4], [1.0, 2.0]), ([0, 2, 7], [0.5, 1.5, -1.0])])
+sparse_in = (jnp.asarray(_sp_idx), jnp.asarray(_sp_val))
+
+# ------------------------------------------------------- canonical specs
+# name -> (builder, inputs tuple)
+
+CANON = {
+    # activations
+    "Abs": (lambda: nn.Abs(), (x2,)),
+    "Clamp": (lambda: nn.Clamp(-1.0, 1.0), (x2,)),
+    "ELU": (lambda: nn.ELU(0.9), (x2,)),
+    "Exp": (lambda: nn.Exp(), (x2,)),
+    "GELU": (lambda: nn.GELU(), (x2,)),
+    "HardSigmoid": (lambda: nn.HardSigmoid(), (x2,)),
+    "HardTanh": (lambda: nn.HardTanh(-2.0, 2.0), (x2,)),
+    "LeakyReLU": (lambda: nn.LeakyReLU(0.1), (x2,)),
+    "Log": (lambda: nn.Log(), (xpos,)),
+    "LogSoftMax": (lambda: nn.LogSoftMax(), (x2,)),
+    "Mish": (lambda: nn.Mish(), (x2,)),
+    "PReLU": (lambda: nn.PReLU(8), (x2,)),
+    "Power": (lambda: nn.Power(2.0, 1.5, 0.5), (xpos,)),
+    "ReLU": (lambda: nn.ReLU(), (x2,)),
+    "ReLU6": (lambda: nn.ReLU6(), (x2,)),
+    "RReLU": (lambda: nn.RReLU(), (x2,)),
+    "SReLU": (lambda: nn.SReLU((8,)), (x2,)),
+    "Sigmoid": (lambda: nn.Sigmoid(), (x2,)),
+    "SoftMax": (lambda: nn.SoftMax(), (x2,)),
+    "SoftPlus": (lambda: nn.SoftPlus(), (x2,)),
+    "SoftSign": (lambda: nn.SoftSign(), (x2,)),
+    "Sqrt": (lambda: nn.Sqrt(), (xpos,)),
+    "Square": (lambda: nn.Square(), (x2,)),
+    "Swish": (lambda: nn.Swish(), (x2,)),
+    "Tanh": (lambda: nn.Tanh(), (x2,)),
+    # linear-family
+    "Linear": (lambda: nn.Linear(8, 3), (x2,)),
+    "Bilinear": (lambda: nn.Bilinear(8, 8, 3), ((x2, x2b),)),
+    "CAdd": (lambda: nn.CAdd((8,)), (x2,)),
+    "CMul": (lambda: nn.CMul((8,)), (x2,)),
+    "Cosine": (lambda: nn.Cosine(8, 3), (x2,)),
+    "Euclidean": (lambda: nn.Euclidean(8, 3), (x2,)),
+    # reshape / structural
+    "AddConstant": (lambda: nn.AddConstant(1.5), (x2,)),
+    "Contiguous": (lambda: nn.Contiguous(), (x2,)),
+    "Echo": (lambda: nn.Echo(), (x2,)),
+    "GradientReversal": (lambda: nn.GradientReversal(0.5), (x2,)),
+    "Identity": (lambda: nn.Identity(), (x2,)),
+    "Masking": (lambda: nn.Masking(0.0), (seq,)),
+    "MulConstant": (lambda: nn.MulConstant(2.0), (x2,)),
+    "Narrow": (lambda: nn.Narrow(2, 2, 4), (x2,)),
+    "Padding": (lambda: nn.Padding(2, 2, 2), (x2,)),
+    "Replicate": (lambda: nn.Replicate(3), (x2,)),
+    "Reshape": (lambda: nn.Reshape([2, 4]), (x2,)),
+    "Select": (lambda: nn.Select(2, 3), (x2,)),
+    "Squeeze": (lambda: nn.Squeeze(), (jnp.reshape(x2, (4, 1, 8)),)),
+    "Unsqueeze": (lambda: nn.Unsqueeze(2), (x2,)),
+    "Transpose": (lambda: nn.Transpose([(2, 3)]), (seq,)),
+    "View": (lambda: nn.View(2, 4), (x2,)),
+    "SpatialZeroPadding": (lambda: nn.SpatialZeroPadding(1, 1, 1, 1),
+                           (img,)),
+    # table ops
+    "CAddTable": (lambda: nn.CAddTable(), ((x2, x2b),)),
+    "CSubTable": (lambda: nn.CSubTable(), ((x2, x2b),)),
+    "CMulTable": (lambda: nn.CMulTable(), ((x2, x2b),)),
+    "CDivTable": (lambda: nn.CDivTable(), ((x2, xpos),)),
+    "CMaxTable": (lambda: nn.CMaxTable(), ((x2, x2b),)),
+    "CMinTable": (lambda: nn.CMinTable(), ((x2, x2b),)),
+    "JoinTable": (lambda: nn.JoinTable(1, n_input_dims=1), ((x2, x2b),)),
+    "SplitTable": (lambda: nn.SplitTable(2), (x2,)),
+    "SelectTable": (lambda: nn.SelectTable(1), ((x2, x2b),)),
+    "FlattenTable": (lambda: nn.FlattenTable(), (T(x2, T(x2b)),)),
+    "DotProduct": (lambda: nn.DotProduct(), ((x2, x2b),)),
+    "CosineDistance": (lambda: nn.CosineDistance(), ((x2, x2b),)),
+    "MM": (lambda: nn.MM(), ((jnp.reshape(x2, (2, 4, 4)),
+                              jnp.reshape(x2b, (2, 4, 4))),)),
+    "MV": (lambda: nn.MV(), ((jnp.reshape(x2, (2, 4, 4)),
+                              jnp.reshape(x2b[:2, :4], (2, 4))),)),
+    "Max": (lambda: nn.Max(1, n_input_dims=1), (x2,)),
+    "Mean": (lambda: nn.Mean(1, n_input_dims=1), (x2,)),
+    "Min": (lambda: nn.Min(1, n_input_dims=1), (x2,)),
+    "Sum": (lambda: nn.Sum(1, n_input_dims=1), (x2,)),
+    # containers
+    "Sequential": (lambda: nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                         nn.Linear(16, 3)), (x2,)),
+    "Concat": (lambda: nn.Concat(2, nn.Linear(8, 3), nn.Linear(8, 5)),
+               (x2,)),
+    "ConcatTable": (lambda: nn.ConcatTable(nn.Linear(8, 3), nn.ReLU()),
+                    (x2,)),
+    "ParallelTable": (lambda: nn.ParallelTable(nn.Linear(8, 3),
+                                               nn.Linear(8, 5)),
+                      ((x2, x2b),)),
+    "MapTable": (lambda: nn.MapTable(nn.Linear(8, 3)), ((x2, x2b),)),
+    "Bottle": (lambda: nn.Bottle(nn.Linear(6, 4)), (seq,)),
+    # conv / pool / vision
+    "SpatialConvolution": (lambda: nn.SpatialConvolution(3, 4, 3, 3, 1, 1,
+                                                         1, 1), (img,)),
+    "SpatialDilatedConvolution": (
+        lambda: nn.SpatialDilatedConvolution(3, 4, 3, 3, 1, 1, 2, 2,
+                                             dilation_w=2, dilation_h=2),
+        (img,)),
+    "SpatialFullConvolution": (
+        lambda: nn.SpatialFullConvolution(3, 4, 3, 3, 2, 2), (img,)),
+    "SpatialShareConvolution": (
+        lambda: nn.SpatialShareConvolution(3, 4, 3, 3, 1, 1, 1, 1), (img,)),
+    "TemporalConvolution": (lambda: nn.TemporalConvolution(6, 4, 2), (seq,)),
+    "TemporalMaxPooling": (lambda: nn.TemporalMaxPooling(2), (seq,)),
+    "SpatialMaxPooling": (lambda: nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
+                          (img,)),
+    "SpatialAveragePooling": (lambda: nn.SpatialAveragePooling(2, 2, 2, 2),
+                              (img,)),
+    "SpatialUpSamplingBilinear": (lambda: nn.SpatialUpSamplingBilinear(2),
+                                  (img,)),
+    "SpatialUpSamplingNearest": (lambda: nn.SpatialUpSamplingNearest(2),
+                                 (img,)),
+    "VolumetricConvolution": (
+        lambda: nn.VolumetricConvolution(3, 4, 2, 2, 2), (vol,)),
+    "VolumetricMaxPooling": (lambda: nn.VolumetricMaxPooling(2, 2, 2),
+                             (vol,)),
+    "VolumetricAveragePooling": (lambda: nn.VolumetricAveragePooling(2, 2, 2),
+                                 (vol,)),
+    # normalization
+    "BatchNormalization": (lambda: nn.BatchNormalization(8), (x2,)),
+    "SpatialBatchNormalization": (lambda: nn.SpatialBatchNormalization(3),
+                                  (img,)),
+    "SpatialCrossMapLRN": (lambda: nn.SpatialCrossMapLRN(5, 1e-4, 0.75),
+                           (img,)),
+    "LayerNorm": (lambda: nn.LayerNorm(8), (x2,)),
+    "RMSNorm": (lambda: nn.RMSNorm(8), (x2,)),
+    "Normalize": (lambda: nn.Normalize(2.0), (x2,)),
+    # dropout family (eval mode → deterministic)
+    "Dropout": (lambda: nn.Dropout(0.5), (x2,)),
+    "GaussianDropout": (lambda: nn.GaussianDropout(0.3), (x2,)),
+    "GaussianNoise": (lambda: nn.GaussianNoise(0.1), (x2,)),
+    "SpatialDropout2D": (lambda: nn.SpatialDropout2D(0.4), (img,)),
+    # embedding / sparse / quantized
+    "LookupTable": (lambda: nn.LookupTable(10, 6), (ids,)),
+    "LookupTableSparse": (lambda: nn.LookupTableSparse(16, 4),
+                          (sparse_in,)),
+    "SparseLinear": (lambda: nn.SparseLinear(16, 4), (sparse_in,)),
+    "QuantizedLinear": (lambda: nn.QuantizedLinear(8, 3), (x2,)),
+    "QuantizedSpatialConvolution": (
+        lambda: nn.QuantizedSpatialConvolution(
+            nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1)), (img,)),
+    # recurrent (cells covered via Recurrent wrapper)
+    "Recurrent": (lambda: nn.Recurrent(nn.LSTM(6, 7)), (seq,)),
+    "RnnCell": (lambda: nn.Recurrent(nn.RnnCell(6, 7)), (seq,)),
+    "LSTM": (lambda: nn.Recurrent(nn.LSTM(6, 7)), (seq,)),
+    "LSTMPeephole": (lambda: nn.Recurrent(nn.LSTMPeephole(6, 7)), (seq,)),
+    "GRU": (lambda: nn.Recurrent(nn.GRU(6, 7)), (seq,)),
+    "ConvLSTMPeephole": (
+        lambda: nn.Recurrent(nn.ConvLSTMPeephole(3, 4, 3)),
+        (jnp.asarray(_r.normal(size=(2, 3, 6, 6, 3)), jnp.float32),)),
+    "BiRecurrent": (lambda: nn.BiRecurrent(nn.LSTM(6, 7)), (seq,)),
+    "TimeDistributed": (lambda: nn.TimeDistributed(nn.Linear(6, 2)),
+                        (seq,)),
+    # attention
+    "MultiHeadAttention": (lambda: nn.MultiHeadAttention(8, 2),
+                           (jnp.asarray(_r.normal(size=(2, 5, 8)),
+                                        jnp.float32),)),
+}
+
+# criterions: name -> (builder, (input, target))
+CANON_CRIT = {
+    "AbsCriterion": (lambda: nn.AbsCriterion(), (x2, x2b)),
+    "BCECriterion": (lambda: nn.BCECriterion(),
+                     (xprob, (x2b > 0).astype(jnp.float32))),
+    "ClassNLLCriterion": (lambda: nn.ClassNLLCriterion(),
+                          (jax.nn.log_softmax(x2, axis=-1), y4)),
+    "ClassSimplexCriterion": (lambda: nn.ClassSimplexCriterion(8),
+                              (x2, y4)),
+    "CosineEmbeddingCriterion": (lambda: nn.CosineEmbeddingCriterion(),
+                                 ((x2, x2b),
+                                  jnp.asarray([1., -1., 1., -1.]))),
+    "CosineProximityCriterion": (lambda: nn.CosineProximityCriterion(),
+                                 (x2, x2b)),
+    "CrossEntropyCriterion": (lambda: nn.CrossEntropyCriterion(), (x2, y4)),
+    "DistKLDivCriterion": (lambda: nn.DistKLDivCriterion(),
+                           (jax.nn.log_softmax(x2, axis=-1),
+                            jax.nn.softmax(x2b, axis=-1))),
+    "HingeEmbeddingCriterion": (lambda: nn.HingeEmbeddingCriterion(),
+                                (xpos[:, 0], jnp.asarray([1., -1., 1., -1.]))),
+    "KLDCriterion": (lambda: nn.KLDCriterion(), ((x2, x2b), x2)),
+    "L1Cost": (lambda: nn.L1Cost(), (x2, x2)),
+    "MSECriterion": (lambda: nn.MSECriterion(), (x2, x2b)),
+    "MarginCriterion": (lambda: nn.MarginCriterion(),
+                        (x2[:, 0], jnp.asarray([1., -1., 1., -1.]))),
+    "MarginRankingCriterion": (lambda: nn.MarginRankingCriterion(),
+                               ((x2[:, 0], x2b[:, 0]),
+                                jnp.asarray([1., -1., 1., -1.]))),
+    "MultiCriterion": (lambda: nn.MultiCriterion()
+                       .add(nn.MSECriterion())
+                       .add(nn.AbsCriterion(), 0.5), (x2, x2b)),
+    "MultiLabelMarginCriterion": (
+        lambda: nn.MultiLabelMarginCriterion(),
+        (xprob, jnp.asarray([[1, 0, 0, 0, 0, 0, 0, 0]] * 4, jnp.int32))),
+    "MultiMarginCriterion": (lambda: nn.MultiMarginCriterion(), (x2, y4)),
+    "ParallelCriterion": (lambda: nn.ParallelCriterion()
+                          .add(nn.MSECriterion())
+                          .add(nn.AbsCriterion(), 0.5),
+                          ((x2, x2), (x2b, x2b))),
+    "SmoothL1Criterion": (lambda: nn.SmoothL1Criterion(), (x2, x2b)),
+    "TimeDistributedCriterion": (
+        lambda: nn.TimeDistributedCriterion(nn.MSECriterion()),
+        (seq, jnp.zeros_like(seq))),
+}
+
+# classes that legitimately cannot auto-construct: name -> reason
+SKIP = {
+    "Graph": "DAG serialization covered by test_module_serializer "
+             "graph cases (needs wired Nodes, not a bare ctor)",
+}
+
+
+# ------------------------------------------------------------------ tests
+
+def test_catalog_fully_enumerated():
+    """Every discovered class has a canonical spec or a skip reason, and
+    coverage is >90% of the catalog."""
+    found = discover()
+    covered = set(CANON) | set(CANON_CRIT)
+    missing = sorted(set(found) - covered - set(SKIP))
+    assert not missing, f"classes with no serialization spec: {missing}"
+    pct = len(covered & set(found)) / len(found)
+    assert pct > 0.9, f"catalog coverage {pct:.0%} <= 90%"
+
+
+@pytest.mark.parametrize("name", sorted(CANON), ids=sorted(CANON))
+def test_module_roundtrip(tmp_path, name):
+    build, inputs = CANON[name]
+    module = build()
+    variables = module.init(jax.random.PRNGKey(3))
+    out0, _ = module.apply(variables, *inputs, training=False)
+    save_module(str(tmp_path), module, variables=variables)
+    loaded, lvars = load_module(str(tmp_path))
+    out1, _ = loaded.apply(lvars, *inputs, training=False)
+    a_leaves = jax.tree_util.tree_leaves(out0)
+    b_leaves = jax.tree_util.tree_leaves(out1)
+    assert len(a_leaves) == len(b_leaves)
+    for a, b in zip(a_leaves, b_leaves):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("name", sorted(CANON_CRIT), ids=sorted(CANON_CRIT))
+def test_criterion_roundtrip(name):
+    build, (inp, tgt) = CANON_CRIT[name]
+    crit = build()
+    loss0 = crit(inp, tgt)
+    rebuilt = spec_to_module(module_to_spec(crit))
+    assert type(rebuilt) is type(crit)
+    loss1 = rebuilt(inp, tgt)
+    np.testing.assert_array_equal(np.asarray(loss0), np.asarray(loss1))

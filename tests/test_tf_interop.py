@@ -223,3 +223,35 @@ def test_imported_model_is_trainable(tmp_path):
     gnorm = sum(float(jnp.abs(g).sum())
                 for g in jax.tree_util.tree_leaves(grads))
     assert gnorm > 0
+
+
+class TestLayoutGuards:
+    def test_nchw_rejected(self):
+        """NCHW frozen graphs must refuse to import rather than convert
+        silently with wrong results (ADVICE r1)."""
+        import pytest
+
+        from bigdl_tpu.utils.tf.loader import _require_nhwc
+
+        class _Attr:
+            def __init__(self, s):
+                self.s = s
+
+        class _Node:
+            name = "conv1"
+            attr = {"data_format": _Attr(b"NCHW")}
+
+        with pytest.raises(NotImplementedError, match="NHWC"):
+            _require_nhwc(_Node())
+
+        class _NodeOK:
+            name = "conv2"
+            attr = {"data_format": _Attr(b"NHWC")}
+
+        _require_nhwc(_NodeOK())  # no raise
+
+        class _NodeNoAttr:
+            name = "conv3"
+            attr = {}
+
+        _require_nhwc(_NodeNoAttr())  # defaults are fine
