@@ -109,9 +109,11 @@ class Module(_SpecCaptured):
         replicas every step (parallel/data_parallel._reduce_state) so
         replicated state stays replicated. A leaf that must NOT be
         averaged — e.g. a float step counter — must use a dict key
-        starting with '_' or one of parallel.data_parallel.
-        NON_REDUCIBLE_STATE_KEYS; such leaves are kept as-is (all
-        replicas advance them identically under SPMD)."""
+        starting with '_' (exempts the whole subtree) or sit DIRECTLY
+        under a key in parallel.data_parallel.NON_REDUCIBLE_STATE_KEYS
+        (leaf-level only; does not propagate to subtrees); such leaves
+        are kept as-is (all replicas advance them identically under
+        SPMD)."""
         return {}
 
     def init(self, rng: jax.Array) -> Dict[str, Any]:

@@ -26,16 +26,22 @@ def softmax_cross_entropy_chunked(hidden: jax.Array, head: jax.Array,
                                   chunk: int = 256) -> jax.Array:
     """Mean token NLL of `softmax(hidden @ head)` vs int targets.
 
-    hidden: (B, S, E); head: (E, V); targets: (B, S) int. `chunk` must
-    divide S (pad the sequence otherwise — LM training shapes are
-    static multiples of 128).
+    hidden: (B, S, E); head: (E, V); targets: (B, S) int. When `chunk`
+    does not divide S, the largest divisor of S that is <= chunk is
+    used instead (so S=384 with the default chunk=256 runs at 192);
+    if even that divisor is tiny (< chunk/4 — prime/near-prime S), the
+    scan would degrade to per-token matmuls, so we raise and ask for a
+    padded sequence instead of silently compiling a pathological loop.
     """
     b, s, e = hidden.shape
     if s % chunk:
-        if s < chunk:
-            chunk = s
-        else:
-            raise ValueError(f"chunk {chunk} must divide sequence {s}")
+        best = max(d for d in range(1, min(chunk, s) + 1) if s % d == 0)
+        if best * 4 < min(chunk, s):
+            raise ValueError(
+                f"no usable chunk size for sequence {s} (largest divisor "
+                f"<= {chunk} is {best}); pad the sequence to a multiple "
+                f"of a reasonable chunk")
+        chunk = best
     n = s // chunk
     hc = hidden.reshape(b, n, chunk, e).transpose(1, 0, 2, 3)
     tc = targets.reshape(b, n, chunk).transpose(1, 0, 2)

@@ -70,11 +70,16 @@ class Evaluator:
         eval_fn = make_dp_eval_step(model, methods, mesh, axis)
 
         def pad_rows(x, rows):
+            # Pad by REPEATING the last real row (mode="edge"), matching
+            # MiniBatch.from_samples' padding: ValidationMethod.stats'
+            # mask-array branch assumes padded rows hold real samples, so
+            # zero rows would bias Loss-style metrics even though the
+            # scale uses the real count.
             x = np.asarray(x)
             if x.shape[0] == rows:
                 return x
             widths = [(0, rows - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
-            return np.pad(x, widths)
+            return np.pad(x, widths, mode="edge")
 
         def place(x, rows):
             if isinstance(x, tuple):

@@ -104,14 +104,23 @@ class Loss(ValidationMethod):
                 target = target[:real_size]
             return (self.criterion(output, target) * real_size,
                     jnp.asarray(float(real_size)))
-        # Mask-array case (sharded eval): padded rows repeat real samples,
-        # so scaling the full-batch mean by the real count biases the total
-        # by at most (padded/batch) of one batch's loss. NOTE this value
-        # also feeds Plateau via train_state['score'] when Loss is the
-        # first validation method — keep Loss exact (unsharded) if driving
-        # an LR schedule from it at small validation sizes.
+        # Mask-array case (sharded eval). Padded rows REPEAT THE LAST REAL
+        # ROW (both padding layers guarantee it: MiniBatch.from_samples
+        # `pad_to` repeats samples[-1]; Evaluator._test_mesh pads
+        # mode="edge"), so the batch mean decomposes exactly:
+        #   sum_real = n * mean_all - (n - real) * loss(last_row)
+        # — the final row of any shard is either a real row or a copy of
+        # the last real one, so the correction is exact per shard, even
+        # for an all-padding shard (mean_all == l_last -> total == 0).
+        # Holds for any criterion whose batch value is the per-row mean;
+        # weighted criterions normalizing by sum-of-weights remain an
+        # approximation, as in the reference's batch-weighted Loss.
         cnt = jnp.sum(jnp.asarray(real_size, jnp.float32))
-        return self.criterion(output, target) * cnt, cnt
+        mean_all = self.criterion(output, target)
+        take_last = (lambda x: tuple(e[-1:] for e in x)
+                     if isinstance(x, tuple) else x[-1:])
+        l_last = self.criterion(take_last(output), take_last(target))
+        return n * mean_all - (n - cnt) * l_last, cnt
 
 
 class TreeNNAccuracy(ValidationMethod):

@@ -147,25 +147,28 @@ def _clip_shard(g_my, clip_const, clip_norm, axis):
 NON_REDUCIBLE_STATE_KEYS = frozenset({"num_batches", "step", "counter"})
 
 
-def _non_reducible_key(k) -> bool:
-    return isinstance(k, str) and (k.startswith("_")
-                                   or k in NON_REDUCIBLE_STATE_KEYS)
-
-
 def _reduce_state(new_state, axis, non_reducible: bool = False):
     """BN running stats etc. diverge per shard of the batch; average them
     so replicated state stays replicated (documented divergence: the
     reference keeps per-replica stats — SURVEY.md §7 hard parts).
 
-    NOT every float leaf is averaged: state entries whose dict key starts
-    with '_' or appears in NON_REDUCIBLE_STATE_KEYS (e.g. a float step
-    counter) are taken from the local shard unchanged — the contract is
-    documented on nn.Module.init_state. All shards advance such leaves
-    identically under SPMD, so "keep local" is "keep replicated"."""
+    NOT every float leaf is averaged. Two opt-outs, per the contract on
+    nn.Module.init_state: a dict key starting with '_' exempts its whole
+    subtree (the explicit convention); a key in NON_REDUCIBLE_STATE_KEYS
+    exempts ONLY a direct leaf under that key — it does not propagate to
+    subtrees, so a future module whose batch-dependent stats happen to
+    live under a generic name like 'step' cannot silently diverge. All
+    shards advance exempt leaves identically under SPMD, so "keep local"
+    is "keep replicated"."""
     if isinstance(new_state, dict):
-        return {k: _reduce_state(v, axis,
-                                 non_reducible or _non_reducible_key(k))
-                for k, v in new_state.items()}
+        out = {}
+        for k, v in new_state.items():
+            named_leaf = (isinstance(k, str) and k in NON_REDUCIBLE_STATE_KEYS
+                          and not isinstance(v, (dict, list, tuple)))
+            nr = non_reducible or named_leaf or (
+                isinstance(k, str) and k.startswith("_"))
+            out[k] = _reduce_state(v, axis, nr)
+        return out
     if isinstance(new_state, (list, tuple)):
         return type(new_state)(_reduce_state(v, axis, non_reducible)
                                for v in new_state)
