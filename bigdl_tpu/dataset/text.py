@@ -120,3 +120,21 @@ class LabeledSentenceToSample(Transformer):
         for data, label in it:
             yield Sample(self._fix(data, self.pad_data),
                          self._fix(label, self.pad_label))
+
+
+def synthetic_next_token(n: int, vocab: int, seq: int, seed: int = 0):
+    """Synthetic next-token LM Samples on a cyclic grammar: each sequence
+    is (start + arange) % vocab, target is the input shifted by one —
+    the stand-in for PTB used by the LM examples, the train CLI, and the
+    LM tests (reference: example/languagemodel synthetic mode)."""
+    import numpy as np
+
+    from bigdl_tpu.dataset.sample import Sample
+
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        start = rng.randint(0, vocab)
+        s = (start + np.arange(seq + 1)) % vocab
+        out.append(Sample(s[:-1].astype(np.int32), s[1:].astype(np.int32)))
+    return out

@@ -108,23 +108,16 @@ def run_perf(model_name: str = "resnet50", batch_size: int = 32,
         params, state = variables["params"], variables["state"]
         bx, by = jnp.asarray(bx_np), jnp.asarray(by_np)
 
+        from bigdl_tpu.ops.losses import build_train_loss
+
+        loss_call = build_train_loss(model, criterion, policy)
+
         @jax.jit
         def step(params, state, slots, i):
-            def loss_fn(p):
-                x = bx
-                if policy is not None:
-                    p = policy.cast_to_compute(p)
-                    x = policy.cast_to_compute(x)
-                out, new_state = model.apply(
-                    {"params": p, "state": state}, x, training=True,
-                    rng=jax.random.fold_in(jax.random.PRNGKey(7), i))
-                if policy is not None:
-                    out = policy.cast_to_output(out)
-                    new_state = policy.cast_to_output(new_state)
-                return criterion(out, by), new_state
-
+            rng = jax.random.fold_in(jax.random.PRNGKey(7), i)
             (loss, new_state), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
+                lambda p: loss_call(p, state, bx, by, rng),
+                has_aux=True)(params)
             new_params, new_slots = method.update(
                 grads, params, slots, jnp.asarray(0.01), i)
             return new_params, new_state, new_slots, loss

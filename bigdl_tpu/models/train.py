@@ -22,7 +22,7 @@ def main(argv=None):
     ap.add_argument("--model", default="lenet",
                     help="lenet | resnet20-cifar | resnet50 | resnet18 | "
                          "inception-v1 | vgg16 | alexnet | "
-                         "textclassifier | ncf | bilstm")
+                         "textclassifier | ncf | bilstm | transformer")
     ap.add_argument("-f", "--dataFolder", default=None)
     ap.add_argument("-b", "--batchSize", type=int, default=128)
     ap.add_argument("--learningRate", type=float, default=0.01)
@@ -105,6 +105,15 @@ def main(argv=None):
                                         24).astype(np.int32), int(y))
                      for y in ys]
         val = train[:args.batchSize]
+    elif args.model == "transformer":
+        from bigdl_tpu.dataset.text import synthetic_next_token
+        from bigdl_tpu.models import transformer
+
+        seq = 32
+        model = transformer.build_lm(vocab_size=64, dim=128, num_heads=4,
+                                     num_layers=2, max_len=seq)
+        train = synthetic_next_token(args.batchSize * 4, 64, seq)
+        val = train[:args.batchSize]
     else:
         from bigdl_tpu.models.perf import _build_model
         import numpy as np
@@ -128,12 +137,22 @@ def main(argv=None):
                   dampening=0.0, weightdecay=args.weightDecay)
               if args.optimizer == "sgd" else Adam(args.learningRate))
 
-    opt = (Optimizer(model, DataSet.array(train), nn.ClassNLLCriterion(),
+    if args.model == "transformer":
+        # LM path: the fused chunked criterion keeps the (B, S, V)
+        # log-prob tensor off the training step entirely
+        criterion = nn.ChunkedSoftmaxCE()
+        from bigdl_tpu.optim import Loss
+        val_methods = [Loss(criterion)]
+    else:
+        criterion = nn.ClassNLLCriterion()
+        val_methods = [Top1Accuracy()]
+
+    opt = (Optimizer(model, DataSet.array(train), criterion,
                      batch_size=args.batchSize)
            .set_optim_method(method)
            .set_end_when(Trigger.max_epoch(args.maxEpoch))
            .set_validation(Trigger.every_epoch(), DataSet.array(val),
-                           [Top1Accuracy()], args.batchSize))
+                           val_methods, args.batchSize))
     if args.checkpoint:
         opt.set_checkpoint(args.checkpoint, Trigger.every_epoch())
         if args.resume:

@@ -67,5 +67,5 @@ from bigdl_tpu.nn.criterion import (
     HingeEmbeddingCriterion, CosineEmbeddingCriterion, DistKLDivCriterion,
     KLDCriterion, L1Cost, ClassSimplexCriterion, ParallelCriterion,
     MultiCriterion, TimeDistributedCriterion, MultiMarginCriterion,
-    MarginRankingCriterion, CosineProximityCriterion,
+    MarginRankingCriterion, CosineProximityCriterion, ChunkedSoftmaxCE,
 )

@@ -346,3 +346,65 @@ class CosineProximityCriterion(Criterion):
         tn = target / jnp.maximum(
             jnp.linalg.norm(target, axis=-1, keepdims=True), 1e-12)
         return -jnp.mean(jnp.sum(xn * tn, axis=-1))
+
+
+class ChunkedSoftmaxCE(Criterion):
+    """Large-vocabulary softmax cross-entropy with model fusion.
+
+    The reference pairs nn/LogSoftMax.scala with nn/ClassNLLCriterion.
+    scala — fine at its vocabulary sizes, but on a TPU LM the (B, S, V)
+    log-prob tensor that pairing materializes is the largest HBM sink of
+    the training step (ops/losses.py header: ~2 GB per copy at V=32k,
+    OOMs a 16 GB chip at batch 8). This criterion is the product-surface
+    fix:
+
+    - As a plain criterion, ``forward(log_probs, targets)`` is the mean
+      token NLL over (N, C) or (B, S, V) log-prob input — drop-in for
+      LogSoftMax+ClassNLL/TimeDistributed pairs (eval, Loss metric).
+    - As the Optimizer/DistriOptimizer criterion for a model exposing
+      ``apply_hidden(variables, x, training, rng)`` and
+      ``head(variables)`` (e.g. models.TransformerLM), every training
+      path fuses via `fused_loss`: the loss is computed from hidden
+      states in sequence chunks (ops/losses.
+      softmax_cross_entropy_chunked) and the (B, S, V) tensor is never
+      materialized, forward or backward.
+    """
+
+    def __init__(self, chunk: int = 256):
+        self.chunk = chunk
+
+    def forward(self, input, target):
+        t = target.astype(jnp.int32)
+        picked = jnp.take_along_axis(input, t[..., None], axis=-1)[..., 0]
+        return -jnp.mean(picked)
+
+    def fused_loss(self, model):
+        """Model-fusion protocol hook (ops/losses.build_train_loss):
+        returns ``fn(variables, x, targets, rng) -> (loss, new_state)``
+        in training mode, or None when `model` has no hidden/head
+        surface (the optimizer then falls back to apply+forward)."""
+        if not (hasattr(model, "apply_hidden") and hasattr(model, "head")):
+            return None
+        from bigdl_tpu.ops.losses import softmax_cross_entropy_chunked
+
+        chunk = self.chunk
+
+        def fn(variables, x, targets, rng):
+            if variables.get("state"):
+                # apply_hidden has no state-output channel, so fusion
+                # would silently freeze running statistics — refuse
+                raise ValueError(
+                    f"ChunkedSoftmaxCE cannot fuse with {model!r}: the "
+                    "model carries non-empty state, which the fused "
+                    "path would not update; use a stateless LM or the "
+                    "plain LogSoftMax+criterion path")
+            hidden = model.apply_hidden(variables, x, training=True,
+                                        rng=rng)
+            loss = softmax_cross_entropy_chunked(
+                hidden, model.head(variables), targets, chunk=chunk)
+            return loss, variables["state"]
+
+        return fn
+
+    def __repr__(self):
+        return f"ChunkedSoftmaxCE(chunk={self.chunk})"

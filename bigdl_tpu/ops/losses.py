@@ -21,6 +21,47 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def build_train_loss(model, criterion, precision=None):
+    """The single training-loss construction point for every optimizer
+    (LocalOptimizer, DP/ZeRO-1 step, perf harness).
+
+    Returns ``loss_call(params, mod_state, x, y, rng) -> (loss,
+    new_state)`` in training mode. When the criterion implements the
+    model-fusion protocol — ``criterion.fused_loss(model)`` returning a
+    callable — that fused path is used instead of
+    ``criterion(model.apply(...), y)``; e.g. nn.ChunkedSoftmaxCE +
+    TransformerLM computes the LM loss from hidden states without ever
+    materializing the (B, S, V) log-prob tensor this module's header
+    describes as OOMing a 16 GB chip.
+    """
+    fuse = getattr(criterion, "fused_loss", None)
+    fused = fuse(model) if callable(fuse) else None
+
+    if fused is not None:
+        def loss_call(p, mod_state, x, y, rng):
+            if precision is not None:
+                p = precision.cast_to_compute(p)
+                x = precision.cast_to_compute(x)
+            loss, new_state = fused({"params": p, "state": mod_state},
+                                    x, y, rng)
+            if precision is not None:
+                new_state = precision.cast_to_output(new_state)
+            return loss, new_state
+        return loss_call
+
+    def loss_call(p, mod_state, x, y, rng):
+        if precision is not None:
+            p = precision.cast_to_compute(p)
+            x = precision.cast_to_compute(x)
+        out, new_state = model.apply({"params": p, "state": mod_state}, x,
+                                     training=True, rng=rng)
+        if precision is not None:
+            out = precision.cast_to_output(out)
+            new_state = precision.cast_to_output(new_state)
+        return criterion(out, y), new_state
+    return loss_call
+
+
 def softmax_cross_entropy_chunked(hidden: jax.Array, head: jax.Array,
                                   targets: jax.Array,
                                   chunk: int = 256) -> jax.Array:

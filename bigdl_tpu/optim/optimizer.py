@@ -214,21 +214,14 @@ class LocalOptimizer:
         precision = self.o.precision
         accum = self.o.grad_accum
 
-        def grads_of(params, mod_state, bx, by, rng):
-            def loss_fn(p):
-                x = bx
-                if precision is not None:
-                    p = precision.cast_to_compute(p)
-                    x = precision.cast_to_compute(x)
-                out, new_state = model.apply(
-                    {"params": p, "state": mod_state}, x,
-                    training=True, rng=rng)
-                if precision is not None:
-                    out = precision.cast_to_output(out)
-                    new_state = precision.cast_to_output(new_state)
-                return criterion(out, by), new_state
+        from bigdl_tpu.ops.losses import build_train_loss
 
-            return jax.value_and_grad(loss_fn, has_aux=True)(params)
+        loss_call = build_train_loss(model, criterion, precision)
+
+        def grads_of(params, mod_state, bx, by, rng):
+            return jax.value_and_grad(
+                lambda p: loss_call(p, mod_state, bx, by, rng),
+                has_aux=True)(params)
 
         def clip_and_update(grads, params, slots, lr, stepno):
             if clip_const is not None:

@@ -89,26 +89,18 @@ def _make_scattered_grads(model, criterion, spec, axis, grad_dtype,
     local loss)."""
     n = spec.num_shards
 
+    from bigdl_tpu.ops.losses import build_train_loss
+
+    loss_call = build_train_loss(model, criterion, precision)
+
     def scattered_grads(flat_w, mod_state, bx, by, rng):
         params = spec.unflatten(flat_w)
         my_index = lax.axis_index(axis)
         local_rng = jax.random.fold_in(rng, my_index)
 
-        def loss_fn(p):
-            x = bx
-            if precision is not None:
-                p = precision.cast_to_compute(p)
-                x = precision.cast_to_compute(x)
-            out, new_state = model.apply(
-                {"params": p, "state": mod_state}, x,
-                training=True, rng=local_rng)
-            if precision is not None:
-                out = precision.cast_to_output(out)
-                new_state = precision.cast_to_output(new_state)
-            return criterion(out, by), new_state
-
         (loss, new_state), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
+            lambda p: loss_call(p, mod_state, bx, by, local_rng),
+            has_aux=True)(params)
 
         flat_g = spec.flatten(grads)
         if grad_dtype is not None:

@@ -5,30 +5,17 @@ unrolled by lax.scan, TimeDistributedCriterion over all steps."""
 import os, sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import numpy as np
-
 from bigdl_tpu import nn
-from bigdl_tpu.dataset import DataSet, Sample
+from bigdl_tpu.dataset import DataSet
+from bigdl_tpu.dataset.text import synthetic_next_token
 from bigdl_tpu.models import rnn
 from bigdl_tpu.optim import Optimizer, Adam, Loss, Trigger
 
 VOCAB, SEQ = 64, 24
 
 
-def synthetic(n=256, seed=0):
-    rng = np.random.RandomState(seed)
-    # deterministic cyclic grammar + noise: next = (cur + 1) % VOCAB
-    xs, ys = [], []
-    for _ in range(n):
-        start = rng.randint(0, VOCAB)
-        seq = (start + np.arange(SEQ + 1)) % VOCAB
-        xs.append(seq[:-1].astype(np.int32))
-        ys.append(seq[1:].astype(np.int32))
-    return [Sample(x, y) for x, y in zip(xs, ys)]
-
-
 def main():
-    samples = synthetic()
+    samples = synthetic_next_token(256, VOCAB, SEQ)
     model = rnn.lstm_lm(VOCAB, embed_dim=32, hidden_size=64)
     crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion())
     trained = (

@@ -1,0 +1,41 @@
+"""Transformer language model trained through the product surface.
+
+Post-parity extension of example/languagemodel (the reference's PTB LSTM
+— see language_model.py for that parity example): a decoder-only
+transformer trained with `Optimizer` + `nn.ChunkedSoftmaxCE`. The
+criterion fuses with the model (ops/losses.build_train_loss), so the
+training step computes the loss from hidden states in sequence chunks
+and never materializes the (B, S, V) log-prob tensor — the same
+Optimizer code path every other model uses.
+"""
+
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DataSet
+from bigdl_tpu.dataset.text import synthetic_next_token
+from bigdl_tpu.models import transformer
+from bigdl_tpu.optim import Optimizer, Adam, Loss, Trigger
+
+VOCAB, SEQ = 64, 32
+
+
+def main():
+    samples = synthetic_next_token(256, VOCAB, SEQ)
+    model = transformer.build_lm(VOCAB, dim=128, num_heads=4,
+                                 num_layers=2, max_len=SEQ)
+    crit = nn.ChunkedSoftmaxCE()
+    trained = (
+        Optimizer(model, DataSet.array(samples[:224]), crit, batch_size=32)
+        .set_optim_method(Adam(learningrate=3e-3))
+        .set_end_when(Trigger.max_epoch(6))
+        .set_validation(Trigger.every_epoch(), DataSet.array(samples[224:]),
+                        [Loss(crit)])
+        .optimize()
+    )
+    return trained
+
+
+if __name__ == "__main__":
+    main()

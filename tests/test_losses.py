@@ -1,0 +1,243 @@
+"""ops/losses oracle tests + the ChunkedSoftmaxCE criterion fusion.
+
+The chunked loss is oracled against the materializing
+LogSoftMax+ClassNLL pair it replaces (reference: nn/LogSoftMax.scala +
+nn/ClassNLLCriterion.scala), forward AND gradients; the fusion protocol
+is verified end-to-end through the Optimizer (LM training through the
+product surface must never materialize the (B, S, V) tensor — checked
+on the jaxpr, not just claimed).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.models.transformer import build_lm
+from bigdl_tpu.ops.losses import build_train_loss, softmax_cross_entropy_chunked
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _materializing_loss(hidden, head, targets):
+    logits = (hidden @ head).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(
+        logp, targets[..., None], axis=-1))
+
+
+class TestChunkedSoftmaxCrossEntropy:
+    @pytest.mark.parametrize("b,s,e,v,chunk", [
+        (2, 64, 16, 50, 16),    # chunk divides S
+        (2, 64, 16, 50, 256),   # chunk > S -> single chunk of S
+        (1, 384, 8, 33, 256),   # ADVICE r2 #2: falls back to divisor 192
+        (3, 96, 8, 17, 32),
+    ])
+    def test_forward_and_grad_match_materializing(self, b, s, e, v, chunk):
+        rng = np.random.RandomState(1)
+        hidden = jnp.asarray(rng.randn(b, s, e), jnp.float32)
+        head = jnp.asarray(rng.randn(e, v) * 0.3, jnp.float32)
+        targets = jnp.asarray(rng.randint(0, v, (b, s)))
+
+        got = softmax_cross_entropy_chunked(hidden, head, targets,
+                                            chunk=chunk)
+        want = _materializing_loss(hidden, head, targets)
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
+
+        g_got = jax.grad(lambda h, w: softmax_cross_entropy_chunked(
+            h, w, targets, chunk=chunk), argnums=(0, 1))(hidden, head)
+        g_want = jax.grad(_materializing_loss, argnums=(0, 1))(
+            hidden, head, targets)
+        for a, b_ in zip(g_got, g_want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-5, atol=1e-6)
+
+    def test_prime_sequence_refused(self):
+        h = jnp.zeros((1, 383, 4))
+        w = jnp.zeros((4, 9))
+        t = jnp.zeros((1, 383), jnp.int32)
+        with pytest.raises(ValueError, match="no usable chunk"):
+            softmax_cross_entropy_chunked(h, w, t)
+
+    def test_grad_under_jit_with_remat(self):
+        """value_and_grad under jit (the optimizer's exact usage): the
+        chunk body is jax.checkpoint'ed, so the backward retraces it —
+        values must still match the materializing oracle."""
+        rng = np.random.RandomState(2)
+        hidden = jnp.asarray(rng.randn(2, 128, 8), jnp.float32)
+        head = jnp.asarray(rng.randn(8, 40) * 0.3, jnp.float32)
+        targets = jnp.asarray(rng.randint(0, 40, (2, 128)))
+
+        f = jax.jit(jax.value_and_grad(
+            lambda h: softmax_cross_entropy_chunked(h, head, targets,
+                                                    chunk=32)))
+        loss, g = f(hidden)
+        want_l, want_g = jax.value_and_grad(
+            lambda h: _materializing_loss(h, head, targets))(hidden)
+        np.testing.assert_allclose(float(loss), float(want_l), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(want_g),
+                                   rtol=2e-5, atol=1e-6)
+
+
+class TestChunkedSoftmaxCECriterion:
+    def test_forward_is_mean_nll_2d_and_3d(self):
+        rng = np.random.RandomState(3)
+        crit = nn.ChunkedSoftmaxCE()
+        oracle2 = nn.ClassNLLCriterion()
+        logp2 = jnp.asarray(jax.nn.log_softmax(
+            jnp.asarray(rng.randn(6, 9), jnp.float32)))
+        t2 = jnp.asarray(rng.randint(0, 9, 6))
+        np.testing.assert_allclose(float(crit(logp2, t2)),
+                                   float(oracle2(logp2, t2)), rtol=1e-6)
+
+        oracle3 = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                              size_average=True)
+        logp3 = jnp.asarray(jax.nn.log_softmax(
+            jnp.asarray(rng.randn(2, 5, 9), jnp.float32)))
+        t3 = jnp.asarray(rng.randint(0, 9, (2, 5)))
+        np.testing.assert_allclose(float(crit(logp3, t3)),
+                                   float(oracle3(logp3, t3)), rtol=1e-6)
+
+    def test_fused_loss_none_without_hidden_surface(self):
+        model = nn.Sequential(nn.Linear(4, 3), nn.LogSoftMax()).build(KEY)
+        assert nn.ChunkedSoftmaxCE().fused_loss(model) is None
+        # build_train_loss falls back to apply+forward and still works
+        loss_call = build_train_loss(model, nn.ChunkedSoftmaxCE())
+        x = jnp.ones((2, 4))
+        y = jnp.zeros((2,), jnp.int32)
+        loss, _ = loss_call(model.variables["params"],
+                            model.variables["state"], x, y, KEY)
+        want = nn.ClassNLLCriterion()(
+            model.apply(model.variables, x)[0], y)
+        np.testing.assert_allclose(float(loss), float(want), rtol=1e-6)
+
+    def test_fusion_refuses_stateful_model(self):
+        """apply_hidden has no state-output channel: a model with real
+        state must be refused, not silently trained with frozen stats."""
+        m = build_lm(vocab_size=16, dim=16, num_heads=2, num_layers=1,
+                     max_len=8)
+        fused = nn.ChunkedSoftmaxCE().fused_loss(m)
+        toks = jnp.zeros((1, 8), jnp.int32)
+        with pytest.raises(ValueError, match="non-empty state"):
+            fused({"params": m.init(KEY)["params"],
+                   "state": {"bn": {"mean": jnp.zeros(4)}}},
+                  toks, toks, KEY)
+
+    def test_fused_matches_unfused_through_model(self):
+        """fused (apply_hidden + chunked) == unfused (apply + forward)
+        on the same TransformerLM — value and parameter gradients."""
+        m = build_lm(vocab_size=40, dim=32, num_heads=2, num_layers=2,
+                     max_len=32)
+        variables = m.init(KEY)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 40)
+        tgts = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, 40)
+        crit = nn.ChunkedSoftmaxCE(chunk=8)
+
+        fused = crit.fused_loss(m)
+        assert fused is not None
+
+        def fused_l(p):
+            return fused({"params": p, "state": {}}, toks, tgts, KEY)[0]
+
+        def unfused_l(p):
+            out, _ = m.apply({"params": p, "state": {}}, toks)
+            return crit(out, tgts)
+
+        lf, gf = jax.value_and_grad(fused_l)(variables["params"])
+        lu, gu = jax.value_and_grad(unfused_l)(variables["params"])
+        np.testing.assert_allclose(float(lf), float(lu), rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(gf),
+                        jax.tree_util.tree_leaves(gu)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=1e-5)
+
+    def test_train_step_jaxpr_never_materializes_bsv(self):
+        """THE point of the fusion: the jitted training step's jaxpr
+        (all sub-jaxprs included) contains no (B, S, V) intermediate."""
+        b, s, v = 4, 64, 512
+        m = build_lm(vocab_size=v, dim=32, num_heads=2, num_layers=2,
+                     max_len=s)
+        variables = m.init(KEY)
+        crit = nn.ChunkedSoftmaxCE(chunk=16)
+        loss_call = build_train_loss(m, crit)
+        toks = jnp.zeros((b, s), jnp.int32)
+        tgts = jnp.zeros((b, s), jnp.int32)
+
+        jaxpr = jax.make_jaxpr(
+            lambda p: jax.value_and_grad(
+                lambda q: loss_call(q, {}, toks, tgts, KEY)[0])(p)
+        )(variables["params"])
+
+        def walk(jx, seen):
+            for eqn in jx.eqns:
+                for var in list(eqn.invars) + list(eqn.outvars):
+                    aval = getattr(var, "aval", None)
+                    if aval is not None and getattr(aval, "shape", None):
+                        seen.add(tuple(aval.shape))
+                for p_ in eqn.params.values():
+                    inner = getattr(p_, "jaxpr", None)
+                    if inner is not None:
+                        walk(inner, seen)
+                    if isinstance(p_, (list, tuple)):
+                        for q_ in p_:
+                            inner = getattr(q_, "jaxpr", None)
+                            if inner is not None:
+                                walk(inner, seen)
+            return seen
+
+        shapes = walk(jaxpr.jaxpr, set())
+        assert (b, s, v) not in shapes, "fused step materialized (B,S,V)"
+        # sanity: the chunked (B, chunk, V) block IS there
+        assert any(sh[-1] == v and len(sh) >= 3 and sh[-2] == 16
+                   for sh in shapes), shapes
+
+    def test_distri_optimizer_mesh_fused(self):
+        """The fused criterion also drives the DP/ZeRO-1 mesh path
+        (DistriOptimizer): loss finite and falling over 2 epochs on the
+        8-device CPU mesh."""
+        from bigdl_tpu.dataset import DataSet
+        from bigdl_tpu.dataset.text import synthetic_next_token
+        from bigdl_tpu.optim import Adam, Loss, Optimizer, Trigger
+        from bigdl_tpu.parallel import make_mesh
+
+        assert jax.device_count() >= 8
+        samples = synthetic_next_token(64, 16, 16)
+        m = build_lm(vocab_size=16, dim=32, num_heads=2, num_layers=1,
+                     max_len=16)
+        m.build(KEY)
+        crit = nn.ChunkedSoftmaxCE(chunk=8)
+        trained = (Optimizer(m, DataSet.array(samples), crit,
+                             batch_size=16)
+                   .set_optim_method(Adam(learningrate=1e-2))
+                   .set_end_when(Trigger.max_epoch(6))
+                   .set_mesh(make_mesh({"data": 8}))
+                   .optimize())
+        from bigdl_tpu.optim import Evaluator
+        res = Evaluator(trained).test(DataSet.array(samples[:16]),
+                                      [Loss(crit)], 16)
+        assert res["Loss"].result()[0] < 2.0
+
+    def test_optimizer_trains_lm_through_product_surface(self):
+        """Optimizer + ChunkedSoftmaxCE on TransformerLM: loss falls on
+        the cyclic-grammar task (the examples/transformer_lm.py setup)."""
+        from bigdl_tpu.dataset import DataSet
+        from bigdl_tpu.dataset.text import synthetic_next_token
+        from bigdl_tpu.optim import Adam, Evaluator, Loss, Optimizer, Trigger
+
+        samples = synthetic_next_token(64, 16, 16)
+        m = build_lm(vocab_size=16, dim=32, num_heads=2, num_layers=1,
+                     max_len=16)
+        m.build(KEY)
+        crit = nn.ChunkedSoftmaxCE(chunk=8)
+
+        opt = (Optimizer(m, DataSet.array(samples), crit, batch_size=16)
+               .set_optim_method(Adam(learningrate=1e-2))
+               .set_end_when(Trigger.max_epoch(8))
+               .set_validation(Trigger.every_epoch(),
+                               DataSet.array(samples[:16]), [Loss(crit)]))
+        trained = opt.optimize()
+        res = Evaluator(trained).test(DataSet.array(samples[:16]),
+                                      [Loss(crit)], 16)
+        final = res["Loss"].result()[0]
+        assert final < 1.0, f"LM did not train through Optimizer: {final}"
