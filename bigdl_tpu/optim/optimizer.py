@@ -40,8 +40,18 @@ from bigdl_tpu.serialization.checkpoint import Checkpoint
 logger = logging.getLogger("bigdl_tpu.optim")
 
 
-def _batch_iterator(dataset: AbstractDataSet, train: bool, batch_size: Optional[int]):
-    """Yield MiniBatch from a dataset that may produce Samples or MiniBatches."""
+def _batch_iterator(dataset: AbstractDataSet, train: bool,
+                    batch_size: Optional[int], skip: int = 0):
+    """Yield MiniBatch from a dataset that may produce Samples or
+    MiniBatches.
+
+    `skip`: fast-forward past the first `skip` batches — resume support.
+    Training datasets replay deterministic epoch permutations from their
+    seed, so skipping the batches a checkpointed run already consumed
+    re-aligns the stream and makes resumed training bit-for-bit equal to
+    the uninterrupted run. Samples are skipped without stacking (train
+    streams are infinite, every batch is full), so the cost is bare
+    iteration."""
     it = dataset.data(train=train)
     first = next(it, None)
     if first is None:
@@ -50,9 +60,13 @@ def _batch_iterator(dataset: AbstractDataSet, train: bool, batch_size: Optional[
 
     chained = itertools.chain([first], it)
     if isinstance(first, MiniBatch):
+        for _ in range(skip):
+            next(chained, None)
         return chained
     if batch_size is None:
         raise ValueError("dataset yields Samples; batch_size is required")
+    for _ in range(skip * batch_size):
+        next(chained, None)
     return SampleToMiniBatch(batch_size)(chained)
 
 
@@ -273,9 +287,7 @@ class LocalOptimizer:
         def flush(params, slots, lr, stepno):
             """Apply a pending partial accumulator (end trigger fired
             mid-cycle): mean over the micro-batches actually seen, so no
-            gradient work is silently discarded. The micro accumulator
-            itself is deliberately NOT checkpointed — checkpoints are
-            taken at update boundaries (see run())."""
+            gradient work is silently discarded."""
             if micro["n"] == 0:
                 return params, slots
             params, slots = upd_fn(micro["acc"], params, slots, lr,
@@ -284,8 +296,22 @@ class LocalOptimizer:
             micro["acc"], micro["n"] = None, 0
             return params, slots
 
+        def restore_micro(acc, n):
+            """Reinstall a checkpointed mid-cycle accumulator (resume).
+            A checkpoint from a run with a LARGER grad_accum can hold
+            n >= this run's accum; the `n == accum` update check would
+            then never fire again — refuse and restart the cycle."""
+            if int(n) >= accum:
+                logger.warning(
+                    "checkpointed accumulation cycle (%d micro-batches) "
+                    "does not fit grad_accum=%d; discarding the partial "
+                    "accumulator and restarting the cycle", int(n), accum)
+                return
+            micro["acc"], micro["n"] = acc, int(n)
+
         step.flush = flush
-        step.micro_n = lambda: micro["n"]
+        step.micro_state = lambda: (micro["acc"], micro["n"])
+        step.restore_micro = restore_micro
         return step
 
     def _make_eval(self) -> Callable:
@@ -327,10 +353,12 @@ class LocalOptimizer:
         train_state: Dict[str, Any] = {"epoch": 1, "neval": 0,
                                        "records": 0, "loss": None, "score": None}
 
+        saved_accum = None
         if o._resume and o.checkpoint is not None and o.checkpoint.latest():
             variables, slots, saved, optim_meta = o.checkpoint.load(
                 with_optim_meta=True)
-            if (optim_meta or {}).get("layout") == "zero1_flat":
+            flat_layout = (optim_meta or {}).get("layout") == "zero1_flat"
+            if flat_layout:
                 # checkpoint written by DistriOptimizer: each slot is a flat
                 # (padded,) vector over the whole parameter set — unflatten
                 # back to the params-pytree layout this loop uses
@@ -339,15 +367,33 @@ class LocalOptimizer:
                 spec = FlatParamSpec(variables["params"],
                                      optim_meta["num_shards"])
                 slots = jax.tree_util.tree_map(spec.unflatten, slots)
+            saved_accum = o.checkpoint.load_accum()
+            if saved_accum is not None and flat_layout:
+                saved_accum = {"g_acc": spec.unflatten(saved_accum["g_acc"]),
+                               "micro_n": saved_accum["micro_n"]}
             train_state.update(saved)
             logger.info("resumed from %s at %s", o.checkpoint.latest(), saved)
 
         self._step = self._make_step()
+        if saved_accum is not None:
+            if hasattr(self._step, "restore_micro"):
+                self._step.restore_micro(saved_accum["g_acc"],
+                                         int(saved_accum["micro_n"]))
+            else:
+                logger.warning(
+                    "checkpoint holds a mid-cycle accumulator (%d "
+                    "micro-batches) but this run has grad_accum=1; the "
+                    "partial gradients are discarded",
+                    int(saved_accum["micro_n"]))
         if o.validation_methods:
             self._eval_step = self._make_eval()
 
         dataset_size = o.dataset.size()
-        batches = _batch_iterator(o.dataset, True, o.batch_size)
+        # fast-forward the deterministic batch stream to where the
+        # checkpointed run stopped: resumed training sees the same
+        # batches the uninterrupted run would have
+        batches = _batch_iterator(o.dataset, True, o.batch_size,
+                                  skip=train_state["neval"])
         pending = None  # deferred (epoch, neval, loss, lr, thr, vars)
         epoch_start = time.perf_counter()
         iter_start = time.perf_counter()
@@ -429,16 +475,17 @@ class LocalOptimizer:
             # ---- checkpoint
             if (o.checkpoint is not None and o.checkpoint_trigger is not None
                     and o.checkpoint_trigger(train_state)):
-                micro_n = getattr(self._step, "micro_n", lambda: 0)()
-                if micro_n:
-                    logger.warning(
-                        "checkpoint taken mid-accumulation-cycle (%d of %d "
-                        "micro-batches pending); the partial gradient "
-                        "accumulator is not checkpointed — on resume the "
-                        "cycle restarts", micro_n, o.grad_accum)
+                accum_state = None
+                micro_state = getattr(self._step, "micro_state", None)
+                if micro_state is not None:
+                    acc, mn = micro_state()
+                    if mn:  # mid-cycle: persist the partial accumulator
+                        accum_state = {"g_acc": jax.device_get(acc),
+                                       "micro_n": mn}
                 path = o.checkpoint.save(train_state["neval"], variables, slots,
                                          {k: train_state[k] for k in
-                                          ("epoch", "neval", "records")})
+                                          ("epoch", "neval", "records")},
+                                         accum_state=accum_state)
                 logger.info("checkpoint -> %s", path)
 
         # end trigger may fire mid-accumulation-cycle: flush the partial

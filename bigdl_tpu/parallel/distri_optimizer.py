@@ -149,6 +149,46 @@ class DistriOptimizer(LocalOptimizer):
         train_state: Dict[str, Any] = {"epoch": 1, "neval": 0,
                                        "records": 0, "loss": None, "score": None}
 
+        def restore_accum(optim_meta):
+            """Reinstall a checkpointed mid-cycle accumulator (or reset).
+            Handles a pytree-layout accumulator from a LocalOptimizer
+            checkpoint (flatten into this run's ZeRO-1 layout) and a
+            flat accumulator from a different mesh size (strip the old
+            padding, re-pad — mirrors _adapt_slots)."""
+            nonlocal g_acc, micro_n
+            saved = o.checkpoint.load_accum() if o.checkpoint else None
+            if accum == 1:
+                if saved is not None:
+                    logger.warning(
+                        "checkpoint holds a mid-cycle accumulator (%d "
+                        "micro-batches) but this run has grad_accum=1; "
+                        "the partial gradients are discarded",
+                        int(saved["micro_n"]))
+                return
+            if saved is None or int(saved["micro_n"]) >= accum:
+                if saved is not None:
+                    logger.warning(
+                        "checkpointed accumulation cycle (%d micro-"
+                        "batches) does not fit grad_accum=%d; restarting "
+                        "the cycle", int(saved["micro_n"]), accum)
+                g_acc, micro_n = fresh_acc(), 0
+                return
+            acc = saved["g_acc"]
+            if isinstance(acc, dict):
+                flat = spec.flatten(acc)
+            else:
+                flat = jnp.asarray(acc)
+                old_total = (optim_meta or {}).get("total")
+                if flat.shape[0] != spec.padded:
+                    if old_total is None or old_total > spec.padded:
+                        raise ValueError(
+                            f"cannot adapt accumulator of length "
+                            f"{flat.shape[0]} to padded {spec.padded}")
+                    flat = jnp.pad(flat[:old_total],
+                                   (0, spec.padded - old_total))
+            g_acc = jax.device_put(flat, sharded)
+            micro_n = int(saved["micro_n"])
+
         if o._resume and o.checkpoint is not None and o.checkpoint.latest():
             saved_vars, saved_slots, saved_ts, optim_meta = o.checkpoint.load(
                 with_optim_meta=True)
@@ -157,10 +197,14 @@ class DistriOptimizer(LocalOptimizer):
             slots = self._place_sharded_slots(
                 self._adapt_slots(saved_slots, optim_meta, spec))
             train_state.update(saved_ts)
+            restore_accum(optim_meta)
             logger.info("resumed from %s at %s", o.checkpoint.latest(), saved_ts)
 
         dataset_size = o.dataset.size()
-        batches = _batch_iterator(o.dataset, True, o.batch_size)
+        # fast-forward the deterministic batch stream past what the
+        # checkpointed run consumed (bit-for-bit resume; no-op fresh)
+        batches = _batch_iterator(o.dataset, True, o.batch_size,
+                                  skip=train_state["neval"])
         iter_start = time.perf_counter()
         retries = 0
 
@@ -211,9 +255,9 @@ class DistriOptimizer(LocalOptimizer):
                     slots = self._place_sharded_slots(
                         self._adapt_slots(saved_slots, om, spec))
                     train_state.update(saved_ts)
-                    batches = _batch_iterator(o.dataset, True, o.batch_size)
-                    if accum > 1:
-                        g_acc, micro_n = fresh_acc(), 0
+                    batches = _batch_iterator(o.dataset, True, o.batch_size,
+                                              skip=train_state["neval"])
+                    restore_accum(om)
                     continue
                 raise
 
@@ -264,22 +308,21 @@ class DistriOptimizer(LocalOptimizer):
 
             if (o.checkpoint is not None and o.checkpoint_trigger is not None
                     and o.checkpoint_trigger(train_state)):
-                if micro_n:
-                    logger.warning(
-                        "checkpoint taken mid-accumulation-cycle (%d of %d "
-                        "micro-batches pending); the partial gradient "
-                        "accumulator is not checkpointed — on resume the "
-                        "cycle restarts", micro_n, accum)
                 saved_variables = {
                     "params": jax.device_get(self._unflatten(flat_w)),
                     "state": jax.device_get(mod_state),
                 }
+                accum_state = None
+                if micro_n:  # mid-cycle: persist the partial accumulator
+                    accum_state = {"g_acc": jax.device_get(g_acc),
+                                   "micro_n": micro_n}
                 path = o.checkpoint.save(
                     train_state["neval"], saved_variables,
                     jax.device_get(slots),
                     {k: train_state[k] for k in ("epoch", "neval", "records")},
                     optim_meta={"layout": "zero1_flat", "num_shards": n,
-                                "total": spec.total, "padded": spec.padded})
+                                "total": spec.total, "padded": spec.padded},
+                    accum_state=accum_state)
                 logger.info("checkpoint -> %s", path)
 
         # end trigger may fire mid-accumulation-cycle: flush the partial

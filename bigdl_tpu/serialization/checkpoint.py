@@ -124,6 +124,7 @@ class Checkpoint:
 
     MODEL = "model"
     OPTIM = "optim"
+    ACCUM = "accum"
 
     def __init__(self, path: str):
         self.path = path
@@ -131,12 +132,38 @@ class Checkpoint:
 
     def save(self, step: int, model_variables: Any, optim_state: Any,
              train_state: Optional[Dict] = None,
-             optim_meta: Optional[Dict] = None) -> str:
+             optim_meta: Optional[Dict] = None,
+             accum_state: Optional[Any] = None) -> str:
+        """`accum_state`: a pending gradient-accumulation cycle
+        ({'g_acc': ..., 'micro_n': n}) — saved so a mid-cycle checkpoint
+        resumes the cycle instead of dropping the partial gradients
+        (reference divergence: the reference has no grad-accum at all;
+        this keeps resume bit-for-bit faithful)."""
         d = os.path.join(self.path, f"checkpoint-{step}")
         save_pytree(d, self.MODEL, model_variables,
                     metadata={"train_state": train_state or {}})
         save_pytree(d, self.OPTIM, optim_state, metadata=optim_meta)
+        if accum_state is not None:
+            save_pytree(d, self.ACCUM, accum_state)
+        else:
+            # a reused checkpoint-{step} dir may hold another run's
+            # mid-cycle sidecar; loading it would install foreign
+            # gradients — remove it
+            for ext in (".json", ".npz"):
+                p = os.path.join(d, self.ACCUM + ext)
+                if os.path.exists(p):
+                    os.remove(p)
         return d
+
+    def load_accum(self, directory: Optional[str] = None):
+        """The pending accumulation cycle saved alongside a checkpoint,
+        or None (update-boundary checkpoint / older format)."""
+        d = directory or self.latest()
+        if d is None or not os.path.exists(
+                os.path.join(d, f"{self.ACCUM}.json")):
+            return None
+        tree, _ = load_pytree(d, self.ACCUM)
+        return tree
 
     def latest(self) -> Optional[str]:
         if not os.path.isdir(self.path):

@@ -265,17 +265,28 @@ CANON_CRIT = {
                           .add(nn.MSECriterion())
                           .add(nn.AbsCriterion(), 0.5),
                           ((x2, x2), (x2b, x2b))),
+    "ChunkedSoftmaxCE": (lambda: nn.ChunkedSoftmaxCE(chunk=128),
+                         (jax.nn.log_softmax(x2, axis=-1), y4)),
     "SmoothL1Criterion": (lambda: nn.SmoothL1Criterion(), (x2, x2b)),
     "TimeDistributedCriterion": (
         lambda: nn.TimeDistributedCriterion(nn.MSECriterion()),
         (seq, jnp.zeros_like(seq))),
 }
 
+def _canonical_graph():
+    """Two-branch DAG: input fans out to two Linear branches joined by
+    CAddTable — exercises node wiring, fan-out, and multi-input join
+    through the serializer (reference: nn/StaticGraph.scala)."""
+    inp = nn.Input()
+    a = nn.ReLU()(nn.Linear(8, 3)(inp))
+    b = nn.Linear(8, 3)(inp)
+    return nn.Graph(inp, nn.CAddTable()(a, b))
+
+
+CANON["Graph"] = (_canonical_graph, (x2,))
+
 # classes that legitimately cannot auto-construct: name -> reason
-SKIP = {
-    "Graph": "DAG serialization covered by test_module_serializer "
-             "graph cases (needs wired Nodes, not a bare ctor)",
-}
+SKIP = {}
 
 
 # ------------------------------------------------------------------ tests
