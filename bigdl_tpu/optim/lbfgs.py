@@ -12,20 +12,181 @@ TPU-first redesign: the reference's Scala loop with mutable ArrayBuffers
 becomes a `lax.while_loop` over fixed-shape history buffers
 ((m, n) ring buffers + ring index), so the WHOLE optimization — history
 updates, two-loop recursion, line search — is one XLA computation with
-static shapes. Line search is backtracking Armijo under an inner
-`lax.while_loop` (the reference defaults to a fixed step unless lswolfe
-is passed; strong-Wolfe cubic interpolation is a documented divergence).
-Works on any params pytree via ravel_pytree.
+static shapes. The default line search is strong-Wolfe with cubic
+interpolation (reference: optim/LineSearch.scala#lswolfe — bracket then
+zoom, both as fixed-shape `lax.while_loop` stages); backtracking Armijo
+remains available as `line_search="armijo"`. Works on any params pytree
+via ravel_pytree.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.flatten_util import ravel_pytree
+
+
+def _cubic_min(x1, f1, g1, x2, f2, g2, lo, hi):
+    """Minimizer of the cubic through (x1,f1,g1), (x2,f2,g2), clipped to
+    [lo, hi]; bisection when the cubic has no real minimum (reference:
+    LineSearch.scala polynomial interpolation inside lswolfe)."""
+    d1 = g1 + g2 - 3.0 * (f1 - f2) / (x1 - x2)
+    d2sq = d1 * d1 - g1 * g2
+    d2 = jnp.sqrt(jnp.maximum(d2sq, 0.0))
+    t = x2 - (x2 - x1) * ((g2 + d2 - d1) / (g2 - g1 + 2.0 * d2))
+    mid = 0.5 * (x1 + x2)
+    t = jnp.where(d2sq >= 0.0, t, mid)
+    t = jnp.where(jnp.isfinite(t), t, mid)
+    return jnp.clip(t, lo, hi)
+
+
+def _strong_wolfe(vg, x, t0, d, f0, g0, gtd0, c1, c2, max_ls):
+    """Strong-Wolfe line search (reference: LineSearch.scala#lswolfe).
+
+    Phase 1 brackets a step interval by cubic extrapolation; phase 2
+    zooms with cubic interpolation until BOTH Wolfe conditions hold:
+        f(t) <= f0 + c1 t g0·d        (sufficient decrease)
+        |g(t)·d| <= -c2 g0·d          (strong curvature)
+    Returns (t, f_t, g_t, evals). Both phases are one `lax.while_loop`
+    with a stage flag, so the whole search stays inside jit with static
+    shapes. On exhaustion the low bracket end (which always satisfies
+    sufficient decrease) is returned.
+    """
+    BRACKET, ZOOM, DONE = 0, 1, 2
+
+    f1, g1 = vg(x + t0 * d)
+
+    def gtd_of(g):
+        return jnp.dot(g, d)
+
+    init = dict(
+        stage=jnp.asarray(BRACKET), nev=jnp.asarray(1), it=jnp.asarray(0),
+        # previous bracket-phase point (starts at t=0 = the origin)
+        tp=jnp.zeros_like(t0), fp=f0, gtdp=gtd0, gp=g0,
+        # current evaluated point
+        t=t0, f=f1, g=g1,
+        # zoom bracket [lo, hi]; lo always satisfies sufficient decrease
+        lo_t=jnp.zeros_like(t0), lo_f=f0, lo_gtd=gtd0, lo_g=g0,
+        hi_t=jnp.zeros_like(t0), hi_f=f0, hi_gtd=gtd0, hi_g=g0,
+    )
+    keys = list(init)
+
+    def pack(d_):
+        return tuple(d_[k] for k in keys)
+
+    def unpack(c):
+        return dict(zip(keys, c))
+
+    def cond(c):
+        s = unpack(c)
+        return (s["stage"] != DONE) & (s["nev"] < max_ls)
+
+    def body(c):
+        s = unpack(c)
+        gtd_t = gtd_of(s["g"])
+        armijo_fail = (s["f"] > f0 + c1 * s["t"] * gtd0) | \
+            ((s["it"] > 0) & (s["f"] >= s["fp"]))
+        wolfe_ok = jnp.abs(gtd_t) <= -c2 * gtd0
+        pos_slope = gtd_t >= 0.0
+
+        def bracket_step(s):
+            # -> zoom with bracket (prev, cur)
+            to_zoom_a = dict(s, stage=jnp.asarray(ZOOM),
+                             lo_t=s["tp"], lo_f=s["fp"], lo_gtd=s["gtdp"],
+                             lo_g=s["gp"], hi_t=s["t"], hi_f=s["f"],
+                             hi_gtd=gtd_t, hi_g=s["g"])
+            # -> done at cur
+            done = dict(s, stage=jnp.asarray(DONE))
+            # -> zoom with bracket (cur, prev)
+            to_zoom_b = dict(s, stage=jnp.asarray(ZOOM),
+                             lo_t=s["t"], lo_f=s["f"], lo_gtd=gtd_t,
+                             lo_g=s["g"], hi_t=s["tp"], hi_f=s["fp"],
+                             hi_gtd=s["gtdp"], hi_g=s["gp"])
+            # -> extrapolate and evaluate a larger step
+            min_t = s["t"] + 0.01 * (s["t"] - s["tp"])
+            max_t = s["t"] * 10.0
+            t_new = _cubic_min(s["tp"], s["fp"], s["gtdp"],
+                               s["t"], s["f"], gtd_t, min_t, max_t)
+            f_new, g_new = vg(x + t_new * d)
+            extrap = dict(s, tp=s["t"], fp=s["f"], gtdp=gtd_t, gp=s["g"],
+                          t=t_new, f=f_new, g=g_new,
+                          nev=s["nev"] + 1)
+
+            branches = [to_zoom_a, done, to_zoom_b, extrap]
+            sel = jnp.where(armijo_fail, 0,
+                            jnp.where(wolfe_ok, 1,
+                                      jnp.where(pos_slope, 2, 3)))
+            return {k: _select(sel, [b[k] for b in branches])
+                    for k in keys}
+
+        def zoom_step(s):
+            lo, hi = jnp.minimum(s["lo_t"], s["hi_t"]), \
+                jnp.maximum(s["lo_t"], s["hi_t"])
+            w = hi - lo
+            t_new = _cubic_min(s["lo_t"], s["lo_f"], s["lo_gtd"],
+                               s["hi_t"], s["hi_f"], s["hi_gtd"],
+                               lo + 0.1 * w, hi - 0.1 * w)
+            f_new, g_new = vg(x + t_new * d)
+            gtd_new = gtd_of(g_new)
+            nev = s["nev"] + 1
+
+            fail = (f_new > f0 + c1 * t_new * gtd0) | (f_new >= s["lo_f"])
+            new_hi = dict(s, hi_t=t_new, hi_f=f_new, hi_gtd=gtd_new,
+                          hi_g=g_new, nev=nev)
+            done = dict(s, t=t_new, f=f_new, g=g_new,
+                        stage=jnp.asarray(DONE), nev=nev)
+            flip = gtd_new * (s["hi_t"] - s["lo_t"]) >= 0.0
+            move_lo = dict(
+                s, hi_t=jnp.where(flip, s["lo_t"], s["hi_t"]),
+                hi_f=jnp.where(flip, s["lo_f"], s["hi_f"]),
+                hi_gtd=jnp.where(flip, s["lo_gtd"], s["hi_gtd"]),
+                hi_g=jnp.where(flip, s["lo_g"], s["hi_g"]),
+                lo_t=t_new, lo_f=f_new, lo_gtd=gtd_new, lo_g=g_new,
+                nev=nev)
+            wolfe_new = jnp.abs(gtd_new) <= -c2 * gtd0
+            # degenerate bracket: stop on the low end
+            tiny = w <= 1e-9 * jnp.maximum(hi, 1.0)
+            stop = dict(s, t=s["lo_t"], f=s["lo_f"], g=s["lo_g"],
+                        stage=jnp.asarray(DONE), nev=nev)
+            branches = [new_hi, done, move_lo, stop]
+            sel = jnp.where(tiny, 3,
+                            jnp.where(fail, 0, jnp.where(wolfe_new, 1, 2)))
+            return {k: _select(sel, [b[k] for b in branches])
+                    for k in keys}
+
+        out = unpack(lax.cond(s["stage"] == ZOOM,
+                              lambda c: pack(zoom_step(unpack(c))),
+                              lambda c: pack(bracket_step(unpack(c))),
+                              pack(s)))
+        out["it"] = s["it"] + 1
+        return pack(out)
+
+    out = unpack(lax.while_loop(cond, body, pack(init)))
+    # Exhausted searches fall back to a sufficient-decrease point:
+    # ZOOM keeps its low bracket end; BRACKET keeps the current point
+    # only if it passes Armijo, else the previous one (tp=0 initially =
+    # the origin, so the worst case is a zero step, never an ascent).
+    zoom_fall = out["stage"] == ZOOM
+    cur_bad = (out["stage"] == BRACKET) & \
+        (out["f"] > f0 + c1 * out["t"] * gtd0)
+    t = jnp.where(zoom_fall, out["lo_t"],
+                  jnp.where(cur_bad, out["tp"], out["t"]))
+    f = jnp.where(zoom_fall, out["lo_f"],
+                  jnp.where(cur_bad, out["fp"], out["f"]))
+    g = jnp.where(zoom_fall, out["lo_g"],
+                  jnp.where(cur_bad, out["gp"], out["g"]))
+    return t, f, g, out["nev"]
+
+
+def _select(idx, values):
+    """Index-select across same-shaped values (branchless)."""
+    out = values[0]
+    for i, v in enumerate(values[1:], start=1):
+        out = jnp.where(idx == i, v, out)
+    return out
 
 
 class LBFGS:
@@ -36,18 +197,28 @@ class LBFGS:
 
     def __init__(self, max_iter: int = 100, history_size: int = 10,
                  learningrate: float = 1.0, tolfun: float = 1e-8,
-                 tolx: float = 1e-9, line_search: bool = True,
-                 ls_max_steps: int = 20, armijo_c: float = 1e-4,
-                 ls_backtrack: float = 0.5):
+                 tolx: float = 1e-9,
+                 line_search: Union[bool, str] = "wolfe",
+                 ls_max_steps: int = 25, armijo_c: float = 1e-4,
+                 ls_backtrack: float = 0.5, wolfe_c2: float = 0.9):
+        """line_search: "wolfe" (default — reference lswolfe), "armijo"
+        (backtracking sufficient-decrease only), or False (fixed step).
+        True is accepted as "wolfe"."""
         self.max_iter = max_iter
         self.history_size = history_size
         self.learningrate = learningrate
         self.tolfun = tolfun
         self.tolx = tolx
+        if line_search is True:
+            line_search = "wolfe"
+        if line_search not in ("wolfe", "armijo", False):
+            raise ValueError(f"unknown line_search {line_search!r}")
         self.line_search = line_search
         self.ls_max_steps = ls_max_steps
         self.armijo_c = armijo_c
         self.ls_backtrack = ls_backtrack
+        self.wolfe_c2 = wolfe_c2
+        self.evals: Optional[jax.Array] = None  # feval count of last minimize
 
     def minimize(self, feval: Callable, x0: Any
                  ) -> Tuple[Any, jax.Array, jax.Array]:
@@ -95,14 +266,17 @@ class LBFGS:
             return lax.fori_loop(0, m, fwd, r)
 
         def search(x, fx, g, d):
-            """Backtracking Armijo: largest t=lr·β^k with sufficient
-            decrease (reference default is fixed-step; lswolfe is the
-            stronger variant — documented divergence)."""
+            """Line search dispatch: strong-Wolfe (lswolfe), Armijo
+            backtracking, or fixed step. Returns (t, f, g, evals)."""
             gtd = jnp.dot(g, d)
-            t0 = jnp.asarray(self.learningrate)
+            t0 = jnp.asarray(self.learningrate, flat0.dtype)
             if not self.line_search:
                 fx2, g2 = vg(x + t0 * d)
-                return t0, fx2, g2
+                return t0, fx2, g2, jnp.asarray(1)
+            if self.line_search == "wolfe":
+                return _strong_wolfe(vg, x, t0, d, fx, g, gtd,
+                                     self.armijo_c, self.wolfe_c2,
+                                     self.ls_max_steps)
 
             def cond(carry):
                 t, k, fx2, _ = carry
@@ -116,17 +290,18 @@ class LBFGS:
                 return t, k + 1, fx2, g2
 
             fx_first, g_first = vg(x + t0 * d)
-            t, _, fx2, g2 = lax.while_loop(
+            t, k, fx2, g2 = lax.while_loop(
                 cond, body, (t0, jnp.asarray(0), fx_first, g_first))
-            return t, fx2, g2
+            return t, fx2, g2, k + 1
 
         def step(carry):
-            x, fx, g, s_hist, y_hist, rho, count, head, it, _ = carry
+            x, fx, g, s_hist, y_hist, rho, count, head, it, nev, _ = carry
             d = direction(g, s_hist, y_hist, rho, count, head)
             # fall back to steepest descent if d is not a descent dir
             gtd = jnp.dot(g, d)
             d = jnp.where(gtd < 0, d, -g)
-            t, fx2, g2 = search(x, fx, g, d)
+            t, fx2, g2, k = search(x, fx, g, d)
+            nev = nev + k
             s = t * d
             y = g2 - g
             sy = jnp.dot(s, y)
@@ -142,15 +317,16 @@ class LBFGS:
                 (jnp.max(jnp.abs(s)) < self.tolx) | \
                 (jnp.max(jnp.abs(g2)) < self.tolfun)
             return (x + s, fx2, g2, s_hist, y_hist, rho, count, head,
-                    it + 1, converged)
+                    it + 1, nev, converged)
 
         def cond(carry):
-            *_, it, converged = carry
+            *_, it, nev, converged = carry
             return (it < self.max_iter) & jnp.logical_not(converged)
 
         fx0, g0 = vg(flat0)
         init = (flat0, fx0, g0, jnp.zeros((m, n)), jnp.zeros((m, n)),
                 jnp.zeros((m,)), jnp.asarray(0), jnp.asarray(0),
-                jnp.asarray(0), jnp.asarray(False))
+                jnp.asarray(0), jnp.asarray(1), jnp.asarray(False))
         out = lax.while_loop(cond, step, init)
+        self.evals = out[9]
         return unravel(out[0]), out[1], out[8]

@@ -1,10 +1,10 @@
 """Torch interop: import torch.nn models into bigdl_tpu modules.
 
-Reference parity: utils/TorchFile.scala (`load`/`save` of Torch7 .t7
-modules and tensors — the reference's model-import path from the Torch
-ecosystem, SURVEY.md §2.5). The modern Torch ecosystem is PyTorch, so
-this module converts `torch.nn` modules (architecture + weights) into
-our Module/variables pair instead of parsing the long-dead .t7 format.
+Reference parity: utils/TorchFile.scala (SURVEY.md §2.5), split in two:
+the Torch7 `.t7` wire format itself lives in utils/torch_file.py
+(`load_t7`/`save_t7`); this module covers the modern Torch ecosystem —
+PyTorch — converting `torch.nn` modules (architecture + weights) into
+our Module/variables pair.
 
 Layout conversions (we are NHWC/HWIO, torch is NCHW/OIHW):
     Linear.weight  (out, in)      → (in, out)

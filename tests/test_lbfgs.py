@@ -25,9 +25,8 @@ def test_rosenbrock():
         x, y = p[0], p[1]
         return (1 - x) ** 2 + 100.0 * (y - x * x) ** 2
 
-    # Armijo-only backtracking needs more iterations than strong-Wolfe
-    # on Rosenbrock's curved valley (converges exactly at ~670)
-    x, fx, it = LBFGS(max_iter=800, history_size=10).minimize(
+    # default strong-Wolfe converges in ~33 iterations (Armijo: ~670)
+    x, fx, it = LBFGS(max_iter=100, history_size=10).minimize(
         f, jnp.asarray([-1.2, 1.0]))
     np.testing.assert_allclose(np.asarray(x), [1.0, 1.0], atol=1e-3)
     assert float(fx) < 1e-6
@@ -62,3 +61,73 @@ def test_fits_tiny_net_on_xor():
     params, fx, it = LBFGS(max_iter=200).minimize(
         feval, variables["params"])
     assert float(fx) < 1e-3, float(fx)
+
+
+def test_wolfe_curvature_condition_holds():
+    """At the accepted step the STRONG Wolfe conditions hold: sufficient
+    decrease and |g(t)·d| <= c2·|g0·d| (reference: LineSearch.lswolfe)."""
+    from bigdl_tpu.optim.lbfgs import _strong_wolfe
+
+    A = jnp.asarray([[5.0, 1.0], [1.0, 2.0]])
+
+    def f(x):
+        return 0.5 * x @ A @ x + jnp.sum(jnp.cos(x))
+
+    vg = jax.value_and_grad(f)
+    x0 = jnp.asarray([2.0, -3.0])
+    f0, g0 = vg(x0)
+    d = -g0
+    gtd0 = jnp.dot(g0, d)
+    c1, c2 = 1e-4, 0.9
+    t, ft, gt, nev = _strong_wolfe(vg, x0, jnp.asarray(1.0), d, f0, g0,
+                                   gtd0, c1, c2, 25)
+    assert float(t) > 0.0
+    assert float(ft) <= float(f0 + c1 * t * gtd0) + 1e-6
+    assert abs(float(jnp.dot(gt, d))) <= c2 * abs(float(gtd0)) + 1e-6
+    # the returned f/g really are f(x+td)
+    f_chk, g_chk = vg(x0 + t * d)
+    np.testing.assert_allclose(float(ft), float(f_chk), rtol=1e-6)
+    assert int(nev) >= 1
+
+
+def test_wolfe_exhausted_bracket_never_ascends():
+    """Exhausting the eval budget during the bracket (extrapolation)
+    phase must not accept a point that fails sufficient decrease — the
+    search falls back to the last Armijo-satisfying point (worst case a
+    zero step), never an ascent."""
+    from bigdl_tpu.optim.lbfgs import _strong_wolfe
+
+    def f(x):
+        # steep wall just past t=1: extrapolation lands uphill
+        t = x[0]
+        return -t + jnp.where(t > 1.005, 5e3 * (t - 1.005) ** 2, 0.0)
+
+    vg = jax.value_and_grad(f)
+    x0 = jnp.asarray([0.0])
+    f0, g0 = vg(x0)
+    d = jnp.asarray([1.0])
+    gtd0 = jnp.dot(g0, d)
+    t, ft, gt, nev = _strong_wolfe(vg, x0, jnp.asarray(1.0), d, f0, g0,
+                                   gtd0, 1e-4, 0.9, 2)
+    assert float(ft) <= float(f0) + 1e-6, "accepted an ascent step"
+
+
+def test_wolfe_beats_armijo_on_rosenbrock():
+    """Strong-Wolfe converges on Rosenbrock in fewer function
+    evaluations than Armijo backtracking (the point of lswolfe)."""
+    def f(p):
+        x, y = p[0], p[1]
+        return (1 - x) ** 2 + 100.0 * (y - x * x) ** 2
+
+    x0 = jnp.asarray([-1.2, 1.0])
+
+    wolfe = LBFGS(max_iter=800, line_search="wolfe")
+    xw, fw, itw = wolfe.minimize(f, x0)
+    armijo = LBFGS(max_iter=800, line_search="armijo")
+    xa, fa, ita = armijo.minimize(f, x0)
+
+    np.testing.assert_allclose(np.asarray(xw), [1.0, 1.0], atol=1e-3)
+    assert float(fw) < 1e-6
+    assert int(wolfe.evals) < int(armijo.evals), \
+        (int(wolfe.evals), int(armijo.evals))
+    assert int(itw) <= int(ita)
