@@ -50,6 +50,7 @@ class DistriOptimizer(LocalOptimizer):
         self.axis = axis
         self.grad_dtype = grad_dtype
         self.max_retries = max_retries
+        self._gather_fn = None
 
     # ------------------------------------------------------------- helpers
     def _batch_spec(self, x) -> P:
@@ -67,6 +68,23 @@ class DistriOptimizer(LocalOptimizer):
         shard = NamedSharding(self.mesh, P(self.axis))
         return jax.tree_util.tree_map(
             lambda s: jax.device_put(s, shard), slots)
+
+    def _gather(self, tree):
+        """Fetch a (possibly cross-process-sharded) ZeRO-1 tree to host.
+
+        Single process: a plain device_get. Multi-host: sharded arrays
+        span non-addressable devices, so an XLA all-gather (jitted
+        identity re-sharded to replicated) runs first — the analogue of
+        the reference's driver pulling weight slices before writing a
+        checkpoint (SURVEY.md §5.4). The jitted identity is built once
+        per optimizer so repeated checkpoints hit the trace cache."""
+        if jax.process_count() == 1:
+            return jax.device_get(tree)
+        if self._gather_fn is None:
+            self._gather_fn = jax.jit(
+                lambda t: t,
+                out_shardings=NamedSharding(self.mesh, P()))
+        return jax.device_get(self._gather_fn(tree))
 
     @staticmethod
     def _adapt_slots(saved_slots, optim_meta, spec):
@@ -104,6 +122,22 @@ class DistriOptimizer(LocalOptimizer):
             raise ValueError(
                 f"validation batch_size {o.validation_batch_size} must be "
                 f"divisible by the '{self.axis}' mesh axis size {n}")
+
+        # Multi-host: batch_size is GLOBAL; each process feeds its
+        # 1/nproc shard of every batch (the reference's "Spark only
+        # partitions data" — each executor iterates its partition).
+        nproc = jax.process_count()
+        if o.batch_size % nproc:
+            raise ValueError(
+                f"global batch_size {o.batch_size} must be divisible by "
+                f"the process count {nproc}")
+        vbs = o.validation_batch_size or o.batch_size
+        if o.validation_methods and vbs % nproc:
+            raise ValueError(
+                f"validation batch_size {vbs} must be divisible by the "
+                f"process count {nproc}")
+        self._local_bs = o.batch_size // nproc
+        self._local_vbs = vbs // nproc
 
         rng = jax.random.PRNGKey(o.seed)
         variables = dict(o.model.variables)
@@ -203,7 +237,7 @@ class DistriOptimizer(LocalOptimizer):
         dataset_size = o.dataset.size()
         # fast-forward the deterministic batch stream past what the
         # checkpointed run consumed (bit-for-bit resume; no-op fresh)
-        batches = _batch_iterator(o.dataset, True, o.batch_size,
+        batches = _batch_iterator(o.dataset, True, self._local_bs,
                                   skip=train_state["neval"])
         iter_start = time.perf_counter()
         retries = 0
@@ -255,7 +289,8 @@ class DistriOptimizer(LocalOptimizer):
                     slots = self._place_sharded_slots(
                         self._adapt_slots(saved_slots, om, spec))
                     train_state.update(saved_ts)
-                    batches = _batch_iterator(o.dataset, True, o.batch_size,
+                    batches = _batch_iterator(o.dataset, True,
+                                              self._local_bs,
                                               skip=train_state["neval"])
                     restore_accum(om)
                     continue
@@ -314,15 +349,23 @@ class DistriOptimizer(LocalOptimizer):
                 }
                 accum_state = None
                 if micro_n:  # mid-cycle: persist the partial accumulator
-                    accum_state = {"g_acc": jax.device_get(g_acc),
+                    accum_state = {"g_acc": self._gather(g_acc),
                                    "micro_n": micro_n}
                 path = o.checkpoint.save(
                     train_state["neval"], saved_variables,
-                    jax.device_get(slots),
+                    self._gather(slots),
                     {k: train_state[k] for k in ("epoch", "neval", "records")},
                     optim_meta={"layout": "zero1_flat", "num_shards": n,
                                 "total": spec.total, "padded": spec.padded},
                     accum_state=accum_state)
+                if nproc > 1:
+                    # barrier: no host may run ahead (and potentially
+                    # recover from this checkpoint) until host 0 has
+                    # finished writing it
+                    from jax.experimental import multihost_utils
+
+                    multihost_utils.sync_global_devices(
+                        f"ckpt-{train_state['neval']}")
                 logger.info("checkpoint -> %s", path)
 
         # end trigger may fire mid-accumulation-cycle: flush the partial
@@ -350,9 +393,49 @@ class DistriOptimizer(LocalOptimizer):
         params = self._unflatten(flat_w)
         results = [ValidationResult(0.0, 0.0, m.name)
                    for m in o.validation_methods]
-        bs = o.validation_batch_size or o.batch_size
-        for mb in _batch_iterator(o.validation_dataset, False, bs):
-            real = getattr(mb, "real_size", mb.size)
+        it = _batch_iterator(o.validation_dataset, False, self._local_vbs)
+        multi = jax.process_count() > 1
+        last = None
+        while True:
+            mb = next(it, None)
+            if multi:
+                # Hosts may own uneven validation shards (sizes differ
+                # by up to one batch). eval_fn and _global are cross-
+                # process collectives, so EVERY host must join EVERY
+                # round: exchange have-data flags, and exhausted hosts
+                # feed an all-masked copy of their previous batch.
+                from jax.experimental import multihost_utils
+
+                flags = multihost_utils.process_allgather(
+                    np.asarray([0 if mb is None else 1]))
+                if not flags.any():
+                    break
+                if mb is None:
+                    if last is None:
+                        raise RuntimeError(
+                            "a host has an empty validation shard; give "
+                            "every process at least one batch "
+                            "(DataSet.sharded of >= nproc samples)")
+                    # every filler row must be IDENTICAL so the Loss
+                    # edge-correction cancels the shard exactly
+                    from bigdl_tpu.dataset.sample import MiniBatch
+
+                    def tile_first(x, rows):
+                        if isinstance(x, tuple):
+                            return tuple(tile_first(e, rows) for e in x)
+                        a = np.asarray(x)
+                        return np.repeat(a[:1], rows, axis=0)
+
+                    mb = MiniBatch(tile_first(last.input, last.size),
+                                   tile_first(last.target, last.size))
+                    real = 0
+                else:
+                    last = mb
+                    real = getattr(mb, "real_size", mb.size)
+            elif mb is None:
+                break
+            else:
+                real = getattr(mb, "real_size", mb.size)
             mask = (np.arange(mb.size) < real).astype(np.float32)
             stats = eval_fn(params, mod_state,
                             self._global(mb.input), self._global(mb.target),

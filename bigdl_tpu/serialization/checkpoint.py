@@ -125,6 +125,7 @@ class Checkpoint:
     MODEL = "model"
     OPTIM = "optim"
     ACCUM = "accum"
+    MARKER = "COMPLETE"
 
     def __init__(self, path: str):
         self.path = path
@@ -139,13 +140,20 @@ class Checkpoint:
         resumes the cycle instead of dropping the partial gradients
         (reference divergence: the reference has no grad-accum at all;
         this keeps resume bit-for-bit faithful)."""
+        import jax
+
         d = os.path.join(self.path, f"checkpoint-{step}")
+        # multi-host: the training plane is replicated (callers gather
+        # sharded state first), so process 0 writes for everyone — the
+        # reference's driver-writes-checkpoint layout (SURVEY.md §5.4)
         save_pytree(d, self.MODEL, model_variables,
-                    metadata={"train_state": train_state or {}})
-        save_pytree(d, self.OPTIM, optim_state, metadata=optim_meta)
+                    metadata={"train_state": train_state or {}},
+                    only_host0=True)
+        save_pytree(d, self.OPTIM, optim_state, metadata=optim_meta,
+                    only_host0=True)
         if accum_state is not None:
-            save_pytree(d, self.ACCUM, accum_state)
-        else:
+            save_pytree(d, self.ACCUM, accum_state, only_host0=True)
+        elif jax.process_index() == 0:
             # a reused checkpoint-{step} dir may hold another run's
             # mid-cycle sidecar; loading it would install foreign
             # gradients — remove it
@@ -153,6 +161,12 @@ class Checkpoint:
                 p = os.path.join(d, self.ACCUM + ext)
                 if os.path.exists(p):
                     os.remove(p)
+        if jax.process_index() == 0:
+            # completion marker written LAST: latest() skips dirs still
+            # being written (another host's failure recovery must never
+            # load a truncated checkpoint)
+            with open(os.path.join(d, self.MARKER), "w") as f:
+                f.write("complete")
         return d
 
     def load_accum(self, directory: Optional[str] = None):
@@ -171,7 +185,14 @@ class Checkpoint:
         best, best_step = None, -1
         for entry in os.listdir(self.path):
             m = re.fullmatch(r"checkpoint-(\d+)", entry)
-            if m and int(m.group(1)) > best_step:
+            if not m or int(m.group(1)) <= best_step:
+                continue
+            d = os.path.join(self.path, entry)
+            complete = os.path.exists(os.path.join(d, self.MARKER)) or (
+                # pre-marker checkpoints: both manifests present
+                os.path.exists(os.path.join(d, f"{self.OPTIM}.json"))
+                and os.path.exists(os.path.join(d, f"{self.MODEL}.json")))
+            if complete:
                 best, best_step = entry, int(m.group(1))
         return os.path.join(self.path, best) if best else None
 

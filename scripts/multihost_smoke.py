@@ -1,0 +1,174 @@
+"""Multi-process multi-host smoke test on CPU (no cluster needed).
+
+Reference parity: the reference proves its distributed plane without a
+cluster by running Spark `local[N]` (SURVEY.md §4 "Distributed-without-
+a-cluster"); the TPU-native equivalent is N real `jax.distributed`
+processes × M virtual CPU devices each — the same code path a v5e pod
+runs (PJRT process group, global mesh, cross-process collectives),
+minus the ICI.
+
+Launcher mode (no --process-id): spawns NUM_PROCESSES children of this
+script, waits, and writes MULTIHOST.json. Child mode: initializes the
+process group through Engine.init_distributed (the product path), runs
+DP/ZeRO-1 training steps through Optimizer.set_mesh → DistriOptimizer
+with per-host sharded data, checkpoints, resumes, and verifies losses
+are finite and identical across processes.
+
+    python scripts/multihost_smoke.py          # 2 procs x 4 devices
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+NUM_PROCESSES = 2
+DEVICES_PER_PROC = 4
+PORT = 12000 + (os.getpid() % 2000)  # avoid collisions across runs
+
+
+def child(args):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               f" --xla_force_host_platform_device_count="
+                               f"{DEVICES_PER_PROC}")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge
+
+    xla_bridge._backend_factories.pop("axon", None)
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    # the product bring-up path (utils/Engine.scala#Engine.init parity):
+    # BIGDL_* env vars are what scripts/launch_pod.sh exports
+    os.environ["BIGDL_COORDINATOR"] = f"localhost:{args.port}"
+    os.environ["BIGDL_NUM_PROCESSES"] = str(args.num_processes)
+    os.environ["BIGDL_PROCESS_ID"] = str(args.process_id)
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.init_distributed()
+    assert jax.process_count() == args.num_processes, jax.process_count()
+    assert jax.device_count() == args.num_processes * DEVICES_PER_PROC
+
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.optim import Adam, Optimizer, Trigger, Loss
+    from bigdl_tpu.parallel import make_mesh
+
+    rng = np.random.RandomState(0)  # same data on every host, sharded below
+    X = (rng.randn(128, 8).astype(np.float32) +
+         np.repeat(np.eye(4, 8) * 3, 32, 0).astype(np.float32))
+    Y = np.repeat(np.arange(4), 32)
+    elements = [Sample(X[i], int(Y[i])) for i in range(128)]
+    dataset = DataSet.sharded(elements, seed=3)      # per-process shard
+    # 33 samples -> shards of 17 and 16: with local batches of 16 one
+    # host runs 2 eval rounds, the other 1 — exercises the uneven-shard
+    # equalization in DistriOptimizer._validate_mesh (no deadlock)
+    val = DataSet.sharded(elements[:33], seed=3)
+
+    def build():
+        return nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                             nn.Linear(16, 4),
+                             nn.LogSoftMax()).build(jax.random.PRNGKey(0))
+
+    mesh = make_mesh({"data": jax.device_count()})
+    ckpt = os.path.join(args.workdir, "ckpt")
+
+    def train(end_iter, resume):
+        opt = (Optimizer(build(), dataset, nn.ClassNLLCriterion(),
+                         batch_size=32)                # GLOBAL batch
+               .set_optim_method(Adam(learningrate=1e-2))
+               .set_gradient_accumulation(2)
+               .set_end_when(Trigger.max_iteration(end_iter))
+               .set_validation(Trigger.several_iteration(3), val,
+                               [Loss(nn.ClassNLLCriterion())], 32)
+               .set_checkpoint(ckpt, Trigger.several_iteration(3))
+               .set_mesh(mesh))
+        if resume:
+            opt.resume_from_checkpoint()
+        return opt.optimize()
+
+    m1 = train(3, resume=False)       # 3 steps + checkpoint
+    m2 = train(6, resume=True)        # resume, 3 more steps
+
+    flat = np.concatenate([np.ravel(np.asarray(a))
+                           for _, a in m2.parameters()])
+    assert np.isfinite(flat).all(), "non-finite parameters"
+
+    # parameters must be IDENTICAL across processes (replicated plane):
+    # compare a digest via the filesystem
+    digest = float(np.sum(np.abs(flat)))
+    out = {"process_id": args.process_id, "digest": digest,
+           "processes": jax.process_count(),
+           "devices": jax.device_count(),
+           "checkpoint_resumed": True}
+    with open(os.path.join(args.workdir, f"proc{args.process_id}.json"),
+              "w") as f:
+        json.dump(out, f)
+    print(f"[proc {args.process_id}] OK digest={digest:.6f}")
+
+
+def launcher():
+    import tempfile
+
+    workdir = tempfile.mkdtemp(prefix="multihost_smoke_")
+    procs = []
+    for pid in range(NUM_PROCESSES):
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--process-id", str(pid),
+             "--num-processes", str(NUM_PROCESSES),
+             "--port", str(PORT), "--workdir", workdir],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    try:
+        outs = [p.communicate(timeout=420)[0].decode() for p in procs]
+    except subprocess.TimeoutExpired:
+        # a hung child must not leak (it holds the coordinator port)
+        for p in procs:
+            p.kill()
+        outs = [p.communicate()[0].decode() for p in procs]
+    codes = [p.returncode for p in procs]
+    for pid, (c, o) in enumerate(zip(codes, outs)):
+        if c != 0:
+            print(f"--- proc {pid} (rc={c}) ---\n{o}")
+    ok = all(c == 0 for c in codes)
+    digests = []
+    if ok:
+        for pid in range(NUM_PROCESSES):
+            with open(os.path.join(workdir, f"proc{pid}.json")) as f:
+                digests.append(json.load(f)["digest"])
+        ok = len(set(digests)) == 1
+    result = {"ok": ok, "processes": NUM_PROCESSES,
+              "devices_per_process": DEVICES_PER_PROC,
+              "return_codes": codes, "digests": digests,
+              "steps": 6, "grad_accum": 2, "checkpoint_resume": True}
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "MULTIHOST.json")
+    with open(path, "w") as f:
+        json.dump(result, f)
+    print(json.dumps(result))
+    sys.exit(0 if ok else 1)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument("--num-processes", type=int, default=NUM_PROCESSES)
+    ap.add_argument("--port", type=int, default=PORT)
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args()
+    if args.process_id is None:
+        launcher()
+    else:
+        child(args)
+
+
+if __name__ == "__main__":
+    main()
