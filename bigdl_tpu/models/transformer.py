@@ -93,6 +93,18 @@ class TransformerConfig:
     # memory O(layers + one block) instead of O(layers × acts) — the knob
     # that makes long-context training fit HBM (SURVEY.md §7 hard parts)
     remat: bool = False
+    # remat policy: "full" recomputes the whole block (max memory
+    # savings); "dots" saves matmul outputs and recomputes only the
+    # cheap elementwise chain (jax.checkpoint_policies
+    # .dots_with_no_batch_dims_saveable) — most of the memory win at a
+    # fraction of the recompute FLOPs
+    remat_policy: str = "full"
+
+    def __post_init__(self):
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy {self.remat_policy!r}: expected 'full' "
+                "or 'dots'")
 
 
 class TransformerLM(Module):
@@ -243,7 +255,12 @@ class TransformerLM(Module):
             return self._block(x, bp, lrng, training), None
 
         if c.remat:
-            body = jax.checkpoint(body)
+            if c.remat_policy == "dots":
+                body = jax.checkpoint(
+                    body, policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable)
+            else:
+                body = jax.checkpoint(body)
         layer_rngs = jax.random.split(base_rng, c.num_layers)
         x, _ = lax.scan(body, x, (p["blocks"], layer_rngs))
 
@@ -279,3 +296,20 @@ def build_lm(vocab_size: int = 256, dim: int = 128, num_heads: int = 4,
     return TransformerLM(TransformerConfig(
         vocab_size=vocab_size, dim=dim, num_heads=num_heads,
         num_layers=num_layers, max_len=max_len), **kw)
+
+
+def lm_train_matmul_flops_per_token(cfg: TransformerConfig,
+                                    ) -> float:
+    """Training (fwd+bwd = 3x fwd) matmul FLOPs per token — the
+    analytic model-flops count behind every LM MFU number (bench.py,
+    scripts/profile_lm.py). Remat recompute is NOT credited (standard
+    MFU convention).
+
+    Per layer fwd: qkv+o projections 4*2*e^2, mlp 2*2*e*4e -> 24*e^2;
+    attention scores+values 2*2*S*e (halved when causal);
+    head 2*e*V. Embedding gather is not a matmul (excluded).
+    """
+    e, L, S, V = cfg.dim, cfg.num_layers, cfg.max_len, cfg.vocab_size
+    per_layer = 24 * e * e + (2 * 2 * S * e) * (0.5 if cfg.causal else 1)
+    head = 2 * e * V
+    return 3 * (L * per_layer + head)

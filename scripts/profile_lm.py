@@ -35,16 +35,11 @@ PEAK_BF16 = 197e12  # TPU v5e (v5 lite) peak bf16 FLOP/s
 
 
 def lm_matmul_flops_per_token(cfg, vocab_tied=True):
-    """Training (fwd+bwd = 3x fwd) matmul FLOPs per token.
+    """See models/transformer.lm_train_matmul_flops_per_token — the
+    canonical analytic count (kept here as an alias for older tooling)."""
+    from bigdl_tpu.models.transformer import lm_train_matmul_flops_per_token
 
-    Per layer fwd: qkv+o 4*2*e^2, mlp 2*2*e*4e -> 24*e^2.
-    Attention scores+values fwd: 2*2*S*e, halved causal.
-    Head: 2*e*V.  Embedding gather is not a matmul (excluded).
-    """
-    e, L, S, V = cfg.dim, cfg.num_layers, cfg.max_len, cfg.vocab_size
-    per_layer = 24 * e * e + (2 * 2 * S * e) * (0.5 if cfg.causal else 1)
-    head = 2 * e * V
-    return 3 * (L * per_layer + head)
+    return lm_train_matmul_flops_per_token(cfg)
 
 
 def param_count(params):
@@ -114,6 +109,8 @@ def main():
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--trace", default=None, help="jax.profiler trace dir")
     ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--remat-policy", default="full",
+                    choices=["full", "dots"])
     ap.add_argument("--attn-impl", default=None,
                     choices=[None, "pallas", "reference", "xla"],
                     help="attention implementation for the in-model runs")
@@ -133,7 +130,8 @@ def main():
 
     cfg = TransformerConfig(
         vocab_size=args.vocab, max_len=args.seq, dim=args.dim,
-        num_heads=args.heads, num_layers=args.layers, remat=args.remat)
+        num_heads=args.heads, num_layers=args.layers, remat=args.remat,
+        remat_policy=args.remat_policy)
     model = TransformerLM(cfg, attn_impl=args.attn_impl)
     variables = model.init(jax.random.PRNGKey(0))
     params = variables["params"]
@@ -154,7 +152,8 @@ def main():
     report = {
         "config": {"dim": e, "layers": args.layers, "heads": H,
                    "vocab": args.vocab, "seq": S, "batch": B,
-                   "remat": args.remat, "loss": args.loss},
+                   "remat": args.remat, "remat_policy": args.remat_policy,
+                   "loss": args.loss},
         "n_params": n_params,
     }
     flops_tok = lm_matmul_flops_per_token(cfg)
