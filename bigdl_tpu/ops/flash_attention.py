@@ -14,9 +14,10 @@ Design
   max / running sum scratch implement the online (streaming) softmax, so
   HBM traffic is O(S·D) and nothing of size S×S ever materializes. QK^T
   and P·V both run on the MXU via `dot_general` with f32 accumulation.
-* Backward: blockwise `lax.scan` over KV blocks in plain XLA (recompute
-  from the saved log-sum-exp). Memory O(S·block_k) — long-context safe —
-  while XLA fuses the elementwise chain into the two matmuls per block.
+* Backward: two more Mosaic kernels — dq over a (bh, q, kv) grid and
+  dk/dv over a (bh, kv, q) grid — recomputing probabilities from the
+  saved log-sum-exp, VMEM accumulators, nothing S×S in HBM. (A
+  blockwise `lax.scan` XLA backward remains for impl="xla".)
 * The same math is exposed as `attention_reference` (jnp oracle for
   tests, CPU fallback), and `flash_attention_with_lse` returns the
   (out, lse) pair that the ring-attention combine consumes
@@ -220,6 +221,195 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k,
 
 
 # --------------------------------------------------------------------------
+# Pallas backward kernels (dq; dk/dv) — recompute-from-lse flash backward
+# --------------------------------------------------------------------------
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dq_scr, *, sm_scale, causal, block_q,
+                      block_k, seq_q, seq_k, num_kv):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, D)
+        k = k_ref[0].astype(jnp.float32)                     # (bk, D)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        row = q_start + lax.broadcasted_iota(jnp.int32,
+                                             (block_q, block_k), 0)
+        col = k_start + lax.broadcasted_iota(jnp.int32,
+                                             (block_q, block_k), 1)
+        mask = (col < seq_k) & (row < seq_q)
+        if causal:
+            mask = mask & (col <= row + (seq_k - seq_q))
+        lse = lse_ref[0, 0, pl.dslice(q_start, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.dslice(q_start, block_q)][:, None]
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)           # (bq, bk)
+        do = do_ref[0].astype(jnp.float32)                   # (bq, D)
+        dp = lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                             (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[:] = dq_scr[:] + lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(k_start <= q_start + block_q - 1 + (seq_k - seq_q))
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale,
+                       causal, block_q, block_k, seq_q, seq_k, num_q):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)                     # (bq, D)
+        k = k_ref[0].astype(jnp.float32)                     # (bk, D)
+        s = lax.dot_general(q * sm_scale, k,
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        row = q_start + lax.broadcasted_iota(jnp.int32,
+                                             (block_q, block_k), 0)
+        col = k_start + lax.broadcasted_iota(jnp.int32,
+                                             (block_q, block_k), 1)
+        # padded q rows MUST be masked here: dk/dv accumulate over rows
+        mask = (col < seq_k) & (row < seq_q)
+        if causal:
+            mask = mask & (col <= row + (seq_k - seq_q))
+        lse = lse_ref[0, 0, pl.dslice(q_start, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.dslice(q_start, block_q)][:, None]
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)           # (bq, bk)
+        do = do_ref[0].astype(jnp.float32)                   # (bq, D)
+        dv_scr[:] = dv_scr[:] + lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, D)
+        dp = lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                             (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        # dk = ds^T @ q_unscaled (q was NOT pre-scaled above)
+        dk_scr[:] = dk_scr[:] + lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # q blocks entirely above the diagonal contribute nothing
+        @pl.when(q_start + block_q - 1 + (seq_k - seq_q) >= k_start)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q,
+                      block_k, interpret):
+    """Flash backward as two Mosaic kernels: dq over a (bh, q, kv) grid,
+    dk/dv over a (bh, kv, q) grid, both recomputing probabilities from
+    the forward's log-sum-exp (nothing S×S in HBM)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, seq_q, dim = q.shape
+    seq_k = k.shape[1]
+
+    qp = _pad_to(_pad_to(q, 1, block_q), 2, 128)
+    dop = _pad_to(_pad_to(do, 1, block_q), 2, 128)
+    kp = _pad_to(_pad_to(k, 1, block_k), 2, 128)
+    vp = _pad_to(_pad_to(v, 1, block_k), 2, 128)
+    sq, dp_ = qp.shape[1], qp.shape[2]
+    sk = kp.shape[1]
+    num_q, num_kv = sq // block_q, sk // block_k
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                 # (BH, Sq)
+    # (BH, 1, sq): Mosaic wants the last two block dims (8,128)-tileable
+    # OR equal to the array dims — (1, sq) matches exactly
+    lse_p = _pad_to(lse.astype(jnp.float32), 1, block_q)[:, None, :]
+    delta_p = _pad_to(delta, 1, block_q)[:, None, :]
+
+    row_specs = [
+        pl.BlockSpec((1, block_q, dp_), lambda b, i, j: (b, i, 0)),   # q
+        pl.BlockSpec((1, block_k, dp_), lambda b, i, j: (b, j, 0)),   # k
+        pl.BlockSpec((1, block_k, dp_), lambda b, i, j: (b, j, 0)),   # v
+        pl.BlockSpec((1, block_q, dp_), lambda b, i, j: (b, i, 0)),   # do
+        pl.BlockSpec((1, 1, sq), lambda b, i, j: (b, 0, 0)),          # lse
+        pl.BlockSpec((1, 1, sq), lambda b, i, j: (b, 0, 0)),          # delta
+    ]
+    dq_p = pl.pallas_call(
+        functools.partial(
+            _fa_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_q=seq_q, seq_k=seq_k,
+            num_kv=num_kv),
+        grid=(bh, num_q, num_kv),
+        in_specs=row_specs,
+        out_specs=pl.BlockSpec((1, block_q, dp_), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, dp_), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, dp_), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse_p, delta_p)
+
+    col_specs = [
+        pl.BlockSpec((1, block_q, dp_), lambda b, j, i: (b, i, 0)),   # q
+        pl.BlockSpec((1, block_k, dp_), lambda b, j, i: (b, j, 0)),   # k
+        pl.BlockSpec((1, block_k, dp_), lambda b, j, i: (b, j, 0)),   # v
+        pl.BlockSpec((1, block_q, dp_), lambda b, j, i: (b, i, 0)),   # do
+        pl.BlockSpec((1, 1, sq), lambda b, j, i: (b, 0, 0)),          # lse
+        pl.BlockSpec((1, 1, sq), lambda b, j, i: (b, 0, 0)),          # delta
+    ]
+    dk_p, dv_p = pl.pallas_call(
+        functools.partial(
+            _fa_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_q=seq_q, seq_k=seq_k,
+            num_q=num_q),
+        grid=(bh, num_kv, num_q),
+        in_specs=col_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, dp_), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dp_), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, dp_), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, dp_), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, dp_), jnp.float32),
+                        pltpu.VMEM((block_k, dp_), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse_p, delta_p)
+
+    return (dq_p[:, :seq_q, :dim], dk_p[:, :seq_k, :dim],
+            dv_p[:, :seq_k, :dim])
+
+
+# --------------------------------------------------------------------------
 # Blockwise XLA forward (online softmax, no S×S) — impl="xla"
 # --------------------------------------------------------------------------
 
@@ -228,11 +418,11 @@ def _flash_fwd_xla(q, k, v, causal, sm_scale, block_k):
 
     Same online-softmax recurrence as the Pallas kernel, but expressed
     as jnp ops so XLA fuses the elementwise chain into the two matmuls
-    per block. Memory O(S·block_k). On this TPU (through the remote
-    tunnel) the XLA lowering of the blockwise recurrence measured
-    FASTER than the hand-written Mosaic kernel (scripts/profile_lm.py,
-    round 2) — kept as the default; the Pallas kernel remains for
-    comparison and as the base for further Mosaic tuning.
+    per block. Memory O(S·block_k). This was the round-2 TPU default;
+    since the Mosaic kernels were retuned (512x512 tiles) and gained a
+    Mosaic backward it loses at every measured shape
+    (PROFILE_r03/ANALYSIS.md) and remains as impl='xla' for comparison
+    and as a fallback.
     """
     bh, seq_q, dim = q.shape
     seq_k = k.shape[1]
@@ -360,6 +550,11 @@ def _flash_core_fwd(q, k, v, causal, sm_scale, block_q, block_k,
 def _flash_core_bwd(causal, sm_scale, block_q, block_k, bwd_block_k, impl,
                     res, do):
     q, k, v, out, lse = res
+    if impl in ("pallas", "interpret"):
+        # Mosaic backward (dq kernel + dk/dv kernel), same tiles as fwd
+        return _flash_bwd_pallas(q, k, v, out, lse, do, causal, sm_scale,
+                                 block_q, block_k,
+                                 interpret=(impl == "interpret"))
     return _flash_bwd_blockwise(q, k, v, out, lse, do, causal, sm_scale,
                                 bwd_block_k)
 
@@ -375,17 +570,17 @@ def _clamp_block(block: int, seq: int) -> int:
 
 def _resolve_impl_and_blocks(q, k, block_q, block_k, impl):
     """Shared default resolution for both public entry points: pick the
-    impl from the B*H crossover, then per-impl default tiles (Mosaic
-    wants 512x512, the XLA scan wants 128), clamped to the sequences."""
-    bh = q.shape[0] * q.shape[1] if q.ndim == 4 else q.shape[0]
-    impl = impl or _default_impl(bh)
+    impl (Mosaic kernels on TPU, reference elsewhere), then per-impl
+    default tiles (Mosaic wants 512x512, the XLA scan wants 128),
+    clamped to the sequences."""
+    impl = impl or _default_impl()
     big = impl in ("pallas", "interpret")
     block_q = _clamp_block(block_q or (512 if big else 128), q.shape[-2])
     block_k = _clamp_block(block_k or (512 if big else 128), k.shape[-2])
     return impl, block_q, block_k
 
 
-def _default_impl(bh: int = 128) -> str:
+def _default_impl() -> str:
     try:
         platform = jax.devices()[0].platform
     except Exception:  # pragma: no cover - backend init failure
@@ -393,14 +588,13 @@ def _default_impl(bh: int = 128) -> str:
     if platform != "tpu":
         return "reference"
     # Round-3 full-step measurements on the real chip (S=2048, D=64,
-    # remat, fused loss, tokens/sec): at B*H=128 the tuned Mosaic kernel
-    # wins decisively (36.4k vs 27.5k for the round-2 blockwise-scan
-    # default — bigger 512x512 blocks amortize grid overhead and feed
-    # the MXU 512-row tiles; jax's library pallas flash measured 13.2ms
-    # vs ours 6.2ms per layer fwd). At B*H=64 the grid has too few
-    # cells to hide the kernel's serial kv loop and the XLA scan is
-    # ~8% faster end-to-end (139.1k vs 128.3k) — measured crossover.
-    return "pallas" if bh >= 96 else "xla"
+    # remat, fused loss): with both the forward kernel (512x512 tiles)
+    # AND the Mosaic backward (dq + dk/dv kernels), pallas wins at every
+    # measured shape — 48.9k vs 27.5k tok/s at 186M (B*H=128) and
+    # 150.7k vs 139.1k at 43M (B*H=64) against the round-2
+    # blockwise-XLA-scan default. (Fwd-kernel-only, the 43M shape
+    # preferred the scan — the Mosaic backward is what tipped it.)
+    return "pallas"
 
 
 def flash_attention(
@@ -416,17 +610,19 @@ def flash_attention(
 ) -> jax.Array:
     """Memory-efficient attention. q,k,v: (B, H, S, D) or (BH, S, D).
 
-    impl: None → auto ('pallas' on TPU for B*H >= 96 — the tuned Mosaic
-    kernel; 'xla' below that; 'reference' off-TPU); explicit choices:
-    'xla' | 'pallas' | 'interpret' (Pallas interpreter mode, for CPU
-    tests) | 'reference'.
+    impl: None → auto ('pallas' on TPU — Mosaic forward AND backward
+    kernels, fastest at every measured shape; 'reference' off-TPU);
+    explicit choices: 'xla' (blockwise-scan fwd + scan bwd) | 'pallas'
+    | 'interpret' (Pallas interpreter mode, for CPU tests) |
+    'reference'.
 
     Block sizes default per impl from the round-3 measurements: the
-    Mosaic kernel wants LARGE tiles (512x512 — grid overhead amortized,
+    Mosaic kernels want LARGE tiles (512x512 — grid overhead amortized,
     MXU fed 512-row tiles), the XLA scan wants SMALL kv blocks (128 —
-    its per-block elementwise chain stays cache-resident); the blockwise
-    backward runs at 128 either way. All are clamped to the sequence
-    lengths, so short sequences run a single-tile kernel.
+    its per-block elementwise chain stays cache-resident).
+    `bwd_block_k` applies only to the impl='xla' scan backward. All are
+    clamped to the sequence lengths, so short sequences run a
+    single-tile kernel.
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
