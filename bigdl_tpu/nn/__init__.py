@@ -60,7 +60,10 @@ from bigdl_tpu.nn.attention import MultiHeadAttention
 from bigdl_tpu.nn.quantized import (
     QuantizedLinear, QuantizedSpatialConvolution, quantize,
 )
-from bigdl_tpu.nn.sparse import LookupTableSparse, SparseLinear, encode_sparse
+from bigdl_tpu.nn.sparse import (
+    LookupTableSparse, SparseJoinTable, SparseLinear, SparseTensor,
+    addmm, addmv, encode_sparse,
+)
 from bigdl_tpu.nn.criterion import (
     ClassNLLCriterion, CrossEntropyCriterion, MSECriterion, AbsCriterion,
     BCECriterion, SmoothL1Criterion, MarginCriterion, MultiLabelMarginCriterion,

@@ -104,3 +104,161 @@ class LookupTableSparse(Module):
                                      keepdims=True))
             out = out / jnp.maximum(w, 1e-8)
         return out, variables["state"]
+
+
+@jax.tree_util.register_pytree_node_class
+class SparseTensor:
+    """General fixed-capacity COO sparse matrix with math ops.
+
+    Reference parity: tensor/SparseTensor.scala + SparseTensorMath.scala
+    + SparseTensorBLAS.scala (SURVEY.md §2.1 "Sparse tensor"). The
+    reference keeps CSR storage and hand-written BLAS; XLA wants static
+    shapes and compiles gather/scatter-add natively, so this is COO with
+    a STATIC nnz capacity (padded entries carry value 0.0 at index
+    (0, ..., 0) and contribute nothing to any op below). Registered as
+    a pytree, so SparseTensors flow through jit/vmap. For grad,
+    differentiate with respect to the float `values` leaf (rebuild via
+    `with_values`) or close over the SparseTensor — grad with a whole
+    SparseTensor argument fails on the int32 indices leaf, as with any
+    pytree carrying integer leaves.
+
+    indices: (nnz, ndim) int32; values: (nnz,) float; shape: static.
+    """
+
+    def __init__(self, indices, values, shape):
+        self.indices = jnp.asarray(indices, jnp.int32)
+        self.values = jnp.asarray(values)
+        self.shape = tuple(int(s) for s in shape)
+
+    # ------------------------------------------------------------ pytree
+    def tree_flatten(self):
+        return (self.indices, self.values), self.shape
+
+    @classmethod
+    def tree_unflatten(cls, shape, leaves):
+        return cls(leaves[0], leaves[1], shape)
+
+    # ------------------------------------------------------- construction
+    @staticmethod
+    def from_dense(x, capacity: Optional[int] = None) -> "SparseTensor":
+        """Host-side (not jittable): COO of the nonzeros of `x`."""
+        x = np.asarray(x)
+        coords = np.argwhere(x != 0)
+        vals = x[tuple(coords.T)]
+        nnz = len(vals)
+        capacity = capacity or max(nnz, 1)
+        if nnz > capacity:
+            raise ValueError(f"{nnz} nonzeros > capacity {capacity}")
+        idx = np.zeros((capacity, x.ndim), np.int32)
+        val = np.zeros((capacity,), x.dtype)
+        idx[:nnz] = coords
+        val[:nnz] = vals
+        return SparseTensor(idx, val, x.shape)
+
+    @property
+    def nnz_capacity(self) -> int:
+        return self.values.shape[0]
+
+    def with_values(self, values) -> "SparseTensor":
+        """Same sparsity pattern, new values — the differentiable leaf
+        (grad wrt `values` through with_values + any op works)."""
+        return SparseTensor(self.indices, values, self.shape)
+
+    # --------------------------------------------------------------- ops
+    def to_dense(self) -> jax.Array:
+        out = jnp.zeros(self.shape, self.values.dtype)
+        return out.at[tuple(self.indices.T)].add(self.values)
+
+    def transpose(self) -> "SparseTensor":
+        if len(self.shape) != 2:
+            raise ValueError("transpose needs a 2-D SparseTensor")
+        return SparseTensor(self.indices[:, ::-1], self.values,
+                            self.shape[::-1])
+
+    def scale(self, alpha) -> "SparseTensor":
+        return SparseTensor(self.indices, self.values * alpha, self.shape)
+
+    def add(self, other: "SparseTensor") -> "SparseTensor":
+        """Union of nonzeros (duplicate coordinates are kept — every op
+        here sums duplicates, matching scatter-add semantics)."""
+        if self.shape != other.shape:
+            raise ValueError(f"shape mismatch {self.shape} {other.shape}")
+        return SparseTensor(
+            jnp.concatenate([self.indices, other.indices]),
+            jnp.concatenate([self.values, other.values]), self.shape)
+
+    def mul_dense(self, dense) -> "SparseTensor":
+        """Elementwise sparse * dense (result keeps this sparsity)."""
+        picked = dense[tuple(self.indices.T)]
+        return SparseTensor(self.indices, self.values * picked, self.shape)
+
+    def mm(self, dense) -> jax.Array:
+        """sparse (M, N) @ dense (N, K) -> dense (M, K): one gather +
+        one scatter-add, both native XLA (reference:
+        SparseTensorBLAS.coomm)."""
+        if len(self.shape) != 2:
+            raise ValueError("mm needs a 2-D SparseTensor")
+        rows, cols = self.indices[:, 0], self.indices[:, 1]
+        contrib = self.values[:, None] * dense[cols]        # (nnz, K)
+        out = jnp.zeros((self.shape[0], dense.shape[1]), contrib.dtype)
+        return out.at[rows].add(contrib)
+
+    def __matmul__(self, dense) -> jax.Array:
+        return self.mm(dense)
+
+    def mv(self, vec) -> jax.Array:
+        """sparse (M, N) @ vec (N,) -> (M,)."""
+        return self.mm(vec[:, None])[:, 0]
+
+    def dot(self, dense) -> jax.Array:
+        """<sparse, dense> inner product over all elements."""
+        return jnp.sum(self.values * dense[tuple(self.indices.T)])
+
+    def __repr__(self):
+        return (f"SparseTensor(shape={self.shape}, "
+                f"nnz_capacity={self.nnz_capacity})")
+
+
+def addmm(beta, c, alpha, sparse: SparseTensor, dense) -> jax.Array:
+    """beta*C + alpha*(sparse @ dense) (reference:
+    SparseTensorMath.addmm)."""
+    return beta * c + alpha * sparse.mm(dense)
+
+
+def addmv(beta, y, alpha, sparse: SparseTensor, vec) -> jax.Array:
+    """beta*y + alpha*(sparse @ vec) (reference:
+    SparseTensorMath.addmv)."""
+    return beta * y + alpha * sparse.mv(vec)
+
+
+class SparseJoinTable(Module):
+    """Join batch-COO inputs along the feature dimension (reference:
+    nn/SparseJoinTable.scala — concatenates SparseTensors on dim 2).
+
+    Input: a sequence of (indices (B, Ki), values (B, Ki)) pairs, each
+    with a static `input_size`; output: one (B, sum Ki) pair whose
+    column ids are offset by the sizes of the preceding inputs — the
+    encoding SparseLinear/LookupTableSparse consume.
+    """
+
+    def __init__(self, input_sizes: Sequence[int],
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.input_sizes = [int(s) for s in input_sizes]
+
+    def apply(self, variables, *inputs, training=False, rng=None):
+        if len(inputs) == 1 and isinstance(inputs[0], (tuple, list)) \
+                and not hasattr(inputs[0][0], "ndim"):
+            inputs = tuple(inputs[0])
+        if len(inputs) != len(self.input_sizes):
+            raise ValueError(
+                f"SparseJoinTable: got {len(inputs)} inputs for "
+                f"{len(self.input_sizes)} input_sizes")
+        offset = 0
+        idx_parts, val_parts = [], []
+        for (indices, values), size in zip(inputs, self.input_sizes):
+            idx_parts.append(indices + offset)
+            val_parts.append(values)
+            offset += size
+        return (jnp.concatenate(idx_parts, axis=1),
+                jnp.concatenate(val_parts, axis=1)), variables["state"]

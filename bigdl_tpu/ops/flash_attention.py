@@ -224,6 +224,35 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k,
 # Pallas backward kernels (dq; dk/dv) — recompute-from-lse flash backward
 # --------------------------------------------------------------------------
 
+def _bwd_recompute(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   q_start, k_start, sm_scale, causal, block_q, block_k,
+                   seq_q, seq_k):
+    """The shared dq/dkv recompute chain: (q, k, do, p, ds) for one
+    (q_block, kv_block) tile — p from the saved lse, ds from delta.
+    `q` comes back UNSCALED (dk needs it that way)."""
+    q = q_ref[0].astype(jnp.float32)                         # (bq, D)
+    k = k_ref[0].astype(jnp.float32)                         # (bk, D)
+    s = lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    row = q_start + lax.broadcasted_iota(jnp.int32,
+                                         (block_q, block_k), 0)
+    col = k_start + lax.broadcasted_iota(jnp.int32,
+                                         (block_q, block_k), 1)
+    # padded q rows must contribute nothing (dk/dv accumulate over rows)
+    mask = (col < seq_k) & (row < seq_q)
+    if causal:
+        mask = mask & (col <= row + (seq_k - seq_q))
+    lse = lse_ref[0, 0, pl.dslice(q_start, block_q)][:, None]
+    delta = delta_ref[0, 0, pl.dslice(q_start, block_q)][:, None]
+    p = jnp.where(mask, jnp.exp(s - lse), 0.0)               # (bq, bk)
+    do = do_ref[0].astype(jnp.float32)                       # (bq, D)
+    dp = lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                         (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * sm_scale
+    return q, k, do, p, ds
+
+
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       dq_ref, dq_scr, *, sm_scale, causal, block_q,
                       block_k, seq_q, seq_k, num_kv):
@@ -238,25 +267,9 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k_start = ki * block_k
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, D)
-        k = k_ref[0].astype(jnp.float32)                     # (bk, D)
-        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-        row = q_start + lax.broadcasted_iota(jnp.int32,
-                                             (block_q, block_k), 0)
-        col = k_start + lax.broadcasted_iota(jnp.int32,
-                                             (block_q, block_k), 1)
-        mask = (col < seq_k) & (row < seq_q)
-        if causal:
-            mask = mask & (col <= row + (seq_k - seq_q))
-        lse = lse_ref[0, 0, pl.dslice(q_start, block_q)][:, None]
-        delta = delta_ref[0, 0, pl.dslice(q_start, block_q)][:, None]
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)           # (bq, bk)
-        do = do_ref[0].astype(jnp.float32)                   # (bq, D)
-        dp = lax.dot_general(do, v_ref[0].astype(jnp.float32),
-                             (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
+        _, k, _, _, ds = _bwd_recompute(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_start,
+            k_start, sm_scale, causal, block_q, block_k, seq_q, seq_k)
         dq_scr[:] = dq_scr[:] + lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -288,31 +301,13 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k_start = ki * block_k
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)                     # (bq, D)
-        k = k_ref[0].astype(jnp.float32)                     # (bk, D)
-        s = lax.dot_general(q * sm_scale, k,
-                            (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-        row = q_start + lax.broadcasted_iota(jnp.int32,
-                                             (block_q, block_k), 0)
-        col = k_start + lax.broadcasted_iota(jnp.int32,
-                                             (block_q, block_k), 1)
-        # padded q rows MUST be masked here: dk/dv accumulate over rows
-        mask = (col < seq_k) & (row < seq_q)
-        if causal:
-            mask = mask & (col <= row + (seq_k - seq_q))
-        lse = lse_ref[0, 0, pl.dslice(q_start, block_q)][:, None]
-        delta = delta_ref[0, 0, pl.dslice(q_start, block_q)][:, None]
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)           # (bq, bk)
-        do = do_ref[0].astype(jnp.float32)                   # (bq, D)
+        q, _, do, p, ds = _bwd_recompute(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_start,
+            k_start, sm_scale, causal, block_q, block_k, seq_q, seq_k)
         dv_scr[:] = dv_scr[:] + lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)              # (bk, D)
-        dp = lax.dot_general(do, v_ref[0].astype(jnp.float32),
-                             (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * sm_scale
-        # dk = ds^T @ q_unscaled (q was NOT pre-scaled above)
+        # dk = ds^T @ q_unscaled
         dk_scr[:] = dk_scr[:] + lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -653,13 +648,18 @@ def flash_attention_with_lse(
     block_k: Optional[int] = None,
     impl: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """(out, lse) for one KV chunk — the ring-attention building block.
+    """(out, lse) for one KV chunk — a building block for callers that
+    combine partial attention results themselves (online-softmax style).
 
-    Not wrapped in the custom VJP: ring attention differentiates its own
-    combined result, recomputing per-chunk attention in its backward.
+    Not wrapped in the custom VJP, so the DEFAULT impl here is the
+    AD-able 'xla' blockwise scan on TPU (the raw Mosaic kernel has no
+    differentiation rule — pass impl='pallas' explicitly for a
+    forward-only kernel call).
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    if impl is None:
+        impl = "xla" if _default_impl() == "pallas" else _default_impl()
     impl, block_q, block_k = _resolve_impl_and_blocks(
         q, k, block_q, block_k, impl)
     return _forward(q, k, v, causal, float(sm_scale), block_q, block_k,

@@ -285,6 +285,11 @@ def _canonical_graph():
 
 CANON["Graph"] = (_canonical_graph, (x2,))
 
+CANON["SparseJoinTable"] = (
+    lambda: nn.SparseJoinTable([8, 8]),  # _sp_idx ids are < 8
+    ((jnp.asarray(_sp_idx), jnp.asarray(_sp_val)),
+     (jnp.asarray(_sp_idx), jnp.asarray(_sp_val))))
+
 # classes that legitimately cannot auto-construct: name -> reason
 SKIP = {}
 

@@ -4,6 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu import nn
 from bigdl_tpu.nn.sparse import LookupTableSparse, SparseLinear, encode_sparse
 
 
@@ -71,3 +72,125 @@ def test_sparse_embedding_grad_is_scatter_add():
     # duplicate contributions accumulate
     np.testing.assert_allclose(np.asarray(g)[1], 2.0 * np.ones(4), atol=1e-6)
     assert float(np.abs(np.asarray(g)[0]).sum()) == 0.0
+
+
+class TestSparseTensorMath:
+    """General sparse math (reference: tensor/SparseTensorMath.scala,
+    SparseTensorBLAS.scala) — oracled against dense jnp."""
+
+    def _rand_sparse(self, m, n, density=0.3, seed=0, capacity=None):
+        rng = np.random.RandomState(seed)
+        dense = rng.randn(m, n) * (rng.rand(m, n) < density)
+        return nn.SparseTensor.from_dense(
+            dense.astype(np.float32), capacity), dense.astype(np.float32)
+
+    def test_from_to_dense_roundtrip(self):
+        sp, dense = self._rand_sparse(5, 7)
+        np.testing.assert_array_equal(np.asarray(sp.to_dense()), dense)
+        # padded capacity: extra zero entries contribute nothing
+        sp2 = nn.SparseTensor.from_dense(dense, capacity=64)
+        np.testing.assert_array_equal(np.asarray(sp2.to_dense()), dense)
+
+    def test_mm_mv_dot_against_dense(self):
+        sp, dense = self._rand_sparse(6, 8, seed=1, capacity=32)
+        rng = np.random.RandomState(2)
+        b = rng.randn(8, 4).astype(np.float32)
+        v = rng.randn(8).astype(np.float32)
+        other = rng.randn(6, 8).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(sp.mm(b)), dense @ b,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(sp @ b), dense @ b,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(sp.mv(v)), dense @ v,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(float(sp.dot(jnp.asarray(other))),
+                                   float((dense * other).sum()),
+                                   rtol=1e-5)
+
+    def test_addmm_addmv(self):
+        sp, dense = self._rand_sparse(4, 6, seed=3)
+        rng = np.random.RandomState(4)
+        b = rng.randn(6, 3).astype(np.float32)
+        c = rng.randn(4, 3).astype(np.float32)
+        y = rng.randn(4).astype(np.float32)
+        v = rng.randn(6).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(nn.addmm(0.5, c, 2.0, sp, b)),
+            0.5 * c + 2.0 * (dense @ b), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(nn.addmv(0.25, y, 3.0, sp, v)),
+            0.25 * y + 3.0 * (dense @ v), rtol=1e-5, atol=1e-6)
+
+    def test_transpose_add_scale_mul(self):
+        sp, dense = self._rand_sparse(5, 4, seed=5)
+        sp2, dense2 = self._rand_sparse(5, 4, seed=6)
+        np.testing.assert_array_equal(
+            np.asarray(sp.transpose().to_dense()), dense.T)
+        np.testing.assert_allclose(
+            np.asarray(sp.add(sp2).to_dense()), dense + dense2,
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sp.scale(2.5).to_dense()), dense * 2.5, rtol=1e-6)
+        other = np.random.RandomState(7).randn(5, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(sp.mul_dense(jnp.asarray(other)).to_dense()),
+            dense * other, rtol=1e-5, atol=1e-6)
+
+    def test_jit_and_grad_through_sparse(self):
+        """SparseTensor is a pytree: passes through jit, and grad wrt
+        the dense operand of mm matches the dense formulation."""
+        sp, dense = self._rand_sparse(6, 8, seed=8)
+        b0 = np.random.RandomState(9).randn(8, 4).astype(np.float32)
+
+        @jax.jit
+        def f(s, b):
+            return jnp.sum(s.mm(b) ** 2)
+
+        g = jax.grad(lambda b: f(sp, b))(jnp.asarray(b0))
+        want = jax.grad(lambda b: jnp.sum((jnp.asarray(dense) @ b) ** 2))(
+            jnp.asarray(b0))
+        np.testing.assert_allclose(np.asarray(g), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_sparse_join_table(self):
+        """SparseJoinTable concatenates batch-COO features with column
+        offsets; feeding the join into SparseLinear equals summing two
+        SparseLinears over the concatenated weight."""
+        idx1, val1 = nn.encode_sparse([([0, 2], [1.0, 2.0]),
+                                       ([1], [3.0])])
+        idx2, val2 = nn.encode_sparse([([1], [4.0]),
+                                       ([0, 3], [5.0, 6.0])])
+        join = nn.SparseJoinTable([4, 5]).build(jax.random.PRNGKey(0))
+        (jidx, jval), _ = join.apply(join.variables,
+                                     (jnp.asarray(idx1), jnp.asarray(val1)),
+                                     (jnp.asarray(idx2), jnp.asarray(val2)))
+        assert jidx.shape == (2, 4) and jval.shape == (2, 4)
+        lin = nn.SparseLinear(9, 3).build(jax.random.PRNGKey(1))
+        out, _ = lin.apply(lin.variables, (jidx, jval))
+        # dense oracle
+        d1 = np.zeros((2, 4), np.float32)
+        d1[0, 0], d1[0, 2], d1[1, 1] = 1.0, 2.0, 3.0
+        d2 = np.zeros((2, 5), np.float32)
+        d2[0, 1], d2[1, 0], d2[1, 3] = 4.0, 5.0, 6.0
+        full = np.concatenate([d1, d2], axis=1)
+        w = np.asarray(lin.variables["params"]["weight"])
+        b = np.asarray(lin.variables["params"]["bias"])
+        np.testing.assert_allclose(np.asarray(out), full @ w + b,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_grad_wrt_values_via_with_values(self):
+        """The documented differentiation pattern: grad wrt the float
+        values leaf through with_values + mm."""
+        sp, dense = self._rand_sparse(4, 6, seed=10)
+        b = jnp.asarray(np.random.RandomState(11).randn(6, 2), jnp.float32)
+
+        def f(vals):
+            return jnp.sum(sp.with_values(vals).mm(b) ** 2)
+
+        g = jax.grad(f)(sp.values)
+        # oracle: d/dvals sum((sum_nnz vals_i e_i @ b)^2)
+        rows, cols = np.asarray(sp.indices).T
+        out = np.asarray(sp.mm(b))
+        want = 2.0 * np.einsum("nk->n", out[rows] * np.asarray(b)[cols])
+        np.testing.assert_allclose(np.asarray(g), want, rtol=1e-4,
+                                   atol=1e-5)
