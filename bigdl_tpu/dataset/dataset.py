@@ -27,8 +27,10 @@ class AbstractDataSet:
     def size(self) -> int:
         raise NotImplementedError
 
-    def shuffle(self) -> None:
-        pass
+    # NOTE: no shuffle() method — the reference's shuffle-before-epoch
+    # contract is inherent in data(train=True), which derives each
+    # epoch's permutation from (seed, epoch) statelessly so checkpoint
+    # resume can replay the schedule exactly.
 
     def transform(self, transformer: Transformer) -> "TransformedDataSet":
         """Attach a transformer chain (the reference's `dataset -> transformer`)."""
@@ -47,23 +49,29 @@ class LocalDataSet(AbstractDataSet):
 
     def __init__(self, elements: Sequence, seed: int = 1):
         self.elements = list(elements)
-        self._rng = np.random.RandomState(seed)
-        self._perm = np.arange(len(self.elements))
+        self.seed = seed
 
     def size(self) -> int:
         return len(self.elements)
-
-    def shuffle(self) -> None:
-        self._rng.shuffle(self._perm)
 
     def data(self, train: bool) -> Iterator:
         if not train:
             yield from self.elements
             return
+        # Stateless replay: every data(train=True) call restarts the
+        # identical epoch sequence — each epoch's permutation is derived
+        # from (seed, epoch) with an iterator-local epoch counter, never
+        # from instance state. This is what makes checkpoint resume's
+        # fast-forward (skip=neval batches) land on the same data even
+        # after a previous iterator already consumed epochs in-process
+        # (DistriOptimizer retry path).
+        epoch = 0
         while True:
-            self.shuffle()
-            for i in self._perm:
+            perm = np.random.RandomState(
+                self.seed + epoch).permutation(len(self.elements))
+            for i in perm:
                 yield self.elements[i]
+            epoch += 1
 
 
 class ShardedDataSet(AbstractDataSet):
@@ -85,7 +93,6 @@ class ShardedDataSet(AbstractDataSet):
         self.pid = jax.process_index() if process_id is None else process_id
         self.nproc = jax.process_count() if process_count is None else process_count
         self.seed = seed
-        self.epoch = 0
 
     def size(self) -> int:
         # per-shard size (the reference reports partition-local counts too)
@@ -99,14 +106,19 @@ class ShardedDataSet(AbstractDataSet):
             for i in range(self.pid, len(self.elements), self.nproc):
                 yield self.elements[i]
             return
+        # iterator-local epoch: every data(train=True) call replays the
+        # identical schedule (same rationale as LocalDataSet.data) — and
+        # the permutation stays host-independent, so hosts remain in
+        # lockstep after any host's in-process retry.
+        epoch = 0
         while True:
             # same permutation on every host: seed ⊕ epoch
-            perm = np.random.RandomState(self.seed + self.epoch).permutation(
+            perm = np.random.RandomState(self.seed + epoch).permutation(
                 len(self.elements))
             shard = perm[self.pid::self.nproc]
             for i in shard:
                 yield self.elements[i]
-            self.epoch += 1
+            epoch += 1
 
 
 class TransformedDataSet(AbstractDataSet):
@@ -118,9 +130,6 @@ class TransformedDataSet(AbstractDataSet):
 
     def size(self) -> int:
         return self.base.size()
-
-    def shuffle(self) -> None:
-        self.base.shuffle()
 
     def transform(self, transformer: Transformer) -> "TransformedDataSet":
         from bigdl_tpu.dataset.transformer import ChainedTransformer

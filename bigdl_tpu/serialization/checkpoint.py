@@ -141,32 +141,46 @@ class Checkpoint:
         (reference divergence: the reference has no grad-accum at all;
         this keeps resume bit-for-bit faithful)."""
         import jax
+        import shutil
 
         d = os.path.join(self.path, f"checkpoint-{step}")
-        # multi-host: the training plane is replicated (callers gather
-        # sharded state first), so process 0 writes for everyone — the
-        # reference's driver-writes-checkpoint layout (SURVEY.md §5.4)
-        save_pytree(d, self.MODEL, model_variables,
-                    metadata={"train_state": train_state or {}},
-                    only_host0=True)
-        save_pytree(d, self.OPTIM, optim_state, metadata=optim_meta,
-                    only_host0=True)
+        if jax.process_index() != 0:
+            # multi-host: the training plane is replicated (callers
+            # gather sharded state first), so process 0 writes for
+            # everyone — the reference's driver-writes-checkpoint
+            # layout (SURVEY.md §5.4)
+            return d
+        # Atomic publish: write everything into a .inprogress staging
+        # dir, then rename over the final name. A crash at ANY point
+        # leaves either the previous complete checkpoint untouched or
+        # an .inprogress dir that latest() never matches — there is no
+        # window where a reused checkpoint-{step} presents mixed
+        # old/new content or where the newest checkpoint is unloadable
+        # mid-overwrite (ADVICE r3 / review r4).
+        tmp = d + ".inprogress"
+        old = d + ".old"
+        for leftover in (tmp, old):
+            if os.path.isdir(leftover):
+                shutil.rmtree(leftover)
+        save_pytree(tmp, self.MODEL, model_variables,
+                    metadata={"train_state": train_state or {}})
+        save_pytree(tmp, self.OPTIM, optim_state, metadata=optim_meta)
         if accum_state is not None:
-            save_pytree(d, self.ACCUM, accum_state, only_host0=True)
-        elif jax.process_index() == 0:
-            # a reused checkpoint-{step} dir may hold another run's
-            # mid-cycle sidecar; loading it would install foreign
-            # gradients — remove it
-            for ext in (".json", ".npz"):
-                p = os.path.join(d, self.ACCUM + ext)
-                if os.path.exists(p):
-                    os.remove(p)
-        if jax.process_index() == 0:
-            # completion marker written LAST: latest() skips dirs still
-            # being written (another host's failure recovery must never
-            # load a truncated checkpoint)
-            with open(os.path.join(d, self.MARKER), "w") as f:
-                f.write("complete")
+            save_pytree(tmp, self.ACCUM, accum_state)
+        # completion marker still written (helps tooling; load-bearing
+        # only for checkpoints from pre-rename versions of this code)
+        with open(os.path.join(tmp, self.MARKER), "w") as f:
+            f.write("complete")
+        # swap via atomic renames only: the reused dir moves aside in
+        # one rename (never half-deleted in place), the staging dir
+        # takes its name in another, and only then is the old content
+        # deleted — latest()'s checkpoint-(\d+) fullmatch ignores both
+        # .inprogress and .old at every intermediate point
+        if os.path.isdir(d):
+            os.rename(d, old)
+        os.rename(tmp, d)
+        if os.path.isdir(old):
+            shutil.rmtree(old)
         return d
 
     def load_accum(self, directory: Optional[str] = None):
@@ -179,7 +193,14 @@ class Checkpoint:
         tree, _ = load_pytree(d, self.ACCUM)
         return tree
 
-    def latest(self) -> Optional[str]:
+    def latest(self, allow_unmarked: bool = True) -> Optional[str]:
+        """Newest complete checkpoint dir. Dirs written by this version
+        are published atomically (staging + rename) and always carry
+        the COMPLETE marker; the marker-less both-manifests fallback
+        (default on) exists for checkpoints from pre-marker versions,
+        whose write order — npz before json, model before optim —
+        makes both-manifests-present imply a finished write. Pass
+        `allow_unmarked=False` to trust only marked dirs."""
         if not os.path.isdir(self.path):
             return None
         best, best_step = None, -1
@@ -189,8 +210,8 @@ class Checkpoint:
                 continue
             d = os.path.join(self.path, entry)
             complete = os.path.exists(os.path.join(d, self.MARKER)) or (
-                # pre-marker checkpoints: both manifests present
-                os.path.exists(os.path.join(d, f"{self.OPTIM}.json"))
+                allow_unmarked
+                and os.path.exists(os.path.join(d, f"{self.OPTIM}.json"))
                 and os.path.exists(os.path.join(d, f"{self.MODEL}.json")))
             if complete:
                 best, best_step = entry, int(m.group(1))

@@ -86,7 +86,13 @@ class _Reader:
 
     def read_string(self) -> str:
         n = self.read_int()
-        return self.f.read(n).decode("utf-8", errors="replace")
+        raw = self.f.read(n)
+        # Lua strings are byte strings: binary payloads are legal.
+        # surrogateescape maps undecodable bytes to lone surrogates
+        # that write_string encodes back to the exact original bytes —
+        # load/save round-trips are lossless and valid UTF-8 is
+        # unaffected (the writer mirrors this; see write_string).
+        return raw.decode("utf-8", errors="surrogateescape")
 
     def read_object(self) -> Any:
         tag = self.read_int()
@@ -180,8 +186,12 @@ class _Writer:
     def write_double(self, v: float):
         self.f.write(struct.pack("<d", v))
 
-    def write_string(self, s: str):
-        raw = s.encode("utf-8")
+    def write_string(self, s):
+        # bytes pass through; str encodes utf-8 with surrogateescape so
+        # strings produced by read_string's binary fallback restore
+        # their exact original bytes (see read_string)
+        raw = s if isinstance(s, bytes) else s.encode(
+            "utf-8", errors="surrogateescape")
         self.write_int(len(raw))
         self.f.write(raw)
 
@@ -203,7 +213,7 @@ class _Writer:
         elif isinstance(obj, (int, float)):
             self.write_int(T_NUMBER)
             self.write_double(float(obj))
-        elif isinstance(obj, str):
+        elif isinstance(obj, (str, bytes)):
             self.write_int(T_STRING)
             self.write_string(obj)
         elif isinstance(obj, np.ndarray):

@@ -232,3 +232,17 @@ def test_unsupported_class_raises(tmp_path):
     save_t7(str(p), TorchObject("nn.FancyUnknownLayer", {}))
     with pytest.raises(ValueError, match="FancyUnknownLayer"):
         load_t7(str(p))
+
+
+def test_binary_string_lossless_roundtrip(tmp_path):
+    # Lua strings are byte strings; non-UTF8 payloads must survive
+    # load/save unchanged (ADVICE r3: errors='replace' corrupted them)
+    payload = bytes(range(256)).decode("utf-8", errors="surrogateescape")
+    p = tmp_path / "bin.t7"
+    save_t7(str(p), {"blob": payload, "name": "ok",
+                     "raw": bytes(range(256))})  # bytes also writable
+    out = load_t7(str(p), to_module=False)
+    assert out["name"] == "ok"
+    for k in ("blob", "raw"):
+        assert out[k].encode("utf-8", errors="surrogateescape") == \
+            bytes(range(256))
