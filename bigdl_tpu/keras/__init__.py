@@ -18,6 +18,10 @@ from bigdl_tpu.keras.layers_extra import (
     ZeroPadding2D,
 )
 from bigdl_tpu.keras.models import Sequential
+from bigdl_tpu.keras.functional import (
+    Add, Average, Concatenate, Dot, Input, KTensor, Maximum, Minimum,
+    Model, Multiply, Subtract, merge,
+)
 
 __all__ = [
     "Sequential", "Dense", "Conv2D", "Convolution2D", "MaxPooling2D",
@@ -26,4 +30,7 @@ __all__ = [
     "InputLayer", "Conv3D", "MaxPooling3D", "UpSampling2D",
     "GlobalMaxPooling2D", "SimpleRNN", "GRU", "Bidirectional",
     "ZeroPadding2D", "Cropping2D", "Permute", "RepeatVector",
+    # functional API
+    "Model", "Input", "KTensor", "merge", "Add", "Multiply", "Subtract",
+    "Average", "Maximum", "Minimum", "Concatenate", "Dot",
 ]

@@ -40,6 +40,13 @@ class KerasLayer:
               ) -> Tuple[Optional[nn.Module], Tuple[int, ...]]:
         raise NotImplementedError
 
+    def __call__(self, tensor):
+        """Functional-API wiring: `layer(tensor)` on a KTensor from
+        `keras.Input` (see keras/functional.py)."""
+        from bigdl_tpu.keras.functional import call_layer
+
+        return call_layer(self, tensor)
+
     @staticmethod
     def _infer_out(module: nn.Module, input_shape: Tuple[int, ...]
                    ) -> Tuple[int, ...]:

@@ -41,16 +41,90 @@ _METRICS = {
 }
 
 
-class Sequential:
+class _Trainable:
+    """compile/fit/evaluate/predict surface shared by keras.Sequential
+    and the functional keras.Model — both lower onto the core
+    Optimizer/Evaluator/Predictor stack."""
+
+    def __init__(self):
+        self._module = None
+        self._optim = None
+        self._criterion = None
+        self._metrics = None
+
+    def build(self):
+        raise NotImplementedError
+
+    # ---- data adaptation (Model overrides for multi-input) ----------
+
+    def _to_samples(self, x, y):
+        xs = np.asarray(x)
+        ys = np.asarray(y)
+        return [Sample(xi, yi) for xi, yi in zip(xs, ys)]
+
+    def _to_dataset(self, x, y) -> "DataSet":
+        return DataSet.array(self._to_samples(x, y))
+
+    # ---- training ---------------------------------------------------
+
+    def compile(self, optimizer="sgd", loss="sparse_categorical_crossentropy",
+                metrics: Sequence[str] = ()):
+        self._optim = _OPTIMIZERS[optimizer]() \
+            if isinstance(optimizer, str) else optimizer
+        self._criterion = _LOSSES[loss]() if isinstance(loss, str) else loss
+        self._metrics = [_METRICS[m]() if isinstance(m, str) else m
+                         for m in metrics]
+        return self
+
+    def fit(self, x, y, batch_size: int = 32, epochs: int = 1,
+            validation_data=None, precision=None):
+        if self._optim is None:
+            raise RuntimeError("call compile() before fit()")
+        module = self.build()
+        opt = (Optimizer(module, self._to_dataset(x, y), self._criterion,
+                         batch_size=batch_size)
+               .set_optim_method(self._optim)
+               .set_end_when(Trigger.max_epoch(epochs)))
+        if validation_data is not None and self._metrics:
+            vx, vy = validation_data
+            opt.set_validation(Trigger.every_epoch(),
+                               self._to_dataset(vx, vy), self._metrics,
+                               batch_size=batch_size)
+        if precision is not None:
+            opt.set_precision(precision)
+        trained = opt.optimize()
+        self._module = trained
+        return self
+
+    def evaluate(self, x, y, batch_size: int = 32) -> dict:
+        module = self.build()
+        methods = self._metrics or [Loss(self._criterion
+                                         or nn.ClassNLLCriterion())]
+        res = Evaluator(module).test(self._to_dataset(x, y), methods,
+                                     batch_size=batch_size)
+        return {k: v.result()[0] for k, v in res.items()}
+
+    def predict(self, x, batch_size: int = 32) -> np.ndarray:
+        module = self.build()
+        samples = [Sample(f, np.int32(0))
+                   for f in self._predict_features(x)]
+        return Predictor(module, batch_size=batch_size).predict(
+            DataSet.array(samples))
+
+    def _predict_features(self, x):
+        return np.asarray(x)
+
+    def predict_classes(self, x, batch_size: int = 32) -> np.ndarray:
+        return np.argmax(self.predict(x, batch_size), axis=-1)
+
+
+class Sequential(_Trainable):
     """keras.models.Sequential-shaped builder; the first layer must carry
     `input_shape` (batch dim excluded, as in Keras)."""
 
     def __init__(self, layers: Optional[Sequence[KerasLayer]] = None):
+        super().__init__()
         self.layers: List[KerasLayer] = []
-        self._module: Optional[nn.Sequential] = None
-        self._optim = None
-        self._criterion = None
-        self._metrics = None
         for l in layers or []:
             self.add(l)
 
@@ -93,56 +167,3 @@ class Sequential:
             lines.append(f"{lname:<29}{(None,) + tuple(shape)}")
         return "\n".join(lines)
 
-    # ---- training ------------------------------------------------------
-
-    def compile(self, optimizer="sgd", loss="sparse_categorical_crossentropy",
-                metrics: Sequence[str] = ()) -> "Sequential":
-        self._optim = _OPTIMIZERS[optimizer]() \
-            if isinstance(optimizer, str) else optimizer
-        self._criterion = _LOSSES[loss]() if isinstance(loss, str) else loss
-        self._metrics = [_METRICS[m]() if isinstance(m, str) else m
-                         for m in metrics]
-        return self
-
-    @staticmethod
-    def _to_dataset(x, y) -> "DataSet":
-        xs = np.asarray(x)
-        ys = np.asarray(y)
-        return DataSet.array([Sample(xi, yi) for xi, yi in zip(xs, ys)])
-
-    def fit(self, x, y, batch_size: int = 32, epochs: int = 1,
-            validation_data=None, precision=None) -> "Sequential":
-        if self._optim is None:
-            raise RuntimeError("call compile() before fit()")
-        module = self.build()
-        opt = (Optimizer(module, self._to_dataset(x, y), self._criterion,
-                         batch_size=batch_size)
-               .set_optim_method(self._optim)
-               .set_end_when(Trigger.max_epoch(epochs)))
-        if validation_data is not None and self._metrics:
-            vx, vy = validation_data
-            opt.set_validation(Trigger.every_epoch(),
-                               self._to_dataset(vx, vy), self._metrics,
-                               batch_size=batch_size)
-        if precision is not None:
-            opt.set_precision(precision)
-        trained = opt.optimize()
-        self._module = trained
-        return self
-
-    def evaluate(self, x, y, batch_size: int = 32) -> dict:
-        module = self.build()
-        methods = self._metrics or [Loss(self._criterion
-                                         or nn.ClassNLLCriterion())]
-        res = Evaluator(module).test(self._to_dataset(x, y), methods,
-                                     batch_size=batch_size)
-        return {k: v.result()[0] for k, v in res.items()}
-
-    def predict(self, x, batch_size: int = 32) -> np.ndarray:
-        module = self.build()
-        xs = np.asarray(x)
-        ds = DataSet.array([Sample(xi, np.int32(0)) for xi in xs])
-        return Predictor(module, batch_size=batch_size).predict(ds)
-
-    def predict_classes(self, x, batch_size: int = 32) -> np.ndarray:
-        return np.argmax(self.predict(x, batch_size), axis=-1)

@@ -58,10 +58,19 @@ class Graph(Module):
         self.input_nodes = [inputs] if isinstance(inputs, Node) else list(inputs)
         self.output_nodes = [outputs] if isinstance(outputs, Node) else list(outputs)
         self._order = self._topo_sort()
+        # weight sharing: nodes wired with the SAME module object share
+        # one parameter entry (the reference's shared-weight semantics;
+        # also what the Keras functional API's layer-reuse contract
+        # requires). Keys are per-module, deduped by identity.
         self._keys: Dict[int, str] = {}
+        seen_modules: Dict[int, str] = {}
         for i, node in enumerate(self._order):
-            if node.module is not None:
-                self._keys[id(node)] = f"{i}_{node.module.key_name()}"
+            if node.module is None:
+                continue
+            mid = id(node.module)
+            if mid not in seen_modules:
+                seen_modules[mid] = f"{i}_{node.module.key_name()}"
+            self._keys[id(node)] = seen_modules[mid]
 
     def _topo_sort(self) -> List[Node]:
         order, seen, stack = [], set(), []
@@ -96,18 +105,24 @@ class Graph(Module):
         return order
 
     def init_params(self, rng):
-        return {
-            self._keys[id(n)]: n.module.init_params(jax.random.fold_in(rng, i))
-            for i, n in enumerate(self._order)
-            if n.module is not None
-        }
+        out = {}
+        for i, n in enumerate(self._order):
+            if n.module is None:
+                continue
+            key = self._keys[id(n)]
+            if key not in out:  # shared modules init once
+                out[key] = n.module.init_params(jax.random.fold_in(rng, i))
+        return out
 
     def init_state(self):
-        return {
-            self._keys[id(n)]: n.module.init_state()
-            for n in self._order
-            if n.module is not None
-        }
+        out = {}
+        for n in self._order:
+            if n.module is None:
+                continue
+            key = self._keys[id(n)]
+            if key not in out:
+                out[key] = n.module.init_state()
+        return out
 
     def apply(self, variables, *inputs, training=False, rng=None):
         if len(inputs) == 1 and isinstance(inputs[0], (tuple, list)):
@@ -137,6 +152,8 @@ class Graph(Module):
                 child_vars, *args, training=training, rng=_fold_rng(rng, i)
             )
             values[id(node)] = out
+            # shared modules: later occurrences overwrite (a shared
+            # stateful module keeps its LAST application's state)
             new_state[key] = s
         outs = [values[id(n)] for n in self.output_nodes]
         return (outs[0] if len(outs) == 1 else T(*outs)), new_state
