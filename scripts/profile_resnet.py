@@ -147,12 +147,14 @@ def make_step(model, method, policy, frozen_bn=False):
         def loss_call(p, mod_state, x, y, rng):
             p = policy.cast_to_compute(p)
             x = policy.cast_to_compute(x)
-            # running stats live in f32 state; cast so eval-mode BN's
-            # output stays bf16 for the next conv
-            out, new_state = model.apply(
+            out, _ = model.apply(
                 {"params": p, "state": policy.cast_to_compute(mod_state)},
                 x, training=False, rng=rng)
-            return crit(policy.cast_to_output(out), y), new_state
+            # return the ORIGINAL f32 state: returning the cast copy
+            # changes the carry dtype between warmup and the timed
+            # loop, landing a recompile inside the timed region
+            # (memory: tpu-measurement-gotchas)
+            return crit(policy.cast_to_output(out), y), mod_state
 
     @jax.jit
     def step(bx, by, carry):

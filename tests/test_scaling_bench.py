@@ -1,0 +1,34 @@
+"""Scaling-harness plumbing CI (VERDICT r3 item 4): the pod-scaling
+script must run end-to-end on the virtual mesh so pod time, when it
+exists, is spent measuring rather than debugging."""
+
+import importlib.util
+import os
+
+import jax
+
+
+def _load():
+    path = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                        "scaling_bench.py")
+    spec = importlib.util.spec_from_file_location("scaling_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_measure_mesh_contract():
+    sb = _load()
+    assert jax.device_count() >= 8
+    for n in (1, 8):
+        row = sb.measure_mesh(n, "mlp", per_chip_batch=8, iters=1,
+                              ici_gbps=400.0)
+        assert row["devices"] == n
+        assert row["global_batch"] == 8 * n
+        assert row["step_ms"] > 0
+        assert row["collective_ms"] > 0
+        assert row["wire_mb"] > 0
+        if n == 1:
+            assert row["ici_ring_bound_ms"] == 0.0
+        else:
+            assert row["ici_ring_bound_ms"] > 0
