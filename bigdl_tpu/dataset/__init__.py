@@ -9,4 +9,7 @@ from bigdl_tpu.dataset.dataset import (
     TransformedDataSet, DataSet,
 )
 from bigdl_tpu.dataset import image, native, text, mnist, cifar, vision
+from bigdl_tpu.dataset.records import (
+    RecordFileDataSet, read_header, resolve_shards, write_shards,
+)
 from bigdl_tpu.dataset.vision import ImageFeature, ImageFrame

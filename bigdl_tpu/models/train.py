@@ -36,6 +36,16 @@ def main(argv=None):
     ap.add_argument("--mesh", default=None, help="e.g. data=8")
     ap.add_argument("--synthetic", action="store_true",
                     help="use synthetic data (no dataset folder needed)")
+    ap.add_argument("--records", default=None, metavar="DIR|GLOB",
+                    help="train from disk-resident BDLS record shards "
+                         "through the native dataplane (any vision "
+                         "model; see bigdl_tpu.dataset.records)")
+    ap.add_argument("--recordsMean", default="127.5",
+                    help="comma per-channel mean for --records")
+    ap.add_argument("--recordsStd", default="127.5",
+                    help="comma per-channel std for --records")
+    ap.add_argument("--recordsAug", default="",
+                    help="comma subset of: hflip,pad<N> (e.g. hflip,pad4)")
     ap.add_argument("--precision", default=None,
                     choices=["bf16", "mixed", "fp32"],
                     help="bf16 → mixed-precision training")
@@ -125,11 +135,14 @@ def main(argv=None):
                 "(only lenet / resnet20-cifar have dataset loaders); drop "
                 "-f to train on synthetic data")
         model, shape, classes = _build_model(args.model, 1000)
-        rng = np.random.RandomState(0)
-        train = [Sample(rng.rand(*shape).astype(np.float32),
-                        np.int32(rng.randint(classes)))
-                 for _ in range(args.batchSize * 4)]
-        val = train[:args.batchSize]
+        if args.records:
+            train, val = [], []  # disk shards replace the synthetic pool
+        else:
+            rng = np.random.RandomState(0)
+            train = [Sample(rng.rand(*shape).astype(np.float32),
+                            np.int32(rng.randint(classes)))
+                     for _ in range(args.batchSize * 4)]
+            val = train[:args.batchSize]
 
     model.build(jax.random.PRNGKey(42))
 
@@ -147,11 +160,49 @@ def main(argv=None):
         criterion = nn.ClassNLLCriterion()
         val_methods = [Top1Accuracy()]
 
-    opt = (Optimizer(model, DataSet.array(train), criterion,
+    if args.records:
+        if args.model in ("transformer", "textclassifier", "ncf",
+                          "bilstm"):
+            raise SystemExit(
+                f"--records holds image shards; model {args.model!r} "
+                "takes token inputs (use a vision model)")
+        # disk-resident path: BDLS shards → native mmap prefetcher
+        # (reference: the Spark-executor-fed ImageNet pipeline,
+        # SURVEY.md §2.4/§7; dataset/records.py)
+        from bigdl_tpu.dataset import RecordFileDataSet, resolve_shards
+        from bigdl_tpu.dataset.records import read_header
+
+        _, _, _, chans = read_header(resolve_shards(args.records)[0])
+
+        def _per_channel(spec):
+            vals = [float(v) for v in spec.split(",")]
+            return vals * chans if len(vals) == 1 else vals
+
+        pad, hflip = 0, False
+        for tok in filter(None, args.recordsAug.split(",")):
+            if tok == "hflip":
+                hflip = True
+            elif tok.startswith("pad"):
+                pad = int(tok[3:])
+            else:
+                raise SystemExit(f"unknown --recordsAug token {tok!r}")
+        train_ds = RecordFileDataSet(
+            args.records, args.batchSize, mean=_per_channel(args.recordsMean),
+            std=_per_channel(args.recordsStd), pad=pad, hflip=hflip)
+        logging.getLogger("bigdl_tpu").info(
+            "records: %d samples %s from %d shards (native=%s)",
+            train_ds.size(), train_ds.shape, len(train_ds.paths),
+            train_ds.native)
+        val_ds = train_ds  # eval iterates the shards once, unaugmented
+    else:
+        train_ds = DataSet.array(train)
+        val_ds = DataSet.array(val)
+
+    opt = (Optimizer(model, train_ds, criterion,
                      batch_size=args.batchSize)
            .set_optim_method(method)
            .set_end_when(Trigger.max_epoch(args.maxEpoch))
-           .set_validation(Trigger.every_epoch(), DataSet.array(val),
+           .set_validation(Trigger.every_epoch(), val_ds,
                            val_methods, args.batchSize))
     if args.checkpoint:
         opt.set_checkpoint(args.checkpoint, Trigger.every_epoch())

@@ -181,10 +181,15 @@ class SpatialFullConvolution(Module):
         kh, kw = self.kernel_h, self.kernel_w
         pad_h = (kh - 1 - self.pad_h, kh - 1 - self.pad_h + self.adj_h)
         pad_w = (kw - 1 - self.pad_w, kw - 1 - self.pad_w + self.adj_w)
+        # transposed conv = cross-correlation of the lhs-dilated input
+        # with the kernel ROTATED 180° — the flip is what makes this the
+        # exact adjoint of SpatialConvolution (torch ConvTranspose2d
+        # semantics; weights stored unflipped, same orientation as torch)
+        w = p["weight"][::-1, ::-1]
         dn = lax.conv_dimension_numbers(
-            x.shape, p["weight"].shape, ("NHWC", "HWOI", "NHWC"))
+            x.shape, w.shape, ("NHWC", "HWOI", "NHWC"))
         y = lax.conv_general_dilated(
-            x, p["weight"],
+            x, w,
             window_strides=(1, 1),
             padding=[pad_h, pad_w],
             lhs_dilation=(self.stride_h, self.stride_w),

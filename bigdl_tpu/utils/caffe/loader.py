@@ -175,6 +175,8 @@ class CaffeLoader:
         t, p = layer.type, layer.proto
         if t == "Convolution":
             return self._conv(p, blobs, in_shape) + (4,)
+        if t == "Deconvolution":
+            return self._deconv(p, blobs, in_shape) + (4,)
         if t == "InnerProduct":
             return self._inner_product(p, blobs, rank, in_shape) + (2,)
         if t == "Pooling":
@@ -287,6 +289,38 @@ class CaffeLoader:
                 n_in, n_out, kw, kh, sw, sh, pw, ph, n_group=group,
                 with_bias=cp.bias_term)
         params = {"weight": w.transpose(2, 3, 1, 0)}  # OIHW → HWIO
+        if cp.bias_term:
+            params["bias"] = _blob_array(blobs[1]).reshape(-1)
+        return m, {"params": params, "state": {}}
+
+    def _deconv(self, p, blobs, in_shape=None):
+        """Caffe Deconvolution → SpatialFullConvolution (transposed
+        conv). Blob layout is (I, O/g, kH, kW) — input channels FIRST,
+        the transpose of Convolution's (O, I/g, kH, kW)."""
+        cp = p.convolution_param
+        kh = int(cp.kernel_h or (cp.kernel_size[0] if cp.kernel_size else 1))
+        kw = int(cp.kernel_w or (cp.kernel_size[-1] if cp.kernel_size else 1))
+        sh = int(cp.stride_h or (cp.stride[0] if cp.stride else 1))
+        sw = int(cp.stride_w or (cp.stride[-1] if cp.stride else 1))
+        ph = int(cp.pad_h or (cp.pad[0] if cp.pad else 0))
+        pw = int(cp.pad_w or (cp.pad[-1] if cp.pad else 0))
+        if int(cp.group) > 1:
+            raise NotImplementedError("grouped Deconvolution")
+        n_out = int(cp.num_output)
+        if not blobs:
+            if in_shape is None or len(in_shape) != 4:
+                raise ValueError(
+                    "Deconvolution without weights needs a known input "
+                    "shape (declare input_shape in the prototxt)")
+            m = nn.SpatialFullConvolution(
+                int(in_shape[-1]), n_out, kw, kh, sw, sh, pw, ph,
+                with_bias=cp.bias_term)
+            return m, None
+        w = _blob_array(blobs[0])  # (I, O, kH, kW)
+        m = nn.SpatialFullConvolution(
+            int(w.shape[0]), n_out, kw, kh, sw, sh, pw, ph,
+            with_bias=cp.bias_term)
+        params = {"weight": w.transpose(2, 3, 1, 0)}  # IOHW → HWOI
         if cp.bias_term:
             params["bias"] = _blob_array(blobs[1]).reshape(-1)
         return m, {"params": params, "state": {}}
@@ -633,6 +667,19 @@ class CaffePersister:
                                      bots)
             blob_of[i] = top
             return finish(l, top, 2)
+        if isinstance(mod, nn.SpatialFullConvolution):
+            l, top = self._new_layer(net, "Deconvolution", mod.name, bots)
+            cp = l.convolution_param
+            cp.num_output = mod.n_output_plane
+            cp.kernel_h, cp.kernel_w = mod.kernel_h, mod.kernel_w
+            cp.stride_h, cp.stride_w = mod.stride_h, mod.stride_w
+            cp.pad_h, cp.pad_w = mod.pad_h, mod.pad_w
+            cp.bias_term = mod.with_bias
+            w = np.asarray(p["weight"]).transpose(3, 2, 0, 1)  # HWOI→IOHW
+            _fill_blob(l.blobs.add(), w)
+            if mod.with_bias:
+                _fill_blob(l.blobs.add(), np.asarray(p["bias"]))
+            return finish(l, top)
         if isinstance(mod, nn.SpatialConvolution):
             l, top = self._new_layer(net, "Convolution",
                                      mod.name, bots)

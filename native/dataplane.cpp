@@ -28,6 +28,11 @@
 #include <thread>
 #include <vector>
 
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
 extern "C" {
 
 // ---------------------------------------------------------------- kernels
@@ -155,20 +160,112 @@ int bdl_decode_cifar10(const uint8_t* buf, int64_t len, uint8_t* images,
   return 0;
 }
 
+// ------------------------------------------------------- BDLS shard files
+//
+// Disk-resident fixed-record image shards (the TPU-era counterpart of
+// the reference's ImageNet sequence files, dataset/image/ + SURVEY.md
+// §2.4): 32-byte header then n records of [label i32 LE][h*w*c u8].
+// Shards are mmap()ed, so datasets far larger than RAM stream through
+// the OS page cache with zero-copy reads in the workers.
+
+struct BdlsHeader {
+  char magic[4];      // "BDLS"
+  uint32_t version;   // 1
+  uint64_t n;
+  uint32_t h, w, c;
+  uint32_t reserved;
+};
+static_assert(sizeof(BdlsHeader) == 32, "BDLS header must be 32 bytes");
+
+struct MappedShard {
+  int fd = -1;
+  void* map = nullptr;
+  size_t len = 0;
+  const uint8_t* base = nullptr;  // first record
+  int64_t n = 0;
+};
+
+// Returns 0 on success. Fills header fields; on success the shard is
+// mapped read-only with MADV_WILLNEED left to the kernel's readahead.
+static int map_shard(const char* path, MappedShard* out, BdlsHeader* hdr) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || static_cast<size_t>(st.st_size) < sizeof(BdlsHeader)) {
+    ::close(fd);
+    return -2;
+  }
+  void* m = ::mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (m == MAP_FAILED) {
+    ::close(fd);
+    return -3;
+  }
+  std::memcpy(hdr, m, sizeof(BdlsHeader));
+  if (std::memcmp(hdr->magic, "BDLS", 4) != 0 || hdr->version != 1) {
+    ::munmap(m, st.st_size);
+    ::close(fd);
+    return -4;
+  }
+  const int64_t rec = 4 + static_cast<int64_t>(hdr->h) * hdr->w * hdr->c;
+  // division form: the multiplication `rec * n` could wrap for a
+  // corrupt/hostile header and bypass validation
+  const uint64_t payload = st.st_size - sizeof(BdlsHeader);
+  if (hdr->n > payload / static_cast<uint64_t>(rec)) {
+    ::munmap(m, st.st_size);
+    ::close(fd);
+    return -5;
+  }
+  out->fd = fd;
+  out->map = m;
+  out->len = st.st_size;
+  out->base = static_cast<const uint8_t*>(m) + sizeof(BdlsHeader);
+  out->n = static_cast<int64_t>(hdr->n);
+  return 0;
+}
+
 // -------------------------------------------------------------- prefetcher
 
 struct Batch {
-  std::vector<float> images;
+  std::vector<float> images;      // f32 mode (normalized on host)
+  std::vector<uint8_t> images_u8; // u8 mode (normalize on device —
+                                  // 4x less host->device wire)
   std::vector<int32_t> labels;
 };
 
+// u8 in-place horizontal flip of one (h, w, c) image
+static void flip_u8(uint8_t* img, int h, int w, int c) {
+  for (int y = 0; y < h; ++y) {
+    uint8_t* row = img + static_cast<int64_t>(y) * w * c;
+    for (int x = 0; x < w / 2; ++x)
+      for (int ch = 0; ch < c; ++ch)
+        std::swap(row[x * c + ch], row[(w - 1 - x) * c + ch]);
+  }
+}
+
+// u8 shift-crop with zero pad, src -> dst, one image
+static void shift_crop_u8(const uint8_t* src, uint8_t* dst, int dy, int dx,
+                          int h, int w, int c) {
+  const int64_t img_sz = static_cast<int64_t>(h) * w * c;
+  std::memset(dst, 0, img_sz);
+  int y0 = std::max(0, dy), y1 = std::min(h, h + dy);
+  int x0 = std::max(0, dx), x1 = std::min(w, w + dx);
+  for (int y = y0; y < y1; ++y)
+    std::memcpy(dst + (static_cast<int64_t>(y) * w + x0) * c,
+                src + (static_cast<int64_t>(y - dy) * w + (x0 - dx)) * c,
+                static_cast<int64_t>(x1 - x0) * c);
+}
+
 struct Prefetcher {
-  const uint8_t* images;   // (n, h, w, c) u8, borrowed from caller
-  const int32_t* labels;   // (n,), borrowed
+  const uint8_t* images;   // (n, h, w, c) u8, borrowed (nullptr: files)
+  const int32_t* labels;   // (n,), borrowed (nullptr: files)
+  std::vector<MappedShard> shards;       // disk-resident mode
+  std::vector<int64_t> shard_starts;     // cumulative record offsets
+  int64_t rec_bytes = 0;                 // 4 + h*w*c (file mode)
   int64_t n;
   int h, w, c, batch;
   int pad;                 // random-shift augmentation range (0 = off)
   bool hflip;
+  bool u8_out = false;     // emit raw u8 batches (device-side normalize)
   std::vector<float> mean, stdd;
 
   std::deque<Batch> ring;
@@ -200,6 +297,19 @@ struct Prefetcher {
     }
   }
 
+  // record accessor spanning both sources (in-memory / mmap'd shards)
+  const uint8_t* record_image(int64_t i, int32_t* label) const {
+    if (images) {
+      *label = labels[i];
+      return images + i * static_cast<int64_t>(h) * w * c;
+    }
+    auto it = std::upper_bound(shard_starts.begin(), shard_starts.end(), i);
+    const size_t s = (it - shard_starts.begin()) - 1;
+    const uint8_t* rec = shards[s].base + (i - shard_starts[s]) * rec_bytes;
+    std::memcpy(label, rec, sizeof(int32_t));
+    return rec + sizeof(int32_t);
+  }
+
   void worker(unsigned seed) {
     std::mt19937 rng(seed);
     std::vector<int64_t> idx;
@@ -207,23 +317,39 @@ struct Prefetcher {
     while (!stop.load()) {
       take_indices(&idx);
       Batch b;
-      b.images.resize(static_cast<int64_t>(batch) * img_px * c);
       b.labels.resize(batch);
-      std::vector<uint8_t> u8img(img_px * c);
-      for (int i = 0; i < batch; ++i) {
-        const uint8_t* src = images + idx[i] * img_px * c;
-        b.labels[i] = labels[idx[i]];
-        float* dst = b.images.data() + static_cast<int64_t>(i) * img_px * c;
-        bdl_normalize_u8(src, dst, img_px, c, mean.data(), stdd.data(), 1);
-        if (pad > 0) {
-          std::uniform_int_distribution<int> d(-pad, pad);
-          int offy = d(rng), offx = d(rng);
-          std::vector<float> tmp(dst, dst + img_px * c);
-          bdl_shift_crop(tmp.data(), dst, &offy, &offx, 1, h, w, c);
+      const int64_t img_sz = img_px * c;
+      if (u8_out) {
+        b.images_u8.resize(static_cast<int64_t>(batch) * img_sz);
+        for (int i = 0; i < batch; ++i) {
+          const uint8_t* src = record_image(idx[i], &b.labels[i]);
+          uint8_t* dst = b.images_u8.data() +
+                         static_cast<int64_t>(i) * img_sz;
+          if (pad > 0) {
+            std::uniform_int_distribution<int> d(-pad, pad);
+            shift_crop_u8(src, dst, d(rng), d(rng), h, w, c);
+          } else {
+            std::memcpy(dst, src, img_sz);
+          }
+          if (hflip && (rng() & 1)) flip_u8(dst, h, w, c);
         }
-        if (hflip && (rng() & 1)) {
-          uint8_t f = 1;
-          bdl_hflip(dst, &f, 1, h, w, c);
+      } else {
+        b.images.resize(static_cast<int64_t>(batch) * img_sz);
+        for (int i = 0; i < batch; ++i) {
+          const uint8_t* src = record_image(idx[i], &b.labels[i]);
+          float* dst = b.images.data() + static_cast<int64_t>(i) * img_sz;
+          bdl_normalize_u8(src, dst, img_px, c, mean.data(), stdd.data(),
+                           1);
+          if (pad > 0) {
+            std::uniform_int_distribution<int> d(-pad, pad);
+            int offy = d(rng), offx = d(rng);
+            std::vector<float> tmp(dst, dst + img_sz);
+            bdl_shift_crop(tmp.data(), dst, &offy, &offx, 1, h, w, c);
+          }
+          if (hflip && (rng() & 1)) {
+            uint8_t f = 1;
+            bdl_hflip(dst, &f, 1, h, w, c);
+          }
         }
       }
       std::unique_lock<std::mutex> lk(mu);
@@ -234,6 +360,85 @@ struct Prefetcher {
     }
   }
 };
+
+// Disk-resident prefetcher over BDLS shard files. Returns nullptr on
+// any open/map/header failure (caller falls back). All shards must
+// share (h, w, c); out_* report the dataset geometry.
+void* bdl_file_prefetcher_create(const char* const* paths, int n_paths,
+                                 int batch, int capacity, int n_threads,
+                                 uint64_t seed, int pad, int hflip,
+                                 int u8_out, const float* mean,
+                                 const float* stdd, int64_t* out_n,
+                                 int* out_h, int* out_w, int* out_c) {
+  auto* p = new Prefetcher();
+  BdlsHeader first{};
+  int64_t total = 0;
+  auto fail = [&](MappedShard* extra) {
+    if (extra && extra->map) {
+      ::munmap(extra->map, extra->len);
+      ::close(extra->fd);
+    }
+    for (auto& s : p->shards) {
+      ::munmap(s.map, s.len);
+      ::close(s.fd);
+    }
+    delete p;
+    return static_cast<void*>(nullptr);
+  };
+  for (int i = 0; i < n_paths; ++i) {
+    MappedShard ms;
+    BdlsHeader hdr{};
+    if (map_shard(paths[i], &ms, &hdr) != 0) return fail(nullptr);
+    if (i > 0 && (hdr.h != first.h || hdr.w != first.w ||
+                  hdr.c != first.c))
+      return fail(&ms);  // the just-mapped shard is not in p->shards yet
+    if (i == 0) first = hdr;
+    p->shard_starts.push_back(total);
+    total += ms.n;
+    p->shards.push_back(ms);
+  }
+  if (total == 0) return fail(nullptr);
+  p->images = nullptr;
+  p->labels = nullptr;
+  p->n = total;
+  p->h = first.h; p->w = first.w; p->c = first.c;
+  p->rec_bytes = 4 + static_cast<int64_t>(first.h) * first.w * first.c;
+  p->batch = batch;
+  p->capacity = capacity > 0 ? capacity : 4;
+  p->pad = pad; p->hflip = hflip != 0;
+  p->u8_out = u8_out != 0;
+  p->mean.assign(mean, mean + first.c);
+  p->stdd.assign(stdd, stdd + first.c);
+  p->index_rng.seed(seed);
+  {
+    std::lock_guard<std::mutex> lk(p->order_mu);
+    p->refill_order();
+  }
+  if (n_threads < 1) n_threads = 1;
+  for (int t = 0; t < n_threads; ++t)
+    p->workers.emplace_back(&Prefetcher::worker, p,
+                            static_cast<unsigned>(seed + 1000003ULL * (t + 1)));
+  *out_n = total;
+  *out_h = first.h; *out_w = first.w; *out_c = first.c;
+  return p;
+}
+
+// u8-mode consumer (pair with u8_out=1 at create time)
+void bdl_prefetcher_next_u8(void* handle, uint8_t* out_images,
+                            int32_t* out_labels) {
+  auto* p = static_cast<Prefetcher*>(handle);
+  Batch b;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv_empty.wait(lk, [&] { return !p->ring.empty(); });
+    b = std::move(p->ring.front());
+    p->ring.pop_front();
+    p->cv_full.notify_one();
+  }
+  std::memcpy(out_images, b.images_u8.data(), b.images_u8.size());
+  std::memcpy(out_labels, b.labels.data(),
+              b.labels.size() * sizeof(int32_t));
+}
 
 void* bdl_prefetcher_create(const uint8_t* images, const int32_t* labels,
                             int64_t n, int h, int w, int c, int batch,
@@ -281,6 +486,10 @@ void bdl_prefetcher_destroy(void* handle) {
   p->cv_full.notify_all();
   p->cv_empty.notify_all();
   for (auto& t : p->workers) t.join();
+  for (auto& s : p->shards) {
+    ::munmap(s.map, s.len);
+    ::close(s.fd);
+  }
   delete p;
 }
 

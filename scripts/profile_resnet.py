@@ -270,6 +270,13 @@ def main():
                      nn.Reshape([2048]), nn.Linear(2048, 1000),
                      nn.LogSoftMax()),
                  (B, 7, 7, 2048)),
+        # single interior bottlenecks (stage × block-count estimates the
+        # stage; whole-stage graphs reproducibly hang the remote compile
+        # service — see tpu-measurement-gotchas)
+        "block1": (seq(R.bottleneck(256, 64)), (B, 56, 56, 256)),
+        "block2": (seq(R.bottleneck(512, 128)), (B, 28, 28, 512)),
+        "block3": (seq(R.bottleneck(1024, 256)), (B, 14, 14, 1024)),
+        "block4": (seq(R.bottleneck(2048, 512)), (B, 7, 7, 2048)),
     }
 
     only = (set(args.only_stage.split(",")) if args.only_stage else None)

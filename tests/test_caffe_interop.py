@@ -383,3 +383,50 @@ def test_persister_keeps_non_flatten_transpose_reshape(tmp_path):
     net = pb.NetParameter()
     net.ParseFromString((tmp_path / "nf.caffemodel").read_bytes())
     assert not any(l.type == "Flatten" for l in net.layer)
+
+
+def test_deconvolution_matches_torch(tmp_path):
+    """Deconvolution fixture → SpatialFullConvolution, oracled against
+    torch ConvTranspose2d (VERDICT r3 item 9)."""
+    import torch
+
+    rng = np.random.default_rng(7)
+    net = pb.NetParameter()
+    net.name = "deconv_net"
+    net.input.append("data")
+    net.input_shape.add().dim.extend([1, 3, 5, 5])
+
+    dc = net.layer.add()
+    dc.name, dc.type = "up1", "Deconvolution"
+    dc.bottom.append("data"); dc.top.append("up1")
+    cp = dc.convolution_param
+    cp.num_output = 4
+    cp.kernel_size.append(4); cp.stride.append(2); cp.pad.append(1)
+    w = rng.standard_normal((3, 4, 4, 4)).astype(np.float32)  # (I,O,kH,kW)
+    b = rng.standard_normal((4,)).astype(np.float32)
+    _mk_blob(dc, w); _mk_blob(dc, b)
+
+    path = tmp_path / "deconv.caffemodel"
+    path.write_bytes(net.SerializeToString())
+    model, variables = caffe.load(model_path=str(path))
+
+    x_nchw = rng.standard_normal((2, 3, 5, 5)).astype(np.float32)
+    out, _ = model.apply(variables,
+                         jnp.asarray(x_nchw.transpose(0, 2, 3, 1)),
+                         training=False)
+    want = torch.nn.functional.conv_transpose2d(
+        torch.from_numpy(x_nchw), torch.from_numpy(w),
+        torch.from_numpy(b), stride=2, padding=1)
+    np.testing.assert_allclose(
+        np.asarray(out), want.numpy().transpose(0, 2, 3, 1),
+        rtol=1e-4, atol=1e-4)
+
+    # round-trip through the persister
+    def_p, mod_p = tmp_path / "d.prototxt", tmp_path / "d.caffemodel"
+    caffe.persist(str(def_p), str(mod_p), model, variables, (1, 5, 5, 3))
+    model2, vars2 = caffe.load(str(def_p), str(mod_p))
+    out2, _ = model2.apply(vars2,
+                           jnp.asarray(x_nchw.transpose(0, 2, 3, 1)),
+                           training=False)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)

@@ -97,6 +97,25 @@ class TestConv:
         # out = (in-1)*stride - 2*pad + kernel = 7*2 - 2 + 4 = 16
         assert eager(m, x).shape == (1, 16, 16, 3)
 
+    def test_transposed_matches_torch(self):
+        import torch
+
+        rng = np.random.RandomState(3)
+        m = nn.SpatialFullConvolution(2, 3, 4, 4, 2, 2, 1, 1)
+        v = m.init(jax.random.PRNGKey(0))
+        x = rng.randn(2, 5, 5, 2).astype(np.float32)
+        out, _ = m.apply(v, jnp.asarray(x))
+        # our (kH,kW,O,I) ↔ torch (I,O,kH,kW)
+        w_t = np.asarray(v["params"]["weight"]).transpose(3, 2, 0, 1)
+        want = torch.nn.functional.conv_transpose2d(
+            torch.from_numpy(x.transpose(0, 3, 1, 2)),
+            torch.from_numpy(w_t),
+            torch.from_numpy(np.asarray(v["params"]["bias"])),
+            stride=2, padding=1)
+        np.testing.assert_allclose(
+            np.asarray(out), want.numpy().transpose(0, 2, 3, 1),
+            rtol=1e-4, atol=1e-4)
+
 
 class TestPooling:
     def test_max_pool(self):
