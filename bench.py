@@ -202,6 +202,25 @@ def bench_resnet_diskpipe(batch, iters, on_tpu, synthetic_step_s=None):
         carry, loss = step(jnp.asarray(img), jnp.asarray(lbl), carry)
         float(loss)
 
+        # component rates, so the row attributes its own overhead:
+        # host pipeline alone (disk->augmented u8 batch), then H2D wire.
+        # Drain the ring first — it filled during the minutes-long
+        # compile, and timing warm-queue pops would understate the
+        # steady-state production rate (CLAUDE.md measurement notes)
+        for _ in range(5):  # > capacity + workers-in-flight
+            pf.next()
+        t0 = time.perf_counter()
+        for _ in range(12):
+            img, lbl = pf.next()
+        host_s = (time.perf_counter() - t0) / 12
+        wire_mb = img.nbytes / 1e6
+        t0 = time.perf_counter()
+        for i in range(4):
+            img[0, 0, 0, 0] = i  # never byte-identical (memoization)
+            x = jnp.asarray(img)
+            float(jnp.sum(x[:1].astype(jnp.float32)))
+        h2d_s = (time.perf_counter() - t0) / 4
+
         t0 = time.perf_counter()
         for _ in range(iters):
             img, lbl = pf.next()  # host pipeline + H2D inside the loop
@@ -221,6 +240,9 @@ def bench_resnet_diskpipe(batch, iters, on_tpu, synthetic_step_s=None):
             "vs_baseline": None,
             "step_ms": round(dt * 1e3, 2),
             "pipe_overhead_vs_synthetic": overhead,
+            "host_pipeline_ms": round(host_s * 1e3, 2),
+            "h2d_ms": round(h2d_s * 1e3, 2),
+            "h2d_mb_per_s": round(wire_mb / h2d_s, 1),
             "native_plane": pf.native,
         }), flush=True)
         pf.close()

@@ -87,14 +87,22 @@ def _load():
         lib.bdl_prefetcher_create.restype = ctypes.c_void_p
         lib.bdl_prefetcher_next.argtypes = [ctypes.c_void_p, f32p, i32p]
         lib.bdl_prefetcher_destroy.argtypes = [ctypes.c_void_p]
-        lib.bdl_file_prefetcher_create.argtypes = [
-            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int, f32p, f32p, i64p,
-            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
-            ctypes.POINTER(ctypes.c_int)]
-        lib.bdl_file_prefetcher_create.restype = ctypes.c_void_p
-        lib.bdl_prefetcher_next_u8.argtypes = [ctypes.c_void_p, u8p, i32p]
+        try:
+            # newer symbols — a prebuilt .so from an older source tree
+            # may lack them; the rest of the native plane still works
+            lib.bdl_file_prefetcher_create.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, f32p, f32p, i64p,
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int)]
+            lib.bdl_file_prefetcher_create.restype = ctypes.c_void_p
+            lib.bdl_prefetcher_next_u8.argtypes = [ctypes.c_void_p, u8p,
+                                                   i32p]
+            lib._has_file_prefetcher = True
+        except AttributeError:
+            lib._has_file_prefetcher = False
         _lib = lib
         return _lib
 
@@ -114,6 +122,19 @@ def _f32(a):
 
 def _i32(a):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _per_channel(vals, c, what) -> np.ndarray:
+    """Validate/broadcast a per-channel vector to exactly c entries —
+    the C++ side reads exactly c floats, so a short array would be an
+    out-of-bounds read, not a broadcast."""
+    arr = np.asarray(vals, np.float32).reshape(-1)
+    if arr.size == 1:
+        arr = np.full((c,), float(arr[0]), np.float32)
+    if arr.size != c:
+        raise ValueError(
+            f"{what} has {arr.size} entries for {c} channels")
+    return np.ascontiguousarray(arr)
 
 
 def normalize_u8(images: np.ndarray, mean: Sequence[float],
@@ -225,8 +246,8 @@ class Prefetcher:
         self.batch_size = batch_size
         n, h, w, c = self.images.shape
         self.shape = (h, w, c)
-        self.mean = np.asarray(mean, np.float32)
-        self.std = np.asarray(std, np.float32)
+        self.mean = _per_channel(mean, c, "mean")
+        self.std = _per_channel(std, c, "std")
         self.pad, self.hflip = pad, hflip
         self._lib = _load()
         self.native = self._lib is not None
@@ -322,15 +343,22 @@ class FilePrefetcher:
         batches — 4x less host->device wire; normalize on device (the
         TPU-idiomatic split: bytes over the wire, elementwise math on
         the chip where it is free)."""
+        from bigdl_tpu.dataset.records import read_header
+
         self.paths = [os.fspath(p) for p in paths]
         self.batch_size = batch_size
-        self.mean = np.asarray(mean, np.float32)
-        self.std = np.asarray(std, np.float32)
+        # channel count from the first shard header (Python-side read;
+        # the native create would read exactly c floats of mean/std, so
+        # validation must happen first)
+        _, _, _, chans = read_header(self.paths[0])
+        self.mean = _per_channel(mean, chans, "mean")
+        self.std = _per_channel(std, chans, "std")
         self.pad, self.hflip = pad, hflip
         assert out_dtype in ("f32", "u8"), out_dtype
         self.out_dtype = out_dtype
         self._lib = _load()
-        self.native = self._lib is not None
+        self.native = (self._lib is not None and
+                       getattr(self._lib, "_has_file_prefetcher", False))
         if self.native:
             arr = (ctypes.c_char_p * len(self.paths))(
                 *[p.encode() for p in self.paths])
@@ -398,7 +426,14 @@ class FilePrefetcher:
                 img = raw.copy() if self.out_dtype == "u8" else \
                     (raw.astype(np.float32) - self.mean) / self.std
                 if self.pad:
-                    shifted = np.zeros_like(img)
+                    if self.out_dtype == "u8":
+                        # mean-byte fill: borders normalize to 0.0 on
+                        # device, matching the f32 plane's zero-fill
+                        shifted = np.empty_like(img)
+                        shifted[:] = np.clip(self.mean + 0.5, 0,
+                                             255).astype(np.uint8)
+                    else:
+                        shifted = np.zeros_like(img)
                     for j in range(len(img)):
                         dy, dx = self._rng.randint(-self.pad,
                                                    self.pad + 1, 2)

@@ -49,14 +49,17 @@ class MoE(Module):
 
     def __init__(self, dim: int, hidden: int, num_experts: int,
                  capacity_factor: float = 1.25,
-                 expert_axis: Optional[str] = None,
+                 expert_axis: Optional[str] = None, top_k: int = 1,
                  name: Optional[str] = None):
         super().__init__(name=name)
+        if top_k not in (1, 2):
+            raise ValueError(f"top_k must be 1 or 2, got {top_k}")
         self.dim = dim
         self.hidden = hidden
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
         self.expert_axis = expert_axis
+        self.top_k = top_k
 
     def init_params(self, rng):
         e, d, f = self.num_experts, self.dim, self.hidden
@@ -71,26 +74,56 @@ class MoE(Module):
         }
 
     def _route(self, x2, router):
-        """x2: (T, D) → dispatch (T, E, C), combine (T, E, C), aux."""
+        """x2: (T, D) → dispatch (T, E, C), combine (T, E, C), aux.
+
+        top_k=1: Switch. top_k=2: GShard — second choice masked from the
+        first, both gate values renormalized to sum to 1, second-choice
+        tokens queue BEHIND all first-choice tokens in an expert's
+        capacity buffer (first choices are never dropped in favor of
+        seconds). Capacity scales with top_k.
+        """
         t = x2.shape[0]
         e = self.num_experts
-        cap = max(1, int(self.capacity_factor * t / e))
+        cap = max(1, int(self.capacity_factor * self.top_k * t / e))
         gates = jax.nn.softmax(x2 @ router, axis=-1)          # (T, E)
-        expert = jnp.argmax(gates, axis=-1)                   # (T,)
-        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # (T, E)
-        # position of each token within its expert's queue
-        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0       # (T, E)
-        keep = onehot * (pos < cap)                           # (T, E)
-        pos_oh = jax.nn.one_hot(pos.max(axis=-1).astype(jnp.int32), cap,
-                                dtype=jnp.float32)            # (T, C)
-        dispatch = keep[:, :, None] * pos_oh[:, None, :]      # (T, E, C)
-        gate_val = jnp.sum(gates * keep, axis=-1,
-                           keepdims=True)                     # (T, 1)
-        combine = dispatch * gate_val[:, :, None]
-        # Switch load-balancing aux: fraction routed × mean gate, per e
-        frac = jnp.mean(onehot, axis=0)
+
+        def choice_slot(onehot, offset):
+            """dispatch mask (T,E,C) for one choice, given per-expert
+            queue offsets (E,) from earlier choices."""
+            pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0   # (T, E)
+            pos = pos + offset[None, :] * onehot
+            keep = onehot * (pos < cap)                       # (T, E)
+            pos_oh = jax.nn.one_hot(
+                pos.max(axis=-1).astype(jnp.int32), cap,
+                dtype=jnp.float32)                            # (T, C)
+            return keep[:, :, None] * pos_oh[:, None, :], keep
+
+        oh1 = jax.nn.one_hot(jnp.argmax(gates, axis=-1), e,
+                             dtype=jnp.float32)               # (T, E)
+        d1, keep1 = choice_slot(oh1, jnp.zeros((e,), jnp.float32))
+        g1 = jnp.sum(gates * keep1, axis=-1)                  # (T,)
+
+        # Switch load-balancing aux from the FIRST choice (both modes):
+        # fraction routed × mean gate, per expert
+        frac = jnp.mean(oh1, axis=0)
         mean_gate = jnp.mean(gates, axis=0)
         aux = jnp.sum(frac * mean_gate) * e
+
+        if self.top_k == 1:
+            combine = d1 * g1[:, None, None]
+            return d1, combine, aux, cap
+
+        gates2 = gates * (1.0 - oh1)                          # mask top-1
+        oh2 = jax.nn.one_hot(jnp.argmax(gates2, axis=-1), e,
+                             dtype=jnp.float32)
+        d2, keep2 = choice_slot(oh2, jnp.sum(oh1, axis=0))
+        g2 = jnp.sum(gates * keep2, axis=-1)
+        # renormalize over the SURVIVING choices (a dropped second
+        # choice leaves the first at full weight, and vice versa)
+        denom = g1 + g2 + 1e-9
+        w1, w2 = g1 / denom, g2 / denom
+        dispatch = d1 + d2          # disjoint experts: no overlap
+        combine = d1 * w1[:, None, None] + d2 * w2[:, None, None]
         return dispatch, combine, aux, cap
 
     def _experts(self, p, xin):

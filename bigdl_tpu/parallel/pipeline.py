@@ -56,6 +56,13 @@ def pipeline_specs(pipe_axis: str = "pipe", tie_embeddings: bool = True):
     return specs
 
 
+def pipeline_bubble_fraction(n_stages: int, microbatches: int) -> float:
+    """GPipe idle fraction: each stage is idle for (n−1) of the
+    m+n−1 ticks (warmup + drain). Raising `microbatches` amortizes it;
+    report this when choosing a schedule."""
+    return (n_stages - 1) / (microbatches + n_stages - 1)
+
+
 def make_pipeline_train_step(
     model: TransformerLM,
     method,
@@ -154,4 +161,12 @@ def make_pipeline_train_step(
         out_specs=(specs, slot_specs, P()),
         check_vma=False,
     )
-    return jax.jit(smapped, donate_argnums=(0, 1))
+    step = jax.jit(smapped, donate_argnums=(0, 1))
+    bubble = pipeline_bubble_fraction(n, m_micro)
+    step.bubble_fraction = bubble
+    import logging
+
+    logging.getLogger("bigdl_tpu.parallel").info(
+        "pipeline schedule: %d stages x %d microbatches, GPipe bubble "
+        "fraction %.3f", n, m_micro, bubble)
+    return step

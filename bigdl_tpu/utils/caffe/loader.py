@@ -306,6 +306,8 @@ class CaffeLoader:
         pw = int(cp.pad_w or (cp.pad[-1] if cp.pad else 0))
         if int(cp.group) > 1:
             raise NotImplementedError("grouped Deconvolution")
+        if cp.dilation and int(cp.dilation[0]) > 1:
+            raise NotImplementedError("dilated Deconvolution")
         n_out = int(cp.num_output)
         if not blobs:
             if in_shape is None or len(in_shape) != 4:

@@ -242,11 +242,15 @@ static void flip_u8(uint8_t* img, int h, int w, int c) {
   }
 }
 
-// u8 shift-crop with zero pad, src -> dst, one image
+// u8 shift-crop, src -> dst, one image. Padding fills with the
+// per-channel MEAN byte so device-side normalization maps borders to
+// 0.0 — identical augmentation distribution to the f32 plane, whose
+// zero-fill happens post-normalize.
 static void shift_crop_u8(const uint8_t* src, uint8_t* dst, int dy, int dx,
-                          int h, int w, int c) {
-  const int64_t img_sz = static_cast<int64_t>(h) * w * c;
-  std::memset(dst, 0, img_sz);
+                          int h, int w, int c, const uint8_t* fill) {
+  for (int y = 0; y < h; ++y)
+    for (int x = 0; x < w; ++x)
+      std::memcpy(dst + (static_cast<int64_t>(y) * w + x) * c, fill, c);
   int y0 = std::max(0, dy), y1 = std::min(h, h + dy);
   int x0 = std::max(0, dx), x1 = std::min(w, w + dx);
   for (int y = y0; y < y1; ++y)
@@ -321,13 +325,17 @@ struct Prefetcher {
       const int64_t img_sz = img_px * c;
       if (u8_out) {
         b.images_u8.resize(static_cast<int64_t>(batch) * img_sz);
+        std::vector<uint8_t> fill(c);
+        for (int ch = 0; ch < c; ++ch)
+          fill[ch] = static_cast<uint8_t>(
+              std::min(255.0f, std::max(0.0f, mean[ch] + 0.5f)));
         for (int i = 0; i < batch; ++i) {
           const uint8_t* src = record_image(idx[i], &b.labels[i]);
           uint8_t* dst = b.images_u8.data() +
                          static_cast<int64_t>(i) * img_sz;
           if (pad > 0) {
             std::uniform_int_distribution<int> d(-pad, pad);
-            shift_crop_u8(src, dst, d(rng), d(rng), h, w, c);
+            shift_crop_u8(src, dst, d(rng), d(rng), h, w, c, fill.data());
           } else {
             std::memcpy(dst, src, img_sz);
           }

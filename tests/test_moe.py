@@ -121,3 +121,141 @@ def test_expert_parallel_grads_match(cap=8.0):
                                jax.tree_util.tree_leaves_with_path(g_ref)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-4, err_msg=str(ka))
+
+
+# ------------------------------------------------------------ top-2 (GShard)
+
+def test_top2_matches_dense_weighted_oracle():
+    """With capacity large enough that nothing drops, top-2 output is
+    exactly w1*FFN_{e1}(x) + w2*FFN_{e2}(x) with renormalized gates —
+    checked against a dense run of ALL experts."""
+    m = MoE(DIM, HID, EXPERTS, capacity_factor=8.0, top_k=2, name="moe")
+    variables = m.init(jax.random.PRNGKey(0))
+    p = variables["params"]
+    x = jax.random.normal(jax.random.PRNGKey(1), (48, DIM))
+    (y, aux), _ = m.apply(variables, x)
+
+    gates = jax.nn.softmax(x @ p["router"], axis=-1)
+    e1 = jnp.argmax(gates, axis=-1)
+    g2m = gates * (1 - jax.nn.one_hot(e1, EXPERTS))
+    e2 = jnp.argmax(g2m, axis=-1)
+    g1 = jnp.take_along_axis(gates, e1[:, None], -1)[:, 0]
+    g2 = jnp.take_along_axis(gates, e2[:, None], -1)[:, 0]
+    w1, w2 = g1 / (g1 + g2 + 1e-9), g2 / (g1 + g2 + 1e-9)
+    # dense: every expert applied to every token
+    h = jnp.einsum("td,edf->tef", x, p["w1"]) + p["b1"][None]
+    out_all = jnp.einsum("tef,efd->ted", jax.nn.gelu(h), p["w2"]) \
+        + p["b2"][None]
+    rows = jnp.arange(x.shape[0])
+    ref = w1[:, None] * out_all[rows, e1] + w2[:, None] * out_all[rows, e2]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    assert float(aux) > 0.0
+
+
+def test_top2_second_choice_yields_to_first():
+    """Second choices queue BEHIND first choices in an expert's
+    capacity buffer: with every token first-choosing expert 0 and
+    second-choosing expert 1 at cap=2, expert 0 keeps exactly the first
+    two tokens' FIRST choices (seconds could never displace them), and
+    dropped-second tokens revert to full weight on their first choice."""
+    m = MoE(2, HID, 2, capacity_factor=0.25, top_k=2, name="moe")
+    # cap = 0.25 * 2 * 8 / 2 = 2
+    t = 8
+    x2 = jnp.tile(jnp.asarray([[2.0, 1.0]]), (t, 1))   # e0 first, e1 second
+    router = jnp.eye(2)
+    dispatch, combine, aux, cap = m._route(x2, router)
+    assert cap == 2
+    d = np.asarray(dispatch)                            # (T, E, C)
+    # expert 0: tokens 0 and 1 occupy its two slots (first choices win)
+    np.testing.assert_array_equal(d[:, 0, :].sum(axis=1),
+                                  [1, 1, 0, 0, 0, 0, 0, 0])
+    # expert 1: the SECOND choices of tokens 0 and 1 fill its slots
+    # (its own queue was empty of first choices)
+    np.testing.assert_array_equal(d[:, 1, :].sum(axis=1),
+                                  [1, 1, 0, 0, 0, 0, 0, 0])
+    # tokens 2..7 lost both choices → zero combine weight; tokens 0,1
+    # keep both with renormalized weights summing to 1
+    c = np.asarray(combine).sum(axis=(1, 2))
+    np.testing.assert_allclose(c[:2], [1.0, 1.0], atol=1e-6)
+    np.testing.assert_allclose(c[2:], 0.0, atol=1e-6)
+
+
+def test_top2_dropped_second_reverts_to_full_first_weight():
+    """Oversubscribe only the second-choice expert: first choices all
+    survive, and a token whose second choice was dropped puts weight
+    1.0 on its first choice (renormalization over survivors)."""
+    m = MoE(2, HID, 2, capacity_factor=0.75, top_k=2, name="moe")
+    # cap = 0.75 * 2 * 8 / 2 = 6: expert 0 keeps 6 of 8 first choices;
+    # expert 1 keeps 6 of 8 second choices
+    t = 8
+    x2 = jnp.tile(jnp.asarray([[2.0, 1.0]]), (t, 1))
+    router = jnp.eye(2)
+    dispatch, combine, aux, cap = m._route(x2, router)
+    assert cap == 6
+    d = np.asarray(dispatch)
+    np.testing.assert_array_equal(d[:, 0, :].sum(axis=1),
+                                  [1] * 6 + [0] * 2)
+    np.testing.assert_array_equal(d[:, 1, :].sum(axis=1),
+                                  [1] * 6 + [0] * 2)
+    c = np.asarray(combine)
+    # tokens 0..5: both survive, weights renormalized to sum 1
+    np.testing.assert_allclose(c[:6].sum(axis=(1, 2)), 1.0, atol=1e-6)
+    # tokens 6,7: both dropped here (same order in both queues)
+    np.testing.assert_allclose(c[6:].sum(axis=(1, 2)), 0.0, atol=1e-6)
+
+
+@pytest.mark.parametrize("cap", [8.0, 1.25])
+def test_top2_expert_parallel_matches_single_device(cap):
+    n = 4
+    mesh = make_mesh({"expert": n}, devices=jax.devices()[:n])
+    m_ref = MoE(DIM, HID, EXPERTS, capacity_factor=cap, top_k=2,
+                name="moe")
+    m_ep = MoE(DIM, HID, EXPERTS, capacity_factor=cap, top_k=2,
+               expert_axis="expert", name="moe")
+    variables = m_ref.init(jax.random.PRNGKey(0))
+    params = variables["params"]
+    x = jax.random.normal(jax.random.PRNGKey(1), (n * 16, DIM))
+
+    chunks = x.reshape(n, 16, DIM)
+    ref = jnp.concatenate([
+        m_ref.apply({"params": params, "state": {}}, chunks[i])[0][0]
+        for i in range(n)])
+
+    specs = moe_specs("expert")
+
+    def body(p, x):
+        (y, aux), _ = m_ep.apply({"params": p, "state": {}}, x)
+        return y
+
+    fn = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(specs, P("expert", None)),
+        out_specs=P("expert", None), check_vma=False))
+    out = fn(shard_params(mesh, specs, params),
+             jax.device_put(x, NamedSharding(mesh, P("expert", None))))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_top_k_validation():
+    with pytest.raises(ValueError, match="top_k"):
+        MoE(DIM, HID, EXPERTS, top_k=3)
+
+
+def test_pipeline_bubble_fraction_reported():
+    from bigdl_tpu.parallel.pipeline import pipeline_bubble_fraction
+
+    assert pipeline_bubble_fraction(4, 4) == pytest.approx(3 / 7)
+    assert pipeline_bubble_fraction(1, 8) == 0.0
+    # the constructed step carries its schedule's bubble fraction
+    from bigdl_tpu.models.transformer import TransformerConfig, TransformerLM
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.parallel import make_mesh, make_pipeline_train_step
+
+    mesh = make_mesh({"pipe": 4}, devices=jax.devices()[:4])
+    cfg = TransformerConfig(vocab_size=32, max_len=16, dim=16,
+                            num_heads=4, num_layers=4, dropout=0.0)
+    step = make_pipeline_train_step(TransformerLM(cfg, name="lm"),
+                                    SGD(learningrate=0.1), mesh,
+                                    microbatches=8)
+    assert step.bubble_fraction == pytest.approx(3 / 11)
