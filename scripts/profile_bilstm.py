@@ -1,0 +1,108 @@
+"""BiLSTM train-step sweep — recurrent-path attribution (VERDICT r3
+item 3; BASELINE config 4).
+
+Sweeps the levers that matter for a latency-bound scan: input-proj
+hoisting (one big MXU matmul outside the scan), lax.scan unroll, and
+batch. Full train step identical to bench.py's bench_bilstm.
+
+Usage: python scripts/profile_bilstm.py [--iters 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge
+
+        xla_bridge._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+
+PEAK_BF16 = 197e12
+
+
+def run_config(tag, batch, seq, unroll, hoist, iters):
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.models import rnn
+    from bigdl_tpu.ops.losses import build_train_loss
+    from bigdl_tpu.optim import Adam
+    from bigdl_tpu.utils.precision import DEFAULT_MIXED as POLICY
+
+    model = rnn.bilstm_sentiment(20000, embed_dim=128, hidden_size=128)
+    bi = model[1]  # BiRecurrent
+    for r in (bi.fwd, bi.bwd):
+        r.unroll = unroll
+        r.hoist_inputs = hoist
+    variables = model.init(jax.random.PRNGKey(0))
+    method = Adam(1e-3)
+    loss_call = build_train_loss(model, nn.ClassNLLCriterion(), POLICY)
+
+    @jax.jit
+    def step(bx, by, carry):
+        params, slots = carry
+        (loss, _), grads = jax.value_and_grad(
+            lambda p: loss_call(p, variables["state"], bx, by,
+                                jax.random.PRNGKey(1)),
+            has_aux=True)(params)
+        new_params, new_slots = method.update(
+            grads, params, slots, jnp.asarray(1e-3), jnp.asarray(0))
+        return (new_params, new_slots), loss
+
+    carry = (variables["params"], method.init_slots(variables["params"]))
+    rng = np.random.RandomState(0)
+    pool = [(jnp.asarray(rng.randint(0, 20000, (batch, seq)), jnp.int32),
+             jnp.asarray(rng.randint(0, 2, batch), jnp.int32))
+            for _ in range(4)]
+    try:
+        carry, loss = step(*pool[0], carry)
+        float(loss)
+        t0 = time.perf_counter()
+        for i in range(iters):
+            carry, loss = step(*pool[(i + 1) % 4], carry)
+        float(loss)
+        dt = (time.perf_counter() - t0) / iters
+        e = h = 128
+        flops = 3 * batch * 2 * seq * 8 * h * (e + h)
+        print(json.dumps({
+            "config": tag, "batch": batch, "seq": seq, "unroll": unroll,
+            "hoist": hoist, "step_ms": round(dt * 1e3, 2),
+            "samples_per_sec": round(batch / dt, 1),
+            "mfu": round(flops / dt / PEAK_BF16, 4),
+        }), flush=True)
+    except Exception as exc:
+        print(json.dumps({"config": tag, "FAILED": str(exc)[:160]}),
+              flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=16)
+    args = ap.parse_args()
+
+    # r3 shipped shape first (the baseline row), then the levers
+    run_config("baseline_nohoist", 128, 128, 1, False, args.iters)
+    run_config("hoist", 128, 128, 1, True, args.iters)
+    run_config("hoist_unroll8", 128, 128, 8, True, args.iters)
+    run_config("hoist_unroll16", 128, 128, 16, True, args.iters)
+    run_config("hoist_unroll8_b512", 512, 128, 8, True, args.iters)
+    run_config("hoist_unroll8_b1024", 1024, 128, 8, True, args.iters)
+
+
+if __name__ == "__main__":
+    main()

@@ -161,3 +161,25 @@ class TestConvLSTMPeephole:
         total = sum(float(jnp.abs(l).sum())
                     for l in jax.tree_util.tree_leaves(g))
         assert total > 0
+
+
+def test_hoisted_scan_matches_unhoisted():
+    """hoist_inputs=True (default, PROFILE_r04) must be numerically
+    equivalent to the in-scan path — guards both paths against drift."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(3, 7, 5).astype(np.float32))
+    for hoist in (True, False):
+        m = nn.Recurrent(nn.LSTM(5, 6), hoist_inputs=hoist)
+        v = m.init(jax.random.PRNGKey(0))
+        out, _ = m.apply(v, x)
+        if hoist:
+            ref = out
+        else:
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=1e-5, atol=1e-6)
+    # BiRecurrent exposes the knob too
+    bi = nn.BiRecurrent(nn.LSTM(5, 6), hoist_inputs=False)
+    assert not bi.fwd.hoist_inputs and not bi.bwd.hoist_inputs
