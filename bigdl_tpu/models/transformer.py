@@ -197,6 +197,11 @@ class TransformerLM(Module):
         y = self._ln(x, bp["ln1_g"], bp["ln1_b"])
         if self.tp_axis is not None:
             y = tp_identity(y, self.tp_axis)
+        # NOTE: a fused qkv matmul (concat weights → one (E, 3HD) gemm →
+        # split) was MEASURED SLOWER at 186M — 53.2k vs 55.3k tok/s
+        # (PROFILE_r04/ANALYSIS.md): the per-scan-step weight concat and
+        # qkv split cost more than the gemm fusion saves. Three gemms
+        # at M=B·S are already MXU-efficient; don't re-fuse.
         q = (y @ bp["wq"] + bp["bq"]).reshape(b, s, h_local, d).transpose(0, 2, 1, 3)
         k = (y @ bp["wk"] + bp["bk"]).reshape(b, s, h_local, d).transpose(0, 2, 1, 3)
         v = (y @ bp["wv"] + bp["bv"]).reshape(b, s, h_local, d).transpose(0, 2, 1, 3)

@@ -566,12 +566,17 @@ def _clamp_block(block: int, seq: int) -> int:
 def _resolve_impl_and_blocks(q, k, block_q, block_k, impl):
     """Shared default resolution for both public entry points: pick the
     impl (Mosaic kernels on TPU, reference elsewhere), then per-impl
-    default tiles (Mosaic wants 512x512, the XLA scan wants 128),
-    clamped to the sequences."""
+    default tiles, clamped to the sequences.
+
+    Mosaic default tiles are 1024x1024 (round-4 sweep,
+    PROFILE_r04/attn_block_sweep.log: fwd 5.84 ms vs 6.23 at 512x512 at
+    the 186M shape, fwd+bwd 15.6 — the grid-cell count, not the MXU, is
+    the binding constraint, so fewer/bigger cells win; 2048-row tiles
+    regress and 2048x1024 fails to compile). The XLA scan keeps 128."""
     impl = impl or _default_impl()
     big = impl in ("pallas", "interpret")
-    block_q = _clamp_block(block_q or (512 if big else 128), q.shape[-2])
-    block_k = _clamp_block(block_k or (512 if big else 128), k.shape[-2])
+    block_q = _clamp_block(block_q or (1024 if big else 128), q.shape[-2])
+    block_k = _clamp_block(block_k or (1024 if big else 128), k.shape[-2])
     return impl, block_q, block_k
 
 
@@ -611,10 +616,11 @@ def flash_attention(
     | 'interpret' (Pallas interpreter mode, for CPU tests) |
     'reference'.
 
-    Block sizes default per impl from the round-3 measurements: the
-    Mosaic kernels want LARGE tiles (512x512 — grid overhead amortized,
-    MXU fed 512-row tiles), the XLA scan wants SMALL kv blocks (128 —
-    its per-block elementwise chain stays cache-resident).
+    Block sizes default per impl from measurement (round 4): the
+    Mosaic kernels want LARGE tiles (1024x1024 — the grid-cell count,
+    not the MXU, binds; PROFILE_r04/attn_block_sweep.log), the XLA scan
+    wants SMALL kv blocks (128 — its per-block elementwise chain stays
+    cache-resident).
     `bwd_block_k` applies only to the impl='xla' scan backward. All are
     clamped to the sequence lengths, so short sequences run a
     single-tile kernel.
