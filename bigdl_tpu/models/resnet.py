@@ -115,8 +115,17 @@ def build_cifar(depth: int = 20, class_num: int = 10,
 
 
 def build_imagenet(depth: int = 50, class_num: int = 1000,
-                   shortcut_type: str = "B") -> nn.Sequential:
-    """ImageNet ResNet (reference: ResNet.apply imagenet branch)."""
+                   shortcut_type: str = "B",
+                   stem: str = "conv7") -> nn.Sequential:
+    """ImageNet ResNet (reference: ResNet.apply imagenet branch).
+
+    stem="s2d": SpaceToDepth(2) + 4x4/stride-1 conv over 12 channels —
+    function-space superset of the reference 7x7/stride-2 stem (same
+    stride-2 geometry; the 4x4 kernel on the s2d grid covers an 8x8>=7x7
+    receptive field) that contracts over 12 channels instead of 3, the
+    TPU MXU stem idiom (MLPerf-era; PROFILE_r04 measured the conv7 stem
+    at 6% of peak).
+    """
     cfgs = {
         18: (basic_block, [2, 2, 2, 2], 1),
         34: (basic_block, [3, 4, 6, 3], 1),
@@ -125,10 +134,21 @@ def build_imagenet(depth: int = 50, class_num: int = 1000,
         152: (bottleneck, [3, 8, 36, 3], 4),
     }
     block, layers, expansion = cfgs[depth]
-    model = nn.Sequential(
-        _conv(3, 64, 7, 2, 3).set_name("conv1"), _bn(64), nn.ReLU(),
-        nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1),
-    )
+    if stem == "s2d":
+        model = nn.Sequential(
+            nn.SpaceToDepth(2),
+            nn.SpatialConvolution(
+                12, 64, 4, 4, 1, 1, (2, 1), (2, 1), with_bias=False,
+                w_init=MsraFiller(variance_norm_average=False),
+            ).set_name("conv1"),
+            _bn(64), nn.ReLU(),
+            nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1),
+        )
+    else:
+        model = nn.Sequential(
+            _conv(3, 64, 7, 2, 3).set_name("conv1"), _bn(64), nn.ReLU(),
+            nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1),
+        )
     n_in = 64
     for stage, (planes, stride) in enumerate([(64, 1), (128, 2), (256, 2),
                                               (512, 2)]):

@@ -44,7 +44,7 @@ from bigdl_tpu.nn.dropout import (
 from bigdl_tpu.nn.reshape import (
     Reshape, View, Squeeze, Unsqueeze, Select, Narrow, Transpose, Contiguous,
     Identity, Echo, SpatialZeroPadding, Padding, AddConstant, MulConstant,
-    Replicate, Masking, GradientReversal,
+    Replicate, Masking, GradientReversal, SpaceToDepth,
 )
 from bigdl_tpu.nn.table_ops import (
     CAddTable, CMulTable, CSubTable, CDivTable, CMaxTable, CMinTable,

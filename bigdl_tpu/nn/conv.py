@@ -89,10 +89,16 @@ class SpatialConvolution(Module):
         return p
 
     def _pad(self):
-        # reference semantics: pad_w == -1 → TF-style SAME padding
+        # reference semantics: pad_w == -1 → TF-style SAME padding;
+        # a (low, high) tuple gives asymmetric padding (even-kernel
+        # stems, e.g. the space-to-depth ResNet stem)
         if self.pad_w == -1:
             return "SAME"
-        return [(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)]
+        ph = (self.pad_h if isinstance(self.pad_h, (tuple, list))
+              else (self.pad_h, self.pad_h))
+        pw = (self.pad_w if isinstance(self.pad_w, (tuple, list))
+              else (self.pad_w, self.pad_w))
+        return [tuple(ph), tuple(pw)]
 
     def apply(self, variables, x, training=False, rng=None):
         p = variables["params"]

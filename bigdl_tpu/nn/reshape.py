@@ -244,3 +244,30 @@ class GradientReversal(Module):
         rev.defvjp(lambda v: (v, None),
                    lambda _, g: (jnp.negative(g) * lam,))
         return rev(x), variables["state"]
+
+
+class SpaceToDepth(Module):
+    """(N, H, W, C) → (N, H/b, W/b, C·b²) — move b×b spatial blocks
+    into channels.
+
+    No reference counterpart; the TPU vision-stem idiom: a 7×7/stride-2
+    stem conv on (224, 224, 3) runs the MXU at C_in=3 (1/42 of the
+    128-lane tile); after SpaceToDepth(2) the equivalent conv contracts
+    over 12 channels on half the spatial grid (models/resnet.py
+    stem="s2d").
+    """
+
+    def __init__(self, block_size: int = 2, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.block_size = block_size
+
+    def apply(self, variables, x, training=False, rng=None):
+        b = self.block_size
+        n, h, w, c = x.shape
+        if h % b or w % b:
+            raise ValueError(f"spatial dims {(h, w)} not divisible by "
+                             f"block_size {b}")
+        y = x.reshape(n, h // b, b, w // b, b, c)
+        y = y.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // b, w // b,
+                                                  b * b * c)
+        return y, variables["state"]

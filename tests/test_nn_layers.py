@@ -290,3 +290,39 @@ class TestLRN:
             torch.tensor(x.transpose(0, 3, 1, 2)), 5, alpha=1.0, beta=0.75, k=1.0)
         np.testing.assert_allclose(
             ours, ref.numpy().transpose(0, 2, 3, 1), rtol=1e-4, atol=1e-5)
+
+
+class TestSpaceToDepth:
+    def test_blocks_to_channels(self):
+        m = nn.SpaceToDepth(2)
+        x = jnp.arange(2 * 4 * 4 * 3, dtype=jnp.float32).reshape(2, 4, 4, 3)
+        out = eager(m, x)
+        assert out.shape == (2, 2, 2, 12)
+        # first output pixel = the 2x2 block's channels, row-major
+        np.testing.assert_allclose(
+            np.asarray(out[0, 0, 0]),
+            np.concatenate([np.asarray(x[0, 0, 0]), np.asarray(x[0, 0, 1]),
+                            np.asarray(x[0, 1, 0]), np.asarray(x[0, 1, 1])]))
+
+    def test_indivisible_raises(self):
+        m = nn.SpaceToDepth(2)
+        with pytest.raises(ValueError, match="not divisible"):
+            eager(m, jnp.zeros((1, 5, 4, 3)))
+
+    def test_asymmetric_conv_padding(self):
+        # (low, high) padding tuples: 4x4/s1 with pad (2,1) preserves
+        # the spatial size (the s2d stem geometry)
+        m = nn.SpatialConvolution(3, 4, 4, 4, 1, 1, (2, 1), (2, 1))
+        x = jnp.zeros((1, 8, 8, 3))
+        assert eager(m, x).shape == (1, 8, 8, 4)
+
+    def test_s2d_resnet_stem_shapes(self):
+        import jax
+
+        from bigdl_tpu.models import resnet
+
+        model = resnet.build_imagenet(50, 10, stem="s2d")
+        v = model.init(jax.random.PRNGKey(0))
+        out, _ = model.apply(v, jnp.zeros((1, 224, 224, 3)),
+                             training=False)
+        assert out.shape == (1, 10)
