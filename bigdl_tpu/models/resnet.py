@@ -135,20 +135,14 @@ def build_imagenet(depth: int = 50, class_num: int = 1000,
     }
     block, layers, expansion = cfgs[depth]
     if stem == "s2d":
-        model = nn.Sequential(
-            nn.SpaceToDepth(2),
-            nn.SpatialConvolution(
-                12, 64, 4, 4, 1, 1, (2, 1), (2, 1), with_bias=False,
-                w_init=MsraFiller(variance_norm_average=False),
-            ).set_name("conv1"),
-            _bn(64), nn.ReLU(),
-            nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1),
-        )
+        model = nn.Sequential(nn.SpaceToDepth(2),
+                              _conv(12, 64, 4, 1, (2, 1)).set_name("conv1"))
+    elif stem == "conv7":
+        model = nn.Sequential(_conv(3, 64, 7, 2, 3).set_name("conv1"))
     else:
-        model = nn.Sequential(
-            _conv(3, 64, 7, 2, 3).set_name("conv1"), _bn(64), nn.ReLU(),
-            nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1),
-        )
+        raise ValueError(f"unknown stem {stem!r} (conv7 | s2d)")
+    model.add(_bn(64)).add(nn.ReLU())
+    model.add(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1))
     n_in = 64
     for stage, (planes, stride) in enumerate([(64, 1), (128, 2), (256, 2),
                                               (512, 2)]):

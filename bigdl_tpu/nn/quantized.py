@@ -129,7 +129,8 @@ class QuantizedSpatialConvolution(Module):
         acc = lax.conv_general_dilated(
             xq, p["qweight"],
             window_strides=(c.stride_h, c.stride_w),
-            padding=[(c.pad_h, c.pad_h), (c.pad_w, c.pad_w)],
+            # reuse the float conv's padding resolution (SAME / tuple)
+            padding=c._pad(),
             dimension_numbers=c._dn,
             feature_group_count=c.n_group,
             preferred_element_type=jnp.int32,

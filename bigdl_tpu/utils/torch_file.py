@@ -404,6 +404,12 @@ def _from_module(module, variables) -> TorchObject:
             fields.update(bias=b, gradBias=_zeros_like(b))
         return TorchObject("nn.Linear", fields)
     if t == "SpatialConvolution":
+        if isinstance(module.pad_w, (tuple, list)) or \
+                isinstance(module.pad_h, (tuple, list)):
+            raise ValueError(
+                "Torch7 SpatialConvolution has no asymmetric padding; "
+                f"cannot export pad_w={module.pad_w}, "
+                f"pad_h={module.pad_h} to .t7")
         w = np.asarray(p["weight"]).transpose(3, 2, 0, 1).copy()  # HWIO->OIHW
         fields = {
             "nInputPlane": module.n_input_plane,
