@@ -11,6 +11,7 @@ from bigdl_tpu.parallel.data_parallel import (
 from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
 from bigdl_tpu.parallel.ring_attention import (
     make_ring_attention, ring_attention, ulysses_attention,
+    zigzag_ring_attention,
 )
 from bigdl_tpu.parallel.tensor_parallel import (
     make_transformer_train_step, shard_params, slot_specs_for,

@@ -54,14 +54,17 @@ def _chunk_stats(q, k, v, sm_scale, q_off, k_off, causal):
     """Unnormalized attention of a Q chunk against one KV chunk.
 
     q: (B, H, Sq, D), k/v: (B, H, Sk, D); q_off/k_off are the chunks'
-    global sequence offsets (traced scalars are fine).
+    global sequence offsets — scalars for contiguous chunks, or (Sq,)/
+    (Sk,) position VECTORS for non-contiguous layouts (zigzag).
     Returns (o_unnorm (B,H,Sq,D), m (B,H,Sq), l (B,H,Sq)).
     """
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
-        qpos = q_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
-        kpos = k_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        qpos = (q_off[:, None] if jnp.ndim(q_off) == 1 else
+                q_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 0))
+        kpos = (k_off[None, :] if jnp.ndim(k_off) == 1 else
+                k_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 1))
         s = jnp.where(kpos <= qpos, s, _NEG_INF)
     m = jnp.max(s, axis=-1)                              # (B,H,Sq)
     p = jnp.exp(s - m[..., None])
@@ -124,6 +127,122 @@ def ring_attention(
     return (acc / safe_l).astype(q.dtype)
 
 
+def zigzag_positions(n: int, s_local: int):
+    """Global row positions device i holds under the zigzag layout.
+
+    Causal masking makes contiguous ring chunks unbalanced: device 0's
+    rows attend 1 chunk, device n-1's attend n — half the ring idles.
+    Zigzag gives each device TWO half-chunks, one from the front and
+    the mirrored one from the back (device i: half-chunks i and
+    2n-1-i), so every device's causal work is equal. Returns a list of
+    (s_local,) int arrays, one per device.
+    """
+    h = s_local // 2
+    return [jnp.concatenate([i * h + jnp.arange(h, dtype=jnp.int32),
+                             (2 * n - 1 - i) * h
+                             + jnp.arange(h, dtype=jnp.int32)])
+            for i in range(n)]
+
+
+def zigzag_ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis: str = "seq",
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Load-balanced CAUSAL ring attention. Call inside shard_map.
+
+    q, k, v: (B, H, S_local, D) in the ZIGZAG layout — device i holds
+    global rows [i·h, (i+1)·h) ∪ [(2n−1−i)·h, (2n−i)·h) with
+    h = S_local/2 (use `make_ring_attention(mode="zigzag")` for the
+    global-array wrapper that applies/undoes the permutation).
+
+    Unlike the contiguous causal ring — where the dense per-hop kernel
+    computes every (Sq×Sk) score and throws the masked half away — the
+    zigzag hop computes ONLY the visible half-blocks. The case analysis
+    for kv arriving from `src` (lo = front half-chunk, hi = mirrored
+    back half-chunk; positions lo(my) < lo(src<my) < n·h ≤ hi(any)):
+
+        hi_q · lo_k : fully visible for EVERY (my, src)   — always done
+        src < my    : + lo_q · lo_k fully visible
+        src > my    : + hi_q · hi_k fully visible
+        src == my   : + lo_q·lo_k and hi_q·hi_k, each diagonal
+
+    so every hop after the first costs exactly 2 unmasked half-blocks
+    on every device: half the dense ring's flops, perfectly balanced
+    (hop 0 is the src==my diagonal case on all devices simultaneously).
+    The per-device branch is a lax.switch on traced (src vs my) —
+    legal SPMD: devices run independent programs between ppermutes.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    n = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    s_local = q.shape[-2]
+    if s_local % 2:
+        raise ValueError("zigzag needs an even local sequence length")
+    h = s_local // 2
+    half = jnp.arange(h, dtype=jnp.int32)
+    q_lo, q_hi = q[..., :h, :], q[..., h:, :]
+
+    def state0(qh):
+        return (jnp.zeros(qh.shape[:-1] + (v.shape[-1],), jnp.float32),
+                jnp.full(qh.shape[:-1], _NEG_INF, jnp.float32),
+                jnp.zeros(qh.shape[:-1], jnp.float32))
+
+    lo, hi = state0(q_lo), state0(q_hi)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    kv = (k, v)
+    diag = (half[:, None] >= half[None, :])  # within-half causal mask
+
+    def attn_full(qh, kh, vh):
+        return _chunk_stats(qh, kh, vh, sm_scale, 0, 0, causal=False)
+
+    def attn_diag(qh, kh, vh):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh).astype(jnp.float32) \
+            * sm_scale
+        s = jnp.where(diag, s, _NEG_INF)
+        m = jnp.max(s, axis=-1)
+        p = jnp.exp(s - m[..., None])
+        l = jnp.sum(p, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(vh.dtype), vh)
+        return o.astype(jnp.float32), m, l
+
+    for i in range(n):
+        src = (my - i) % n
+        k_i, v_i = kv
+        k_lo, k_hi = k_i[..., :h, :], k_i[..., h:, :]
+        v_lo, v_hi = v_i[..., :h, :], v_i[..., h:, :]
+
+        # hi_q sees src's lo half in full, for every (my, src)
+        hi = _online_combine(*hi, *attn_full(q_hi, k_lo, v_lo))
+
+        def case_before(lo, hi):   # src < my: lo_q sees lo_k fully
+            return _online_combine(*lo, *attn_full(q_lo, k_lo, v_lo)), hi
+
+        def case_after(lo, hi):    # src > my: hi_q sees hi_k fully
+            return lo, _online_combine(*hi, *attn_full(q_hi, k_hi, v_hi))
+
+        def case_self(lo, hi):     # src == my: two diagonal halves
+            return (_online_combine(*lo, *attn_diag(q_lo, k_lo, v_lo)),
+                    _online_combine(*hi, *attn_diag(q_hi, k_hi, v_hi)))
+
+        idx = jnp.where(src == my, 2, jnp.where(src < my, 0, 1))
+        lo, hi = lax.switch(idx, [case_before, case_after, case_self],
+                            lo, hi)
+        if i != n - 1:
+            kv = jax.tree_util.tree_map(
+                lambda x: lax.ppermute(x, axis, perm), kv)
+
+    def finish(state, qh):
+        acc, m_acc, l_acc = state
+        safe_l = jnp.where(l_acc == 0.0, 1.0, l_acc)[..., None]
+        return (acc / safe_l).astype(qh.dtype)
+
+    return jnp.concatenate([finish(lo, q_lo), finish(hi, q_hi)], axis=-2)
+
+
 def ulysses_attention(
     q: jax.Array,
     k: jax.Array,
@@ -169,15 +288,44 @@ def make_ring_attention(
 ) -> Callable:
     """jit-ready wrapper: (q, k, v) global arrays sharded on the sequence
     axis → attention output with the same sharding. q,k,v: (B,H,S,D),
-    S divisible by the axis size."""
+    S divisible by the axis size.
+
+    mode: "ring" (contiguous chunks) | "ulysses" (all-to-all) |
+    "zigzag" (causal-only load-balanced ring: the wrapper permutes the
+    global sequence into the zigzag layout, runs the balanced ring, and
+    inverse-permutes the output — callers keeping their data in zigzag
+    layout end-to-end should call `zigzag_ring_attention` inside their
+    own shard_map instead and skip both permutes)."""
+    if mode == "zigzag" and not causal:
+        raise ValueError("zigzag balancing only applies to causal "
+                         "attention; use mode='ring'")
 
     def body(q, k, v):
         if mode == "ring":
             return ring_attention(q, k, v, axis=axis, causal=causal)
+        if mode == "zigzag":
+            return zigzag_ring_attention(q, k, v, axis=axis)
         return ulysses_attention(q, k, v, axis=axis, causal=causal,
                                  impl=impl)
 
     spec = P(None, None, axis, None)
     smapped = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                         out_specs=spec, check_vma=False)
-    return jax.jit(smapped)
+    fn = jax.jit(smapped)
+    if mode != "zigzag":
+        return fn
+
+    n = mesh.shape[axis]
+
+    def zig(q, k, v):
+        s = q.shape[2]
+        if s % (2 * n):
+            raise ValueError(
+                f"zigzag needs sequence length divisible by 2·{n} "
+                f"(two half-chunks per device), got {s}")
+        order = jnp.concatenate(zigzag_positions(n, s // n))
+        inv = jnp.argsort(order)
+        out = fn(q[:, :, order], k[:, :, order], v[:, :, order])
+        return out[:, :, inv]
+
+    return jax.jit(zig)

@@ -125,6 +125,7 @@ CANON = {
     "View": (lambda: nn.View(2, 4), (x2,)),
     "SpatialZeroPadding": (lambda: nn.SpatialZeroPadding(1, 1, 1, 1),
                            (img,)),
+    "SpaceToDepth": (lambda: nn.SpaceToDepth(2), (img,)),
     # table ops
     "CAddTable": (lambda: nn.CAddTable(), ((x2, x2b),)),
     "CSubTable": (lambda: nn.CSubTable(), ((x2, x2b),)),
