@@ -196,6 +196,26 @@ class GRU(Cell):
         h = (1.0 - z) * carry + z * cand
         return h, h
 
+    # ---- hoisted-input protocol (see Recurrent.apply / LSTM) --------
+    # Both GRU matmuls split into a time-independent x half (hoisted to
+    # one full-sequence MXU matmul) and a recurrent h half.
+
+    def precompute_inputs(self, params, x):
+        d = self.input_size
+        zx = x @ params["gates"]["weight"][:d] + params["gates"]["bias"]
+        cx = x @ params["cand"]["weight"][:d] + params["cand"]["bias"]
+        return jnp.concatenate([zx, cx], axis=-1)  # (N, T, 3H)
+
+    def step_precomputed(self, params, carry, z_t, training=False,
+                         rng=None):
+        d, h = self.input_size, self.hidden_size
+        zx, cx = z_t[..., :2 * h], z_t[..., 2 * h:]
+        zr = zx + carry @ params["gates"]["weight"][d:]
+        z, r = jnp.split(jax.nn.sigmoid(zr), 2, axis=-1)
+        cand = jnp.tanh(cx + (r * carry) @ params["cand"]["weight"][d:])
+        h_new = (1.0 - z) * carry + z * cand
+        return h_new, h_new
+
 
 class Recurrent(Module):
     """Drive a cell across time with `lax.scan`

@@ -183,3 +183,21 @@ def test_hoisted_scan_matches_unhoisted():
     # BiRecurrent exposes the knob too
     bi = nn.BiRecurrent(nn.LSTM(5, 6), hoist_inputs=False)
     assert not bi.fwd.hoist_inputs and not bi.bwd.hoist_inputs
+
+
+def test_gru_hoisted_matches_unhoisted():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 9, 4).astype(np.float32))
+    ref = None
+    for hoist in (True, False):
+        m = nn.Recurrent(nn.GRU(4, 5), hoist_inputs=hoist)
+        v = m.init(jax.random.PRNGKey(2))
+        out, _ = m.apply(v, x)
+        if ref is None:
+            ref = out
+        else:
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=1e-5, atol=1e-6)
