@@ -88,6 +88,12 @@ def _load():
         lib.bdl_prefetcher_next.argtypes = [ctypes.c_void_p, f32p, i32p]
         lib.bdl_prefetcher_destroy.argtypes = [ctypes.c_void_p]
         try:
+            lib.bdl_resize_bilinear.argtypes = [f32p, f32p] + \
+                [ctypes.c_int] * 6
+            lib._has_resize = True
+        except AttributeError:
+            lib._has_resize = False
+        try:
             # newer symbols — a prebuilt .so from an older source tree
             # may lack them; the rest of the native plane still works
             lib.bdl_file_prefetcher_create.argtypes = [
@@ -151,6 +157,24 @@ def normalize_u8(images: np.ndarray, mean: Sequence[float],
     lib.bdl_normalize_u8(_u8(images), _f32(out),
                          images.size // c, c, _f32(mean), _f32(std),
                          n_threads)
+    return out
+
+
+def resize_bilinear(img: np.ndarray, out_h: int, out_w: int,
+                    n_threads: int = 1) -> Optional[np.ndarray]:
+    """f32 HWC bilinear resize (align_corners=False) in C++, or None
+    when the native plane is unavailable (caller falls back to numpy —
+    measured 12x slower per core for 256→224, PROFILE_r04)."""
+    lib = _load()
+    if lib is None or not getattr(lib, "_has_resize", False):
+        return None
+    img = np.ascontiguousarray(img, np.float32)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    h, w, c = img.shape
+    out = np.empty((out_h, out_w, c), np.float32)
+    lib.bdl_resize_bilinear(_f32(img), _f32(out), h, w, c, out_h, out_w,
+                            n_threads)
     return out
 
 

@@ -100,6 +100,61 @@ void bdl_shift_crop(const float* src, float* dst, const int* offy,
   }
 }
 
+// f32 HWC bilinear resize (align_corners=False, the TF/torch default):
+// src (h, w, c) -> dst (oh, ow, c). Multithreaded over output rows.
+// Matches dataset/vision.py's pure-numpy implementation.
+void bdl_resize_bilinear(const float* src, float* dst, int h, int w,
+                         int c, int oh, int ow, int n_threads) {
+  const float sy = static_cast<float>(h) / oh;
+  const float sx = static_cast<float>(w) / ow;
+  auto work = [&](int lo, int hi) {
+    std::vector<int> x0s(ow), x1s(ow);
+    std::vector<float> fxs(ow);
+    for (int x = 0; x < ow; ++x) {
+      float fx = (x + 0.5f) * sx - 0.5f;
+      if (fx < 0) fx = 0;
+      int x0 = static_cast<int>(fx);
+      x0s[x] = x0;
+      x1s[x] = std::min(x0 + 1, w - 1);
+      fxs[x] = fx - x0;
+    }
+    for (int y = lo; y < hi; ++y) {
+      float fy = (y + 0.5f) * sy - 0.5f;
+      if (fy < 0) fy = 0;
+      int y0 = static_cast<int>(fy);
+      int y1 = std::min(y0 + 1, h - 1);
+      float wy = fy - y0;
+      const float* r0 = src + static_cast<int64_t>(y0) * w * c;
+      const float* r1 = src + static_cast<int64_t>(y1) * w * c;
+      float* out = dst + static_cast<int64_t>(y) * ow * c;
+      for (int x = 0; x < ow; ++x) {
+        const float* a = r0 + x0s[x] * c;
+        const float* b = r0 + x1s[x] * c;
+        const float* d = r1 + x0s[x] * c;
+        const float* e = r1 + x1s[x] * c;
+        float wx = fxs[x];
+        for (int ch = 0; ch < c; ++ch) {
+          float top = a[ch] + (b[ch] - a[ch]) * wx;
+          float bot = d[ch] + (e[ch] - d[ch]) * wx;
+          out[x * c + ch] = top + (bot - top) * wy;
+        }
+      }
+    }
+  };
+  if (n_threads < 2 || oh < 2 * n_threads) {
+    work(0, oh);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int chunk = (oh + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int lo = t * chunk, hi = std::min(oh, lo + chunk);
+    if (lo >= hi) break;
+    ts.emplace_back(work, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
 // ---------------------------------------------------------------- decoders
 
 // IDX3 images: returns 0 on success; out must hold n*rows*cols bytes.

@@ -3,10 +3,13 @@ paths the CPU test suite can only exercise in interpret/simulation mode:
 the Pallas flash-attention kernel lowering, bf16 training numerics, and
 fenced throughput sanity. Usage: python scripts/validate_tpu.py"""
 
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def main():

@@ -282,3 +282,17 @@ def test_file_prefetcher_u8_mode(tmp_path, have_native):
         match = (images == img[j]).all(axis=(1, 2, 3))
         assert match.any()
     p.close()
+
+
+def test_native_resize_matches_numpy(have_native):
+    import unittest.mock as mock
+
+    from bigdl_tpu.dataset import vision
+
+    rng = np.random.RandomState(2)
+    img = rng.randint(0, 255, (37, 53, 3)).astype(np.float32)
+    fast = native.resize_bilinear(img, 24, 31)
+    assert fast is not None and fast.shape == (24, 31, 3)
+    with mock.patch.object(native, "resize_bilinear", return_value=None):
+        slow = vision._bilinear_resize(img, 24, 31)
+    np.testing.assert_allclose(fast, slow, atol=1e-3, rtol=1e-5)
