@@ -114,18 +114,34 @@ class TransformerLM(Module):
     axis — apply() must then be called inside shard_map with the
     sequence dimension sharded on `sp_axis` (positional embeddings are
     offset by the shard's global position automatically).
+
+    `sp_mode`: "ring" (contiguous chunks) or "zigzag" — the causal
+    load-balanced layout: device i holds global rows [i·h, (i+1)·h) ∪
+    [(2n−1−i)·h, (2n−i)·h), every ring hop computes only visible
+    half-blocks (half the causal flops, equal per-device work;
+    parallel/ring_attention.py). Callers must feed tokens/targets
+    PERMUTED into that layout — make_transformer_train_step does this
+    when built with sp_mode="zigzag" (the LM loss is a mean over
+    positions, so the permutation leaves it unchanged); positional
+    embeddings are gathered by the zigzag position vector here.
     """
 
     def __init__(self, config: TransformerConfig,
                  sp_axis: Optional[str] = None,
                  tp_axis: Optional[str] = None,
                  attn_impl: Optional[str] = None,
+                 sp_mode: str = "ring",
                  name: Optional[str] = None):
         super().__init__(name=name)
         self.cfg = config
         self.sp_axis = sp_axis
         self.tp_axis = tp_axis
         self.attn_impl = attn_impl
+        if sp_mode not in ("ring", "zigzag"):
+            raise ValueError(f"sp_mode must be ring|zigzag, got {sp_mode}")
+        if sp_mode == "zigzag" and not config.causal:
+            raise ValueError("zigzag sp_mode requires a causal model")
+        self.sp_mode = sp_mode
         if config.dim % config.num_heads:
             raise ValueError("dim must be divisible by num_heads")
         self.head_dim = config.dim // config.num_heads
@@ -174,9 +190,12 @@ class TransformerLM(Module):
 
     def _attention(self, q, k, v):
         from bigdl_tpu.ops.flash_attention import flash_attention
-        from bigdl_tpu.parallel.ring_attention import ring_attention
+        from bigdl_tpu.parallel.ring_attention import (
+            ring_attention, zigzag_ring_attention)
 
         if self.sp_axis is not None:
+            if self.sp_mode == "zigzag":
+                return zigzag_ring_attention(q, k, v, axis=self.sp_axis)
             return ring_attention(q, k, v, axis=self.sp_axis,
                                   causal=self.cfg.causal)
         return flash_attention(q, k, v, causal=self.cfg.causal,
@@ -244,7 +263,23 @@ class TransformerLM(Module):
         p = variables["params"]
         s = tokens.shape[-1]
 
-        if self.sp_axis is not None:
+        if self.sp_axis is not None and self.sp_mode == "zigzag":
+            # zigzag layout: gather positions for half-chunks my and
+            # 2n-1-my (rows arrive already permuted by the caller;
+            # layout invariant lives in parallel/ring_attention.py)
+            from bigdl_tpu.parallel.ring_attention import zigzag_positions
+
+            if s % 2:
+                raise ValueError(
+                    f"zigzag sp_mode needs an even local sequence "
+                    f"length, got {s}")
+            n = lax.axis_size(self.sp_axis)
+            my = lax.axis_index(self.sp_axis)
+            # positions(i) for traced i: both half starts are affine
+            # in the device index, so index the stacked table
+            zpos_table = jnp.stack(zigzag_positions(n, s))
+            pos = p["pos"][zpos_table[my]]
+        elif self.sp_axis is not None:
             pos_off = lax.axis_index(self.sp_axis) * s
             pos = lax.dynamic_slice_in_dim(p["pos"], pos_off, s, axis=0)
         else:

@@ -144,6 +144,20 @@ def zigzag_positions(n: int, s_local: int):
             for i in range(n)]
 
 
+def zigzag_order(n: int, s: int) -> jax.Array:
+    """Global gather order for the zigzag layout over the full sequence
+    (concatenation of every device's zigzag_positions), with the
+    divisibility check every entry point needs. THE single source of
+    the layout invariant — the model's pos gather, the train-step feed
+    permutation, and the attention wrapper all use this module's
+    functions, so a layout change stays in one place."""
+    if s % (2 * n):
+        raise ValueError(
+            f"zigzag needs sequence length divisible by 2·{n} "
+            f"(two half-chunks per device), got {s}")
+    return jnp.concatenate(zigzag_positions(n, s // n))
+
+
 def zigzag_ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -318,12 +332,7 @@ def make_ring_attention(
     n = mesh.shape[axis]
 
     def zig(q, k, v):
-        s = q.shape[2]
-        if s % (2 * n):
-            raise ValueError(
-                f"zigzag needs sequence length divisible by 2·{n} "
-                f"(two half-chunks per device), got {s}")
-        order = jnp.concatenate(zigzag_positions(n, s // n))
+        order = zigzag_order(n, q.shape[2])
         inv = jnp.argsort(order)
         out = fn(q[:, :, order], k[:, :, order], v[:, :, order])
         return out[:, :, inv]

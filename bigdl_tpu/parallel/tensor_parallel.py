@@ -142,7 +142,24 @@ def make_transformer_train_step(
         out_specs=(specs, slot_specs, P()),
         check_vma=False,
     )
-    return jax.jit(smapped, donate_argnums=(0, 1))
+    step = jax.jit(smapped, donate_argnums=(0, 1))
+    if sp_axis is None or getattr(model, "sp_mode", "ring") != "zigzag":
+        return step
+
+    # zigzag SP: permute tokens/targets into the balanced layout before
+    # the shard_map (the LM loss is a mean over positions, so the
+    # consistent permutation leaves it — and every gradient — exactly
+    # equal to the contiguous-layout step)
+    from bigdl_tpu.parallel.ring_attention import zigzag_order
+
+    n_sp = mesh.shape[sp_axis]
+
+    def zig_step(params, slots, tokens, targets, lr, stepno, rng):
+        order = zigzag_order(n_sp, tokens.shape[1])
+        return step(params, slots, tokens[:, order], targets[:, order],
+                    lr, stepno, rng)
+
+    return jax.jit(zig_step, donate_argnums=(0, 1))
 
 
 def slot_specs_for(method, specs):
