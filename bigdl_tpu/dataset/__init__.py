@@ -12,4 +12,8 @@ from bigdl_tpu.dataset import image, native, text, mnist, cifar, vision
 from bigdl_tpu.dataset.records import (
     RecordFileDataSet, read_header, resolve_shards, write_shards,
 )
+from bigdl_tpu.dataset.tfrecord import (
+    TFRecordDataSet, decode_example, encode_example, read_tfrecords,
+    write_image_examples, write_tfrecords,
+)
 from bigdl_tpu.dataset.vision import ImageFeature, ImageFrame
