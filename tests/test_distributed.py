@@ -345,3 +345,46 @@ class TestStandaloneMeshEvaluator:
             mv, mc = mesh[name].result()
             assert lc == mc, (name, lc, mc)
             np.testing.assert_allclose(lv, mv, rtol=1e-5, atol=1e-6)
+
+
+class TestSyncBatchNorm:
+    def test_sync_bn_equals_full_batch_bn(self, mesh8):
+        """sync=True BN inside shard_map == BN over the FULL batch on
+        one device. Round 4 made this exact: averaging E[x] and E[x^2]
+        across replicas yields the true global variance (the old
+        averaged-local-variance form only approximated it)."""
+        try:
+            from jax import shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        bn_sync = nn.SpatialBatchNormalization(3, sync=True,
+                                               axis_name="data")
+        bn_ref = nn.SpatialBatchNormalization(3)
+        v = bn_ref.init(KEY)
+        x = jax.random.normal(jax.random.PRNGKey(4), (16, 4, 4, 3)) \
+            * 3.0 + 1.0
+
+        ref, ref_state = bn_ref.apply(v, x, training=True)
+
+        def body(x_local):
+            y, st = bn_sync.apply(v, x_local, training=True)
+            return y, st
+
+        fn = jax.jit(shard_map(
+            body, mesh=mesh8,
+            in_specs=P("data", None, None, None),
+            out_specs=(P("data", None, None, None), P()),
+            check_vma=False))
+        out, state = fn(jax.device_put(
+            x, NamedSharding(mesh8, P("data", None, None, None))))
+
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(state["running_mean"]),
+            np.asarray(ref_state["running_mean"]), atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(state["running_var"]),
+            np.asarray(ref_state["running_var"]), atol=1e-5)
