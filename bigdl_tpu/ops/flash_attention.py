@@ -326,23 +326,17 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q,
-                      block_k, interpret):
-    """Flash backward as two Mosaic kernels: dq over a (bh, q, kv) grid,
-    dk/dv over a (bh, kv, q) grid, both recomputing probabilities from
-    the forward's log-sum-exp (nothing S×S in HBM)."""
-    from jax.experimental.pallas import tpu as pltpu
-
-    bh, seq_q, dim = q.shape
-    seq_k = k.shape[1]
-
+def _bwd_prep(q, k, v, o, lse, do, block_q, block_k):
+    """Shared backward setup (fused AND split wrappers): pad operands to
+    block/lane multiples, precompute delta = sum(do*o), reshape lse and
+    delta to the (BH, 1, sq) layout Mosaic accepts, and build the
+    (bh, kv, q)-grid input BlockSpecs."""
     qp = _pad_to(_pad_to(q, 1, block_q), 2, 128)
     dop = _pad_to(_pad_to(do, 1, block_q), 2, 128)
     kp = _pad_to(_pad_to(k, 1, block_k), 2, 128)
     vp = _pad_to(_pad_to(v, 1, block_k), 2, 128)
     sq, dp_ = qp.shape[1], qp.shape[2]
     sk = kp.shape[1]
-    num_q, num_kv = sq // block_q, sk // block_k
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                                 # (BH, Sq)
@@ -351,6 +345,180 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q,
     lse_p = _pad_to(lse.astype(jnp.float32), 1, block_q)[:, None, :]
     delta_p = _pad_to(delta, 1, block_q)[:, None, :]
 
+    col_specs = [
+        pl.BlockSpec((1, block_q, dp_), lambda b, j, i: (b, i, 0)),   # q
+        pl.BlockSpec((1, block_k, dp_), lambda b, j, i: (b, j, 0)),   # k
+        pl.BlockSpec((1, block_k, dp_), lambda b, j, i: (b, j, 0)),   # v
+        pl.BlockSpec((1, block_q, dp_), lambda b, j, i: (b, i, 0)),   # do
+        pl.BlockSpec((1, 1, sq), lambda b, j, i: (b, 0, 0)),          # lse
+        pl.BlockSpec((1, 1, sq), lambda b, j, i: (b, 0, 0)),          # delta
+    ]
+    return (qp, kp, vp, dop, lse_p, delta_p, sq, sk, dp_, col_specs)
+
+
+def _fa_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dk_ref, dv_ref, dq_scr, dk_scr, dv_scr,
+                         *, sm_scale, causal, block_q, block_k, seq_q,
+                         seq_k, num_q, num_kv):
+    """Single-pass backward: dk/dv over the (bh, kv, q) grid as before,
+    with dq accumulated IN the same pass.
+
+    The trick that makes one pass legal under Mosaic's output-revisit
+    semantics: dq's output block is the WHOLE (seq, D) row plane with
+    index map (b, 0, 0) — it never changes within a batch-head, so the
+    block stays resident in VMEM across every (kv, q) cell and is
+    flushed exactly once per bh. Each cell adds its ds·k contribution
+    to the dq row-slice in a full-sequence f32 scratch, and the row
+    slice is emitted during the final kv sweep. One s/p/ds recompute
+    per tile instead of the two the split dq/dkv kernels pay, and half
+    the grid cells.
+    """
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when((ki == 0) & (qi == 0))
+    def _init_dq():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(qi == 0)
+    def _init_dkv():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _compute():
+        q, k, do, p, ds = _bwd_recompute(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_start,
+            k_start, sm_scale, causal, block_q, block_k, seq_q, seq_k)
+        dv_scr[:] = dv_scr[:] + lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, D)
+        dk_scr[:] = dk_scr[:] + lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dq_scr[pl.dslice(q_start, block_q)] = \
+            dq_scr[pl.dslice(q_start, block_q)] + lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(q_start + block_q - 1 + (seq_k - seq_q) >= k_start)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(qi == num_q - 1)
+    def _finalize_dkv():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+    # dq row-block i has received every contribution once the kv sweep
+    # is past its diagonal; emitting during the LAST kv sweep is always
+    # safe (later sweeps add nothing above the diagonal)
+    @pl.when(ki == num_kv - 1)
+    def _finalize_dq():
+        dq_ref[0, pl.dslice(q_start, block_q)] = \
+            dq_scr[pl.dslice(q_start, block_q)].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas_fused(q, k, v, o, lse, do, causal, sm_scale,
+                            block_q, block_k, interpret):
+    """One-kernel Mosaic backward (see _fa_bwd_fused_kernel). Falls
+    back to the two-kernel form for very long sequences where the
+    full-sequence dq scratch would crowd VMEM
+    (_flash_bwd_pallas caller decides)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, seq_q, dim = q.shape
+    seq_k = k.shape[1]
+    (qp, kp, vp, dop, lse_p, delta_p, sq, sk, dp_,
+     col_specs) = _bwd_prep(q, k, v, o, lse, do, block_q, block_k)
+    num_q, num_kv = sq // block_q, sk // block_k
+
+    dq_p, dk_p, dv_p = pl.pallas_call(
+        functools.partial(
+            _fa_bwd_fused_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_q=seq_q, seq_k=seq_k,
+            num_q=num_q, num_kv=num_kv),
+        grid=(bh, num_kv, num_q),
+        in_specs=col_specs,
+        out_specs=[
+            # whole dq row plane per bh: index map constant in (j, i),
+            # so the block is flushed once per batch-head
+            pl.BlockSpec((1, sq, dp_), lambda b, j, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, dp_), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dp_), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, dp_), q.dtype),
+            jax.ShapeDtypeStruct((bh, sk, dp_), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, dp_), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((sq, dp_), jnp.float32),
+                        pltpu.VMEM((block_k, dp_), jnp.float32),
+                        pltpu.VMEM((block_k, dp_), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse_p, delta_p)
+
+    return (dq_p[:, :seq_q, :dim], dk_p[:, :seq_k, :dim],
+            dv_p[:, :seq_k, :dim])
+
+
+# Above this, the fused kernel's full-sequence VMEM residents (f32 dq
+# scratch + dq output block in q.dtype) would crowd VMEM; use the
+# two-kernel backward instead. 13 MiB admits the largest measured-good
+# config (bf16 S=16384, D=64→128: 12.6 MiB resident, 70.9k tok/s —
+# PROFILE_r04) while sending f32 S=16384 (16.8 MiB) to the split form.
+_FUSED_BWD_MAX_RESIDENT_BYTES = 13 * 1024 * 1024
+
+
+_FUSED_BWD_MAX_TILE = 1024 * 512  # bq*bk above this fails to compile
+                                  # (s-tile + dq scratch exceed VMEM)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q,
+                      block_k, interpret):
+    sq_padded = ((q.shape[1] + block_q - 1) // block_q) * block_q
+    dp_padded = ((q.shape[2] + 127) // 128) * 128
+    # fused-path VMEM residents that scale with the FULL sequence: the
+    # f32 dq scratch AND the dq output block (q.dtype) — both stay live
+    # per batch-head
+    resident = sq_padded * dp_padded * (4 + q.dtype.itemsize)
+    if resident <= _FUSED_BWD_MAX_RESIDENT_BYTES:
+        # the fused kernel's per-cell tiles cap lower than the split
+        # kernels'. Tie-break shrinks the Q tile first: measured at the
+        # 186M shape, 512x1024 beats 1024x512 (59.5k vs 57.9k tok/s,
+        # PROFILE_r04/ANALYSIS.md) — the serial kv loop amortizes
+        # better with a WIDE kv tile.
+        fb_q, fb_k = block_q, block_k
+        while fb_q * fb_k > _FUSED_BWD_MAX_TILE:
+            if fb_q >= fb_k:
+                fb_q //= 2
+            else:
+                fb_k //= 2
+        return _flash_bwd_pallas_fused(q, k, v, o, lse, do, causal,
+                                       sm_scale, fb_q, fb_k, interpret)
+    return _flash_bwd_pallas_split(q, k, v, o, lse, do, causal, sm_scale,
+                                   block_q, block_k, interpret)
+
+
+def _flash_bwd_pallas_split(q, k, v, o, lse, do, causal, sm_scale,
+                            block_q, block_k, interpret):
+    """Flash backward as two Mosaic kernels: dq over a (bh, q, kv) grid,
+    dk/dv over a (bh, kv, q) grid, both recomputing probabilities from
+    the forward's log-sum-exp (nothing S×S in HBM)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, seq_q, dim = q.shape
+    seq_k = k.shape[1]
+    (qp, kp, vp, dop, lse_p, delta_p, sq, sk, dp_,
+     col_specs) = _bwd_prep(q, k, v, o, lse, do, block_q, block_k)
+    num_q, num_kv = sq // block_q, sk // block_k
+
+    # dq kernel iterates (bh, q, kv): same specs, swapped grid axes
     row_specs = [
         pl.BlockSpec((1, block_q, dp_), lambda b, i, j: (b, i, 0)),   # q
         pl.BlockSpec((1, block_k, dp_), lambda b, i, j: (b, j, 0)),   # k
@@ -372,14 +540,6 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q,
         interpret=interpret,
     )(qp, kp, vp, dop, lse_p, delta_p)
 
-    col_specs = [
-        pl.BlockSpec((1, block_q, dp_), lambda b, j, i: (b, i, 0)),   # q
-        pl.BlockSpec((1, block_k, dp_), lambda b, j, i: (b, j, 0)),   # k
-        pl.BlockSpec((1, block_k, dp_), lambda b, j, i: (b, j, 0)),   # v
-        pl.BlockSpec((1, block_q, dp_), lambda b, j, i: (b, i, 0)),   # do
-        pl.BlockSpec((1, 1, sq), lambda b, j, i: (b, 0, 0)),          # lse
-        pl.BlockSpec((1, 1, sq), lambda b, j, i: (b, 0, 0)),          # delta
-    ]
     dk_p, dv_p = pl.pallas_call(
         functools.partial(
             _fa_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,

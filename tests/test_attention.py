@@ -288,3 +288,53 @@ class TestMosaicBackwardEdgeShapes:
                 q, k, v, causal=True, sm_scale=scale).sum())(q)
             np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                        rtol=2e-4, atol=2e-5)
+
+
+class TestFusedBackward:
+    """The one-pass backward (persistent dq accumulator) must equal the
+    two-kernel form bit-for-bit-ish at any shape both can run."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_fused_equals_split(self, causal):
+        import importlib
+        fa = importlib.import_module("bigdl_tpu.ops.flash_attention")
+
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(2, 64, 16).astype(np.float32))
+        k = jnp.asarray(rng.randn(2, 64, 16).astype(np.float32))
+        v = jnp.asarray(rng.randn(2, 64, 16).astype(np.float32))
+        o, lse = fa._flash_fwd_pallas(q, k, v, causal, 0.25, 32, 32,
+                                      interpret=True)
+        do = jnp.asarray(rng.randn(2, 64, 16).astype(np.float32))
+        fused = fa._flash_bwd_pallas_fused(q, k, v, o, lse, do, causal,
+                                           0.25, 32, 32, interpret=True)
+        split = fa._flash_bwd_pallas_split(q, k, v, o, lse, do, causal,
+                                           0.25, 32, 32, interpret=True)
+        for a, b, name in zip(fused, split, ("dq", "dk", "dv")):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-5,
+                                       err_msg=name)
+
+    def test_long_sequence_falls_back_to_split(self, monkeypatch):
+        import importlib
+        fa = importlib.import_module("bigdl_tpu.ops.flash_attention")
+
+        calls = []
+        monkeypatch.setattr(
+            fa, "_flash_bwd_pallas_split",
+            lambda *a, **k: calls.append("split") or
+            (a[0], a[1], a[2]))
+        monkeypatch.setattr(
+            fa, "_flash_bwd_pallas_fused",
+            lambda *a, **k: calls.append("fused") or
+            (a[0], a[1], a[2]))
+        small = jnp.zeros((1, 128, 64))
+        fa._flash_bwd_pallas(small, small, small, small,
+                             jnp.zeros((1, 128)), small, True, 1.0,
+                             128, 128, True)
+        # 8M / (128 lanes * 4B) = 16384 rows: S beyond that splits
+        big = jnp.zeros((1, 32768, 64))
+        fa._flash_bwd_pallas(big, big, big, big,
+                             jnp.zeros((1, 32768)), big, True, 1.0,
+                             1024, 1024, True)
+        assert calls == ["fused", "split"]
