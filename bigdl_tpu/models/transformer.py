@@ -99,12 +99,23 @@ class TransformerConfig:
     # .dots_with_no_batch_dims_saveable) — most of the memory win at a
     # fraction of the recompute FLOPs
     remat_policy: str = "full"
+    # Switch/GShard-MoE FFN: moe_experts > 0 replaces EVERY block's MLP
+    # with a routed mixture of moe_experts expert MLPs (parallel/moe.py
+    # routing math; homogeneous across layers so the block scan stays
+    # one compiled body). The auxiliary load-balancing loss is summed
+    # over layers and added to .loss() scaled by moe_aux_weight.
+    moe_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     def __post_init__(self):
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(
                 f"remat_policy {self.remat_policy!r}: expected 'full' "
                 "or 'dots'")
+        if self.moe_experts and self.moe_top_k not in (1, 2):
+            raise ValueError("moe_top_k must be 1 or 2")
 
 
 class TransformerLM(Module):
@@ -142,6 +153,20 @@ class TransformerLM(Module):
         if sp_mode == "zigzag" and not config.causal:
             raise ValueError("zigzag sp_mode requires a causal model")
         self.sp_mode = sp_mode
+        if config.moe_experts:
+            if tp_axis is not None:
+                raise NotImplementedError(
+                    "MoE FFN under tensor parallelism (expert "
+                    "parallelism shards experts instead; see "
+                    "parallel/moe.py)")
+            from bigdl_tpu.parallel.moe import MoE
+
+            # routing/dispatch math only; its params are the per-layer
+            # slices of the stacked block weights
+            self._moe = MoE(config.dim, config.dim * config.mlp_ratio,
+                            config.moe_experts,
+                            capacity_factor=config.moe_capacity_factor,
+                            top_k=config.moe_top_k, name="moe_ffn")
         if config.dim % config.num_heads:
             raise ValueError("dim must be divisible by num_heads")
         self.head_dim = config.dim // config.num_heads
@@ -165,11 +190,23 @@ class TransformerLM(Module):
             "bq": jnp.zeros((l, e)), "bk": jnp.zeros((l, e)),
             "bv": jnp.zeros((l, e)), "bo": jnp.zeros((l, e)),
             "ln2_g": jnp.ones((l, e)), "ln2_b": jnp.zeros((l, e)),
-            "w1": norm(next(keys), (l, e, f), e),
-            "b1": jnp.zeros((l, f)),
-            "w2": norm(next(keys), (l, f, e), f),
-            "b2": jnp.zeros((l, e)),
         }
+        if c.moe_experts:
+            ex = c.moe_experts
+            blocks.update({
+                "router": norm(next(keys), (l, e, ex), e),
+                "w1": norm(next(keys), (l, ex, e, f), e),
+                "b1": jnp.zeros((l, ex, f)),
+                "w2": norm(next(keys), (l, ex, f, e), f),
+                "b2": jnp.zeros((l, ex, e)),
+            })
+        else:
+            blocks.update({
+                "w1": norm(next(keys), (l, e, f), e),
+                "b1": jnp.zeros((l, f)),
+                "w2": norm(next(keys), (l, f, e), f),
+                "b2": jnp.zeros((l, e)),
+            })
         p = {
             "embed": jax.random.normal(next(keys),
                                        (c.vocab_size, e)) * 0.02,
@@ -238,22 +275,32 @@ class TransformerLM(Module):
         x = x + a
 
         y = self._ln(x, bp["ln2_g"], bp["ln2_b"])
-        if self.tp_axis is not None:
-            y = tp_identity(y, self.tp_axis)
-        y = jax.nn.gelu(y @ bp["w1"] + bp["b1"])
-        y = y @ bp["w2"]                      # row-parallel: partial sums
-        if self.tp_axis is not None:
-            y = tp_reduce(y, self.tp_axis)
-        y = y + bp["b2"]
+        aux = jnp.zeros((), jnp.float32)
+        if c.moe_experts:
+            moe_p = {"router": bp["router"], "w1": bp["w1"],
+                     "b1": bp["b1"], "w2": bp["w2"], "b2": bp["b2"]}
+            (y, aux), _ = self._moe.apply({"params": moe_p, "state": {}},
+                                          y)
+        else:
+            if self.tp_axis is not None:
+                y = tp_identity(y, self.tp_axis)
+            y = jax.nn.gelu(y @ bp["w1"] + bp["b1"])
+            y = y @ bp["w2"]                  # row-parallel: partial sums
+            if self.tp_axis is not None:
+                y = tp_reduce(y, self.tp_axis)
+            y = y + bp["b2"]
         if training and c.dropout > 0.0:
             keep = 1.0 - c.dropout
             k2, _ = jax.random.split(dropout_rng)
             y = jnp.where(jax.random.bernoulli(k2, keep, y.shape),
                           y, 0.0) / keep
-        return x + y
+        return x + y, aux
 
-    def apply_hidden(self, variables, tokens, training=False, rng=None):
+    def apply_hidden(self, variables, tokens, training=False, rng=None,
+                     with_aux=False):
         """Forward up to the final LayerNorm: (B, S) int → (B, S, E).
+        `with_aux=True` also returns the summed MoE load-balancing
+        auxiliary (0.0 for dense configs).
 
         The training hot path: pair with `head(variables)` and
         `ops.losses.softmax_cross_entropy_chunked` so the (B, S, V)
@@ -290,9 +337,11 @@ class TransformerLM(Module):
             raise ValueError(f"{self.name}: dropout needs rng in training")
         base_rng = rng if rng is not None else jax.random.PRNGKey(0)
 
-        def body(x, layer):
+        def body(carry, layer):
+            x, aux_sum = carry
             bp, lrng = layer
-            return self._block(x, bp, lrng, training), None
+            x, aux = self._block(x, bp, lrng, training)
+            return (x, aux_sum + aux), None
 
         if c.remat:
             if c.remat_policy == "dots":
@@ -302,9 +351,13 @@ class TransformerLM(Module):
             else:
                 body = jax.checkpoint(body)
         layer_rngs = jax.random.split(base_rng, c.num_layers)
-        x, _ = lax.scan(body, x, (p["blocks"], layer_rngs))
+        (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
+                               (p["blocks"], layer_rngs))
 
-        return self._ln(x, p["lnf_g"], p["lnf_b"])
+        h = self._ln(x, p["lnf_g"], p["lnf_b"])
+        if with_aux:
+            return h, aux
+        return h
 
     def head(self, variables):
         """The (E, V) output projection (weight-tied to the embedding
@@ -318,10 +371,14 @@ class TransformerLM(Module):
         log-probs (ops/losses.softmax_cross_entropy_chunked)."""
         from bigdl_tpu.ops.losses import softmax_cross_entropy_chunked
 
-        hidden = self.apply_hidden(variables, tokens, training=training,
-                                   rng=rng)
-        return softmax_cross_entropy_chunked(hidden, self.head(variables),
-                                             targets, chunk=chunk)
+        hidden, aux = self.apply_hidden(variables, tokens,
+                                        training=training, rng=rng,
+                                        with_aux=True)
+        nll = softmax_cross_entropy_chunked(hidden, self.head(variables),
+                                            targets, chunk=chunk)
+        if self.cfg.moe_experts:
+            return nll + self.cfg.moe_aux_weight * aux
+        return nll
 
     def apply(self, variables, tokens, training=False, rng=None):
         x = self.apply_hidden(variables, tokens, training=training,

@@ -398,10 +398,16 @@ class ChunkedSoftmaxCE(Criterion):
                     "model carries non-empty state, which the fused "
                     "path would not update; use a stateless LM or the "
                     "plain LogSoftMax+criterion path")
-            hidden = model.apply_hidden(variables, x, training=True,
-                                        rng=rng)
-            loss = softmax_cross_entropy_chunked(
-                hidden, model.head(variables), targets, chunk=chunk)
+            if hasattr(model, "loss"):
+                # the model's own fused loss — includes model-specific
+                # terms (e.g. the MoE load-balancing auxiliary)
+                loss = model.loss(variables, x, targets, training=True,
+                                  rng=rng, chunk=chunk)
+            else:
+                hidden = model.apply_hidden(variables, x, training=True,
+                                            rng=rng)
+                loss = softmax_cross_entropy_chunked(
+                    hidden, model.head(variables), targets, chunk=chunk)
             return loss, variables["state"]
 
         return fn

@@ -82,6 +82,10 @@ def make_pipeline_train_step(
     """
     if model.tp_axis is not None or model.sp_axis is not None:
         raise ValueError("pipeline stage model must not set tp/sp axes")
+    if model.cfg.moe_experts:
+        raise NotImplementedError(
+            "pipeline over a MoE-FFN TransformerLM (the MoE aux loss "
+            "and expert-stacked specs are not plumbed through GPipe)")
     n = mesh.shape[pipe_axis]
     if model.cfg.num_layers % n:
         raise ValueError(
@@ -103,8 +107,9 @@ def make_pipeline_train_step(
 
             def stage(x):
                 def blk(x, bp):
-                    return model._block(x, bp, jax.random.PRNGKey(0),
-                                        False), None
+                    y, _aux = model._block(x, bp, jax.random.PRNGKey(0),
+                                           False)
+                    return y, None
                 x, _ = lax.scan(blk, x, p["blocks"])
                 return x
 

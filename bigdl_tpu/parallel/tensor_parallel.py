@@ -89,6 +89,12 @@ def make_transformer_train_step(
     if (model.tp_axis or None) != (tp_axis or None):
         raise ValueError(
             f"model.tp_axis={model.tp_axis!r} != step tp_axis={tp_axis!r}")
+    if model.cfg.moe_experts:
+        raise NotImplementedError(
+            "make_transformer_train_step over a MoE-FFN TransformerLM "
+            "(the expert-stacked param specs and the aux loss are not "
+            "plumbed; train MoE via the Optimizer path, or shard "
+            "experts with parallel/moe.py directly)")
     if (model.sp_axis or None) != (sp_axis or None):
         raise ValueError(
             f"model.sp_axis={model.sp_axis!r} != step sp_axis={sp_axis!r}")

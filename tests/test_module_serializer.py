@@ -152,3 +152,27 @@ def test_rename_after_wire_graph(tmp_path):
     g = Graph(inp, out)
     fc.set_name("late-rename")
     _roundtrip(tmp_path, g, x2)
+
+
+def test_shared_module_graph_roundtrip(tmp_path):
+    """nn.Graph dedupes shared module objects into one param entry
+    (round-4 weight sharing); the ctor-capture serializer must
+    preserve the sharing across save/load."""
+    import numpy as np
+
+    from bigdl_tpu.nn.graph import Graph, Input, Node
+
+    a, b = Input(), Input()
+    shared = nn.Linear(4, 3)
+    out = Node(nn.CAddTable(), [shared(a), shared(b)])
+    g = Graph([a, b], out).build(jax.random.PRNGKey(0))
+    assert sum("Linear" in k for k in g.variables["params"]) == 1
+
+    save_module(str(tmp_path / "m"), g, g.variables)
+    m2, v2 = load_module(str(tmp_path / "m"))
+    assert sorted(v2["params"]) == sorted(g.variables["params"])
+    x = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+    o1, _ = g.apply(g.variables, x, x)
+    o2, _ = m2.apply(v2, x, x)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                               rtol=1e-6)

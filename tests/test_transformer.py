@@ -4,6 +4,7 @@ sequence-parallel apply on the CPU mesh."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from bigdl_tpu.models.transformer import TransformerConfig, TransformerLM, build_lm
@@ -116,3 +117,60 @@ def test_remat_matches_no_remat():
                     jax.tree_util.tree_leaves(g2)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestSwitchMoELM:
+    """TransformerConfig.moe_experts: Switch/GShard-FFN transformer."""
+
+    def _cfg(self, top_k=1):
+        return TransformerConfig(vocab_size=64, max_len=32, dim=32,
+                                 num_heads=4, num_layers=2, dropout=0.0,
+                                 moe_experts=4, moe_top_k=top_k)
+
+    @pytest.mark.parametrize("top_k", [1, 2])
+    def test_forward_loss_and_grads(self, top_k):
+        model = TransformerLM(self._cfg(top_k), name="lm")
+        v = model.init(jax.random.PRNGKey(0))
+        assert v["params"]["blocks"]["w1"].shape == (2, 4, 32, 128)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+        tgts = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, 64)
+        logp, _ = model.apply(v, toks)
+        assert logp.shape == (2, 16, 64)
+        # loss includes the positive aux term
+        loss = model.loss(v, toks, tgts, chunk=16)
+        h, aux = model.apply_hidden(v, toks, with_aux=True)
+        assert float(aux) > 0.0
+        g = jax.grad(lambda p: model.loss(
+            {"params": p, "state": {}}, toks, tgts, chunk=16))(v["params"])
+        leaves = jax.tree_util.tree_leaves(g)
+        assert all(np.isfinite(np.asarray(x)).all() for x in leaves)
+        # router must receive gradient (through routing AND aux)
+        assert float(jnp.abs(g["blocks"]["router"]).sum()) > 0
+
+    def test_trains_through_optimizer(self):
+        from bigdl_tpu import nn as bnn
+        from bigdl_tpu.dataset import DataSet
+        from bigdl_tpu.dataset.text import synthetic_next_token
+        from bigdl_tpu.optim import Adam, Optimizer, Trigger
+
+        model = TransformerLM(self._cfg(), name="lm")
+        model.build(jax.random.PRNGKey(0))
+        data = synthetic_next_token(64, 64, 16)
+        opt = (Optimizer(model, DataSet.array(data),
+                         bnn.ChunkedSoftmaxCE(), batch_size=16)
+               .set_optim_method(Adam(3e-3))
+               .set_end_when(Trigger.max_iteration(20)))
+        opt.log_every = 100
+        trained = opt.optimize()
+        # loss finite and decreased vs iteration 1 is covered by the
+        # convergence harness elsewhere; here: end-to-end runs + params
+        # moved
+        p0 = model.init(jax.random.PRNGKey(0))["params"]
+        moved = jax.tree_util.tree_map(
+            lambda a, b: float(jnp.abs(a - b).max()),
+            trained.variables["params"], p0)
+        assert max(jax.tree_util.tree_leaves(moved)) > 0
+
+    def test_moe_rejects_tp(self):
+        with pytest.raises(NotImplementedError, match="tensor"):
+            TransformerLM(self._cfg(), tp_axis="model", name="lm")
