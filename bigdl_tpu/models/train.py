@@ -46,6 +46,10 @@ def main(argv=None):
                     help="comma per-channel std for --records")
     ap.add_argument("--recordsAug", default="",
                     help="comma subset of: hflip,pad<N> (e.g. hflip,pad4)")
+    ap.add_argument("--moeExperts", type=int, default=0,
+                    help="transformer only: Switch/GShard-MoE FFN with "
+                         "this many experts (0 = dense)")
+    ap.add_argument("--moeTopK", type=int, default=1, choices=[1, 2])
     ap.add_argument("--precision", default=None,
                     choices=["bf16", "mixed", "fp32"],
                     help="bf16 → mixed-precision training")
@@ -117,11 +121,14 @@ def main(argv=None):
         val = train[:args.batchSize]
     elif args.model == "transformer":
         from bigdl_tpu.dataset.text import synthetic_next_token
-        from bigdl_tpu.models import transformer
+        from bigdl_tpu.models.transformer import (TransformerConfig,
+                                                  TransformerLM)
 
         seq = 32
-        model = transformer.build_lm(vocab_size=64, dim=128, num_heads=4,
-                                     num_layers=2, max_len=seq)
+        model = TransformerLM(TransformerConfig(
+            vocab_size=64, dim=128, num_heads=4, num_layers=2,
+            max_len=seq, moe_experts=args.moeExperts,
+            moe_top_k=args.moeTopK))
         train = synthetic_next_token(args.batchSize * 4, 64, seq)
         val = train[:args.batchSize]
     else:
