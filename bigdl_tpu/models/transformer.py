@@ -142,12 +142,16 @@ class TransformerLM(Module):
                  tp_axis: Optional[str] = None,
                  attn_impl: Optional[str] = None,
                  sp_mode: str = "ring",
+                 ep_axis: Optional[str] = None,
                  name: Optional[str] = None):
         super().__init__(name=name)
         self.cfg = config
         self.sp_axis = sp_axis
         self.tp_axis = tp_axis
         self.attn_impl = attn_impl
+        self.ep_axis = ep_axis
+        if ep_axis is not None and not config.moe_experts:
+            raise ValueError("ep_axis requires moe_experts > 0")
         if sp_mode not in ("ring", "zigzag"):
             raise ValueError(f"sp_mode must be ring|zigzag, got {sp_mode}")
         if sp_mode == "zigzag" and not config.causal:
@@ -166,7 +170,8 @@ class TransformerLM(Module):
             self._moe = MoE(config.dim, config.dim * config.mlp_ratio,
                             config.moe_experts,
                             capacity_factor=config.moe_capacity_factor,
-                            top_k=config.moe_top_k, name="moe_ffn")
+                            top_k=config.moe_top_k,
+                            expert_axis=ep_axis, name="moe_ffn")
         if config.dim % config.num_heads:
             raise ValueError("dim must be divisible by num_heads")
         self.head_dim = config.dim // config.num_heads

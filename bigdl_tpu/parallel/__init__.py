@@ -18,4 +18,6 @@ from bigdl_tpu.parallel.tensor_parallel import (
     transformer_tp_specs,
 )
 from bigdl_tpu.parallel.pipeline import make_pipeline_train_step, pipeline_specs
-from bigdl_tpu.parallel.moe import MoE, moe_specs
+from bigdl_tpu.parallel.moe import (
+    MoE, make_moe_lm_train_step, moe_lm_specs, moe_specs,
+)

@@ -177,3 +177,96 @@ def moe_specs(expert_axis: str = "expert"):
     return {"router": P(),
             "w1": P(expert_axis, None, None), "b1": P(expert_axis, None),
             "w2": P(expert_axis, None, None), "b2": P(expert_axis, None)}
+
+
+def moe_lm_specs(ep_axis: str, tie_embeddings: bool = True):
+    """PartitionSpecs for a MoE-FFN TransformerLM's params: expert-
+    stacked block leaves (l, EX, ...) sharded on the EXPERT dim, all
+    else replicated. Derived from transformer_tp_specs (param-key
+    structure) + moe_specs (expert leaf layout) so there is no third
+    hand-maintained key list."""
+    from jax.sharding import PartitionSpec as P
+
+    from bigdl_tpu.parallel.tensor_parallel import transformer_tp_specs
+
+    base = transformer_tp_specs("unused_axis", tie_embeddings)
+    specs = jax.tree_util.tree_map(
+        lambda _: P(), base, is_leaf=lambda x: isinstance(x, P))
+    # MoE leaves: moe_specs' per-expert layout with the layer dim
+    # prepended; the replicated router stays P()
+    specs["blocks"].update({
+        k: (P() if k == "router" else P(None, *tuple(s)))
+        for k, s in moe_specs(ep_axis).items()})
+    return specs
+
+
+def make_moe_lm_train_step(model, method, mesh, ep_axis: str = "expert"):
+    """Jitted expert-parallel training step for a MoE-FFN TransformerLM.
+
+    Signature: (params, slots, tokens, targets, lr, stepno, rng)
+             -> (params', slots', mean_loss)
+
+    The expert axis doubles as the batch axis (tokens shard on it, the
+    standard EP deployment): each device computes its shard's loss with
+    the per-layer all_to_all expert exchange inside the scan. Scaling:
+    the local loss is the local token-mean divided by the axis size, so
+    summed over shards it is the GLOBAL mean — expert-sharded leaves'
+    gradients then arrive complete and correctly scaled through the
+    all_to_all transposes with no extra collective, while replicated
+    leaves (router, attention, embeddings) psum their per-shard
+    contributions. The model must be built with ep_axis=<axis>.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    if getattr(model, "ep_axis", None) != ep_axis:
+        raise ValueError(
+            f"model.ep_axis={getattr(model, 'ep_axis', None)!r} != "
+            f"step ep_axis={ep_axis!r}")
+    if model.tp_axis is not None or model.sp_axis is not None:
+        raise NotImplementedError(
+            "the EP step runs on a pure expert mesh (the expert axis "
+            "doubles as the batch axis); tp/sp composition is not "
+            "implemented")
+    n = mesh.shape[ep_axis]
+    specs = moe_lm_specs(ep_axis, model.cfg.tie_embeddings)
+
+    def body(params, slots, tokens, targets, lr, stepno, rng):
+        rng = jax.random.fold_in(rng, lax.axis_index(ep_axis))
+
+        def loss_fn(p):
+            # local token-mean / n: sums to the global mean over shards
+            return model.loss({"params": p, "state": {}}, tokens,
+                              targets, training=True, rng=rng) / n
+
+        local_loss, grads = jax.value_and_grad(loss_fn)(params)
+
+        # replicated leaves: per-shard partial contributions → psum;
+        # expert-sharded leaves: already complete via the all_to_all
+        # transposes
+        grads = jax.tree_util.tree_map(
+            lambda sp, g: g if any(a is not None for a in sp)
+            else lax.psum(g, ep_axis),
+            specs, grads, is_leaf=lambda x: isinstance(x, P))
+        loss = lax.psum(local_loss, ep_axis)
+
+        new_params, new_slots = method.update(grads, params, slots, lr,
+                                              stepno)
+        return new_params, new_slots, loss
+
+    from bigdl_tpu.parallel.tensor_parallel import slot_specs_for
+
+    slot_specs = slot_specs_for(method, specs)
+    tok_spec = P(ep_axis, None)
+    smapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(specs, slot_specs, tok_spec, tok_spec, P(), P(), P()),
+        out_specs=(specs, slot_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(smapped, donate_argnums=(0, 1))
