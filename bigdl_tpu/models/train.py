@@ -50,6 +50,10 @@ def main(argv=None):
                     help="transformer only: Switch/GShard-MoE FFN with "
                          "this many experts (0 = dense)")
     ap.add_argument("--moeTopK", type=int, default=1, choices=[1, 2])
+    ap.add_argument("--tfrecords", default=None, metavar="DIR|GLOB",
+                    help="train a vision model from TFRecord shards of "
+                         "tf.train.Examples (image/shape/label layout; "
+                         "see bigdl_tpu.dataset.tfrecord)")
     ap.add_argument("--precision", default=None,
                     choices=["bf16", "mixed", "fp32"],
                     help="bf16 → mixed-precision training")
@@ -142,7 +146,7 @@ def main(argv=None):
                 "(only lenet / resnet20-cifar have dataset loaders); drop "
                 "-f to train on synthetic data")
         model, shape, classes = _build_model(args.model, 1000)
-        if args.records:
+        if args.records or args.tfrecords:
             train, val = [], []  # disk shards replace the synthetic pool
         else:
             rng = np.random.RandomState(0)
@@ -167,12 +171,22 @@ def main(argv=None):
         criterion = nn.ClassNLLCriterion()
         val_methods = [Top1Accuracy()]
 
-    if args.records:
-        if args.model in ("transformer", "textclassifier", "ncf",
-                          "bilstm"):
-            raise SystemExit(
-                f"--records holds image shards; model {args.model!r} "
-                "takes token inputs (use a vision model)")
+    if args.records and args.tfrecords:
+        raise SystemExit("--records and --tfrecords are exclusive")
+    if (args.records or args.tfrecords) and args.model in (
+            "transformer", "textclassifier", "ncf", "bilstm"):
+        raise SystemExit(
+            f"record shards hold images; model {args.model!r} takes "
+            "token inputs (use a vision model)")
+    if args.tfrecords:
+        from bigdl_tpu.dataset import TFRecordDataSet
+
+        train_ds = TFRecordDataSet(args.tfrecords)
+        logging.getLogger("bigdl_tpu").info(
+            "tfrecords: %d samples from %d shards", train_ds.size(),
+            len(train_ds.paths))
+        val_ds = train_ds
+    elif args.records:
         # disk-resident path: BDLS shards → native mmap prefetcher
         # (reference: the Spark-executor-fed ImageNet pipeline,
         # SURVEY.md §2.4/§7; dataset/records.py)
