@@ -72,16 +72,18 @@ def read_header(path: str) -> Tuple[int, int, int, int]:
     return int(n), int(h), int(w), int(c)
 
 
-def resolve_shards(spec) -> List[str]:
-    """Directory | glob | list of paths → sorted shard list."""
+def resolve_shards(spec, pattern: str = "*.bdls") -> List[str]:
+    """Directory | glob | list of paths → sorted shard list (shared by
+    the BDLS and TFRecord datasets; `pattern` is the in-directory
+    glob)."""
     if isinstance(spec, (list, tuple)):
-        paths = list(spec)
+        paths = [os.fspath(p) for p in spec]
     elif os.path.isdir(spec):
-        paths = _glob.glob(os.path.join(spec, "*.bdls"))
+        paths = _glob.glob(os.path.join(spec, pattern))
     else:
         paths = _glob.glob(spec)
     if not paths:
-        raise FileNotFoundError(f"no .bdls shards match {spec!r}")
+        raise FileNotFoundError(f"no {pattern} shards match {spec!r}")
     return sorted(paths)
 
 

@@ -216,17 +216,9 @@ class TFRecordDataSet(AbstractDataSet):
 
     def __init__(self, paths, parser: Callable[[Dict[str, Any]], Sample]
                  = default_image_parser, seed: int = 1):
-        import glob as _glob
+        from bigdl_tpu.dataset.records import resolve_shards
 
-        if isinstance(paths, (list, tuple)):
-            self.paths = [os.fspath(p) for p in paths]
-        elif os.path.isdir(paths):
-            self.paths = sorted(
-                _glob.glob(os.path.join(paths, "*.tfrecord*")))
-        else:
-            self.paths = sorted(_glob.glob(paths))
-        if not self.paths:
-            raise FileNotFoundError(f"no tfrecord shards match {paths!r}")
+        self.paths = resolve_shards(paths, pattern="*.tfrecord*")
         self.parser = parser
         self.seed = seed
         self._n: Optional[int] = None

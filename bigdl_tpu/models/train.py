@@ -179,12 +179,28 @@ def main(argv=None):
             f"record shards hold images; model {args.model!r} takes "
             "token inputs (use a vision model)")
     if args.tfrecords:
-        from bigdl_tpu.dataset import TFRecordDataSet
+        import numpy as np
 
-        train_ds = TFRecordDataSet(args.tfrecords)
+        from bigdl_tpu.dataset import Sample, TFRecordDataSet
+        from bigdl_tpu.dataset.tfrecord import default_image_parser
+
+        if args.recordsAug:
+            raise SystemExit(
+                "--recordsAug applies to --records (native-plane "
+                "augmentation); TFRecord training is unaugmented")
+        mean = np.asarray([float(v) for v in args.recordsMean.split(",")],
+                          np.float32)
+        std = np.asarray([float(v) for v in args.recordsStd.split(",")],
+                         np.float32)
+
+        def parser(example):
+            s = default_image_parser(example)
+            return Sample((s.feature - mean) / std, s.label)
+
+        train_ds = TFRecordDataSet(args.tfrecords, parser=parser)
         logging.getLogger("bigdl_tpu").info(
-            "tfrecords: %d samples from %d shards", train_ds.size(),
-            len(train_ds.paths))
+            "tfrecords: %d samples from %d shards (mean=%s std=%s)",
+            train_ds.size(), len(train_ds.paths), mean, std)
         val_ds = train_ds
     elif args.records:
         # disk-resident path: BDLS shards → native mmap prefetcher

@@ -109,21 +109,24 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     q_start = qi * block_q
     k_start = ki * block_k
 
-    def _compute():
+    def _compute(masked):
         q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, D)
         k = k_ref[0]                                         # (bk, D)
         s = lax.dot_general(q, k.astype(jnp.float32),
                             (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)  # (bq, bk)
-        col = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        mask = col < seq_k
-        if causal:
-            # bottom-right alignment (query i sees keys ≤ i + seq_k-seq_q),
-            # matching attention_reference and the blockwise backward
-            row = q_start + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            mask = mask & (col <= row + (seq_k - seq_q))
-        s = jnp.where(mask, s, _NEG_INF)
+        if masked:
+            col = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = col < seq_k
+            if causal:
+                # bottom-right alignment (query i sees keys ≤
+                # i + seq_k-seq_q), matching attention_reference and
+                # the blockwise backward
+                row = q_start + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                mask = mask & (col <= row + (seq_k - seq_q))
+            s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_scr[:, :1]                                # (bq, 1)
         l_prev = l_scr[:, :1]
@@ -133,7 +136,9 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         # zero masked columns explicitly: _NEG_INF is finite, so for a
         # fully-masked row exp(s - m_new) == 1 and the row would emit
         # mean(V) instead of the zeros the ring combine relies on
-        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)         # (bq, bk)
+        p = jnp.exp(s - m_new)                               # (bq, bk)
+        if masked:
+            p = jnp.where(mask, p, 0.0)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
 
         acc = acc_scr[:] * alpha + lax.dot_general(
@@ -145,13 +150,30 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
         acc_scr[:] = acc
 
+    # a tile entirely in-bounds and (for causal) entirely below the
+    # diagonal needs NO mask — skip the iota/where chain on the s tile
+    # (the VPU elementwise chain is the fwd kernel's residual cost)
+    in_bounds = k_start + block_k <= seq_k
     if causal:
-        # blocks strictly above the (aligned) diagonal contribute nothing
-        @pl.when(k_start <= q_start + block_q - 1 + (seq_k - seq_q))
+        reachable = k_start <= q_start + block_q - 1 + (seq_k - seq_q)
+        full = in_bounds & (k_start + block_k - 1
+                            <= q_start + (seq_k - seq_q))
+
+        @pl.when(full)
         def _():
-            _compute()
+            _compute(masked=False)
+
+        @pl.when(reachable & jnp.logical_not(full))
+        def _():
+            _compute(masked=True)
     else:
-        _compute()
+        @pl.when(in_bounds)
+        def _():
+            _compute(masked=False)
+
+        @pl.when(jnp.logical_not(in_bounds))
+        def _():
+            _compute(masked=True)
 
     @pl.when(ki == num_kv - 1)
     def _finalize():
