@@ -248,25 +248,31 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k,
 
 def _bwd_recompute(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    q_start, k_start, sm_scale, causal, block_q, block_k,
-                   seq_q, seq_k):
+                   seq_q, seq_k, masked=True):
     """The shared dq/dkv recompute chain: (q, k, do, p, ds) for one
     (q_block, kv_block) tile — p from the saved lse, ds from delta.
-    `q` comes back UNSCALED (dk needs it that way)."""
+    `q` comes back UNSCALED (dk needs it that way). `masked=False`
+    skips the iota/where chain — only valid for tiles fully in-bounds
+    on BOTH axes and (causal) entirely below the diagonal."""
     q = q_ref[0].astype(jnp.float32)                         # (bq, D)
     k = k_ref[0].astype(jnp.float32)                         # (bk, D)
     s = lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
                         preferred_element_type=jnp.float32)
-    row = q_start + lax.broadcasted_iota(jnp.int32,
-                                         (block_q, block_k), 0)
-    col = k_start + lax.broadcasted_iota(jnp.int32,
-                                         (block_q, block_k), 1)
-    # padded q rows must contribute nothing (dk/dv accumulate over rows)
-    mask = (col < seq_k) & (row < seq_q)
-    if causal:
-        mask = mask & (col <= row + (seq_k - seq_q))
     lse = lse_ref[0, 0, pl.dslice(q_start, block_q)][:, None]
     delta = delta_ref[0, 0, pl.dslice(q_start, block_q)][:, None]
-    p = jnp.where(mask, jnp.exp(s - lse), 0.0)               # (bq, bk)
+    if masked:
+        row = q_start + lax.broadcasted_iota(jnp.int32,
+                                             (block_q, block_k), 0)
+        col = k_start + lax.broadcasted_iota(jnp.int32,
+                                             (block_q, block_k), 1)
+        # padded q rows must contribute nothing (dk/dv accumulate over
+        # rows)
+        mask = (col < seq_k) & (row < seq_q)
+        if causal:
+            mask = mask & (col <= row + (seq_k - seq_q))
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)           # (bq, bk)
+    else:
+        p = jnp.exp(s - lse)
     do = do_ref[0].astype(jnp.float32)                       # (bq, D)
     dp = lax.dot_general(do, v_ref[0].astype(jnp.float32),
                          (((1,), (1,)), ((), ())),
@@ -410,10 +416,11 @@ def _fa_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     q_start = qi * block_q
     k_start = ki * block_k
 
-    def _compute():
+    def _compute(masked):
         q, k, do, p, ds = _bwd_recompute(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_start,
-            k_start, sm_scale, causal, block_q, block_k, seq_q, seq_k)
+            k_start, sm_scale, causal, block_q, block_k, seq_q, seq_k,
+            masked=masked)
         dv_scr[:] = dv_scr[:] + lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)              # (bk, D)
@@ -425,12 +432,29 @@ def _fa_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 ds, k, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
+    # same unmasked fast path as the forward kernel, with the extra
+    # q-rows-in-bounds requirement (padded rows feed dk/dv sums)
+    full = (k_start + block_k <= seq_k) & (q_start + block_q <= seq_q)
     if causal:
-        @pl.when(q_start + block_q - 1 + (seq_k - seq_q) >= k_start)
+        reachable = q_start + block_q - 1 + (seq_k - seq_q) >= k_start
+        full = full & (k_start + block_k - 1
+                       <= q_start + (seq_k - seq_q))
+
+        @pl.when(full)
         def _():
-            _compute()
+            _compute(masked=False)
+
+        @pl.when(reachable & jnp.logical_not(full))
+        def _():
+            _compute(masked=True)
     else:
-        _compute()
+        @pl.when(full)
+        def _():
+            _compute(masked=False)
+
+        @pl.when(jnp.logical_not(full))
+        def _():
+            _compute(masked=True)
 
     @pl.when(qi == num_q - 1)
     def _finalize_dkv():
