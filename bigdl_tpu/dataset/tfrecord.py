@@ -205,6 +205,33 @@ def default_image_parser(example: Dict[str, Any]) -> Sample:
     return Sample(img.astype(np.float32), label)
 
 
+def count_tfrecords(path: str) -> int:
+    """Record count of one shard by seeking over the framing (length
+    header → skip body), no CRC work and no body reads — O(records)
+    seeks instead of a full decode. A sidecar `<path>.count` file
+    holding the integer short-circuits even that (write one when
+    producing ImageNet-scale shards)."""
+    sidecar = path + ".count"
+    if os.path.exists(sidecar):
+        with open(sidecar) as f:
+            return int(f.read().strip())
+    n = 0
+    total = os.path.getsize(path)
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if not header:
+                return n
+            if len(header) < 8:
+                raise ValueError(f"{path}: truncated record header")
+            (ln,) = struct.unpack("<Q", header)
+            f.seek(4 + ln + 4, 1)  # header crc + body + body crc
+            if f.tell() > total:   # seek past EOF succeeds silently —
+                # raise the same error the reading iterator would
+                raise ValueError(f"{path}: truncated record body")
+            n += 1
+
+
 class TFRecordDataSet(AbstractDataSet):
     """Dataset over TFRecord shards of tf.train.Example records.
 
@@ -212,20 +239,28 @@ class TFRecordDataSet(AbstractDataSet):
     train=True shuffles shard order and in-shard record order per epoch
     (statelessly, like every dataset here — resume fast-forward safe);
     train=False streams in order once.
+
+    Memory note: the train iterator materializes ONE shard at a time to
+    shuffle in-shard order — size shards accordingly (the conventional
+    100–200 MB TFRecord shard is fine; don't write one giant shard).
+    `size()` counts by framing seeks (or a `<shard>.count` sidecar),
+    not a full CRC decode.
     """
 
     def __init__(self, paths, parser: Callable[[Dict[str, Any]], Sample]
                  = default_image_parser, seed: int = 1):
         from bigdl_tpu.dataset.records import resolve_shards
 
-        self.paths = resolve_shards(paths, pattern="*.tfrecord*")
+        self.paths = [p for p in resolve_shards(paths,
+                                                pattern="*.tfrecord*")
+                      if not p.endswith(".count")]  # count sidecars
         self.parser = parser
         self.seed = seed
         self._n: Optional[int] = None
 
     def size(self) -> int:
         if self._n is None:
-            self._n = sum(1 for p in self.paths for _ in read_tfrecords(p))
+            self._n = sum(count_tfrecords(p) for p in self.paths)
         return self._n
 
     def data(self, train: bool) -> Iterator:

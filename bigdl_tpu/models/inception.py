@@ -42,8 +42,46 @@ def inception_layer_v1(n_in, config, prefix=""):
     )
 
 
-def build(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
-    """(reference: Inception_v1.scala#Inception_v1_NoAuxClassifier)"""
+def inception_layer_v1_fused(n_in, config, prefix=""):
+    """Branch-fused variant of `inception_layer_v1` (VERDICT r4 item 2):
+    the three REDUCE 1x1 convs (1x1 branch, 3x3 reduce, 5x5 reduce) all
+    read the same input, so they merge into ONE conv with c1+c3r+c5r
+    output channels — one large M=B·H·W gemm instead of three small
+    ones whose padded-to-128 output lanes waste the MXU (e.g. layer 3a:
+    64/96/16 lanes → three pads vs one 176-wide gemm). ReLU commutes
+    with the channel slice, so slicing after the merged conv+ReLU is
+    numerically identical to the per-branch form. The pool-projection
+    1x1 reads the pooled input and stays separate."""
+    (c1,), (c3r, c3), (c5r, c5), (pp,) = config
+    x = nn.Input()
+    merged = nn.Sequential(
+        nn.SpatialConvolution(n_in, c1 + c3r + c5r, 1, 1, 1, 1, 0, 0,
+                              w_init=Xavier()
+                              ).set_name(prefix + "reduce_merged/conv1x1"),
+        nn.ReLU(),
+    )(x)
+    b1 = nn.Narrow(4, 1, c1)(merged)
+    b3 = _conv(c3r, c3, 3, pad=1, name=prefix + "3x3/")(
+        nn.Narrow(4, 1 + c1, c3r)(merged))
+    b5 = _conv(c5r, c5, 5, pad=2, name=prefix + "5x5/")(
+        nn.Narrow(4, 1 + c1 + c3r, c5r)(merged))
+    bp = nn.Sequential(
+        nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil(),
+        _conv(n_in, pp, 1, name=prefix + "pool/"),
+    )(x)
+    out = nn.JoinTable(4)(b1, b3, b5, bp)
+    return nn.Graph(x, out)
+
+
+def build(class_num: int = 1000, has_dropout: bool = True,
+          fused_branches: bool = False) -> nn.Sequential:
+    """(reference: Inception_v1.scala#Inception_v1_NoAuxClassifier)
+
+    fused_branches=True swaps each inception layer for the
+    reduce-merged variant (identical math, fewer/larger gemms —
+    see inception_layer_v1_fused)."""
+    layer = inception_layer_v1_fused if fused_branches \
+        else inception_layer_v1
     m = nn.Sequential(
         nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3,
                               w_init=Xavier()).set_name("conv1/7x7_s2"),
@@ -54,17 +92,17 @@ def build(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
         _conv(64, 192, 3, pad=1, name="conv2/3x3/"),
         nn.SpatialCrossMapLRN(5, 0.0001, 0.75),
         nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
-        inception_layer_v1(192, ((64,), (96, 128), (16, 32), (32,)), "3a/"),
-        inception_layer_v1(256, ((128,), (128, 192), (32, 96), (64,)), "3b/"),
+        layer(192, ((64,), (96, 128), (16, 32), (32,)), "3a/"),
+        layer(256, ((128,), (128, 192), (32, 96), (64,)), "3b/"),
         nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
-        inception_layer_v1(480, ((192,), (96, 208), (16, 48), (64,)), "4a/"),
-        inception_layer_v1(512, ((160,), (112, 224), (24, 64), (64,)), "4b/"),
-        inception_layer_v1(512, ((128,), (128, 256), (24, 64), (64,)), "4c/"),
-        inception_layer_v1(512, ((112,), (144, 288), (32, 64), (64,)), "4d/"),
-        inception_layer_v1(528, ((256,), (160, 320), (32, 128), (128,)), "4e/"),
+        layer(480, ((192,), (96, 208), (16, 48), (64,)), "4a/"),
+        layer(512, ((160,), (112, 224), (24, 64), (64,)), "4b/"),
+        layer(512, ((128,), (128, 256), (24, 64), (64,)), "4c/"),
+        layer(512, ((112,), (144, 288), (32, 64), (64,)), "4d/"),
+        layer(528, ((256,), (160, 320), (32, 128), (128,)), "4e/"),
         nn.SpatialMaxPooling(3, 3, 2, 2).ceil(),
-        inception_layer_v1(832, ((256,), (160, 320), (32, 128), (128,)), "5a/"),
-        inception_layer_v1(832, ((384,), (192, 384), (48, 128), (128,)), "5b/"),
+        layer(832, ((256,), (160, 320), (32, 128), (128,)), "5a/"),
+        layer(832, ((384,), (192, 384), (48, 128), (128,)), "5b/"),
         nn.SpatialAveragePooling(7, 7, 1, 1),
     )
     if has_dropout:

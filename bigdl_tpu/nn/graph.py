@@ -146,14 +146,17 @@ class Graph(Module):
             key = self._keys[id(node)]
             child_vars = {
                 "params": variables["params"][key],
-                "state": variables["state"][key],
+                # shared modules: a later occurrence starts from the
+                # earlier occurrence's NEW state within this same pass,
+                # so running-stat updates (e.g. a shared BatchNorm's
+                # momentum EMA) compose instead of the last application
+                # silently overwriting the first
+                "state": new_state.get(key, variables["state"][key]),
             }
             out, s = node.module.apply(
                 child_vars, *args, training=training, rng=_fold_rng(rng, i)
             )
             values[id(node)] = out
-            # shared modules: later occurrences overwrite (a shared
-            # stateful module keeps its LAST application's state)
             new_state[key] = s
         outs = [values[id(n)] for n in self.output_nodes]
         return (outs[0] if len(outs) == 1 else T(*outs)), new_state

@@ -110,11 +110,16 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     k_start = ki * block_k
 
     def _compute(masked):
-        q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, D)
+        # dot NATIVE-dtype operands (bf16 on the training path) with f32
+        # MXU accumulation; a pre-dot f32 cast would force the MXU into
+        # multi-pass f32 mode (~3-6x slower on v5e). Scale applies to the
+        # f32 s tile post-matmul (more accurate than pre-scaling bf16 q).
+        q = q_ref[0]                                         # (bq, D)
         k = k_ref[0]                                         # (bk, D)
-        s = lax.dot_general(q, k.astype(jnp.float32),
+        s = lax.dot_general(q, k,
                             (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)  # (bq, bk)
+        s = s * sm_scale
         if masked:
             col = k_start + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -253,11 +258,18 @@ def _bwd_recompute(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     (q_block, kv_block) tile — p from the saved lse, ds from delta.
     `q` comes back UNSCALED (dk needs it that way). `masked=False`
     skips the iota/where chain — only valid for tiles fully in-bounds
-    on BOTH axes and (causal) entirely below the diagonal."""
-    q = q_ref[0].astype(jnp.float32)                         # (bq, D)
-    k = k_ref[0].astype(jnp.float32)                         # (bk, D)
-    s = lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32)
+    on BOTH axes and (causal) entirely below the diagonal.
+
+    All dots take NATIVE-dtype operands with f32 MXU accumulation (the
+    library-kernel convention); q/k/do come back in native dtype and
+    p/ds in f32 — callers cast p/ds to the operand dtype at their dots.
+    A pre-dot f32 cast would force multi-pass f32 MXU mode (~3-6x
+    slower on v5e) — measured as the dominant term of the round-4
+    backward (PROFILE_r05)."""
+    q = q_ref[0]                                             # (bq, D)
+    k = k_ref[0]                                             # (bk, D)
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * sm_scale
     lse = lse_ref[0, 0, pl.dslice(q_start, block_q)][:, None]
     delta = delta_ref[0, 0, pl.dslice(q_start, block_q)][:, None]
     if masked:
@@ -273,8 +285,8 @@ def _bwd_recompute(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)           # (bq, bk)
     else:
         p = jnp.exp(s - lse)
-    do = do_ref[0].astype(jnp.float32)                       # (bq, D)
-    dp = lax.dot_general(do, v_ref[0].astype(jnp.float32),
+    do = do_ref[0]                                           # (bq, D)
+    dp = lax.dot_general(do, v_ref[0],
                          (((1,), (1,)), ((), ())),
                          preferred_element_type=jnp.float32)
     ds = p * (dp - delta) * sm_scale
@@ -299,7 +311,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_start,
             k_start, sm_scale, causal, block_q, block_k, seq_q, seq_k)
         dq_scr[:] = dq_scr[:] + lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -333,11 +345,11 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_start,
             k_start, sm_scale, causal, block_q, block_k, seq_q, seq_k)
         dv_scr[:] = dv_scr[:] + lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)              # (bk, D)
         # dk = ds^T @ q_unscaled
         dk_scr[:] = dk_scr[:] + lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -421,15 +433,16 @@ def _fa_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, q_start,
             k_start, sm_scale, causal, block_q, block_k, seq_q, seq_k,
             masked=masked)
+        ds_n = ds.astype(q.dtype)
         dv_scr[:] = dv_scr[:] + lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)              # (bk, D)
         dk_scr[:] = dk_scr[:] + lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds_n, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dq_scr[pl.dslice(q_start, block_q)] = \
             dq_scr[pl.dslice(q_start, block_q)] + lax.dot_general(
-                ds, k, (((1,), (0,)), ((), ())),
+                ds_n, k, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
     # same unmasked fast path as the forward kernel, with the extra
@@ -490,6 +503,11 @@ def _flash_bwd_pallas_fused(q, k, v, o, lse, do, causal, sm_scale,
             block_q=block_q, block_k=block_k, seq_q=seq_q, seq_k=seq_k,
             num_q=num_q, num_kv=num_kv),
         grid=(bh, num_kv, num_q),
+        # the full-sequence dq residents exceed Mosaic's default 16 MiB
+        # scoped-vmem budget at long context (18.1 MiB at S=16384 with
+        # native-dtype dots); v5e has 128 MiB — raise the kernel's cap
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
         in_specs=col_specs,
         out_specs=[
             # whole dq row plane per bh: index map constant in (j, i),
@@ -526,7 +544,7 @@ _FUSED_BWD_MAX_TILE = 1024 * 512  # bq*bk above this fails to compile
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q,
-                      block_k, interpret):
+                      block_k, interpret, bwd_tiles=None):
     sq_padded = ((q.shape[1] + block_q - 1) // block_q) * block_q
     dp_padded = ((q.shape[2] + 127) // 128) * 128
     # fused-path VMEM residents that scale with the FULL sequence: the
@@ -535,11 +553,18 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q,
     resident = sq_padded * dp_padded * (4 + q.dtype.itemsize)
     if resident <= _FUSED_BWD_MAX_RESIDENT_BYTES:
         # the fused kernel's per-cell tiles cap lower than the split
-        # kernels'. Tie-break shrinks the Q tile first: measured at the
-        # 186M shape, 512x1024 beats 1024x512 (59.5k vs 57.9k tok/s,
-        # PROFILE_r04/ANALYSIS.md) — the serial kv loop amortizes
-        # better with a WIDE kv tile.
-        fb_q, fb_k = block_q, block_k
+        # kernels'. Default tie-break shrinks the Q tile first: the
+        # round-5 sweep with native-dtype dots re-confirmed 512x1024 as
+        # the optimum at the 186M shape (13.39 ms vs 13.58 at 1024x512,
+        # 15.94 at 512x512 — PROFILE_r05/bwd_tile_sweep.log); the
+        # serial kv loop amortizes better with a WIDE kv tile.
+        # `bwd_tiles` overrides for experimentation.
+        if bwd_tiles is not None:
+            fb_q, fb_k = bwd_tiles
+            fb_q = _clamp_block(fb_q, q.shape[1])
+            fb_k = _clamp_block(fb_k, k.shape[1])
+        else:
+            fb_q, fb_k = block_q, block_k
         while fb_q * fb_k > _FUSED_BWD_MAX_TILE:
             if fb_q >= fb_k:
                 fb_q //= 2
@@ -735,27 +760,28 @@ def _forward(q, k, v, causal, sm_scale, block_q, block_k, impl):
                              interpret=(impl == "interpret"))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_core(q, k, v, causal, sm_scale, block_q, block_k, bwd_block_k,
-                impl):
+                impl, bwd_tiles):
     out, _ = _forward(q, k, v, causal, sm_scale, block_q, block_k, impl)
     return out
 
 
 def _flash_core_fwd(q, k, v, causal, sm_scale, block_q, block_k,
-                    bwd_block_k, impl):
+                    bwd_block_k, impl, bwd_tiles):
     out, lse = _forward(q, k, v, causal, sm_scale, block_q, block_k, impl)
     return out, (q, k, v, out, lse)
 
 
 def _flash_core_bwd(causal, sm_scale, block_q, block_k, bwd_block_k, impl,
-                    res, do):
+                    bwd_tiles, res, do):
     q, k, v, out, lse = res
     if impl in ("pallas", "interpret"):
-        # Mosaic backward (dq kernel + dk/dv kernel), same tiles as fwd
+        # Mosaic backward; fused-kernel tiles chosen independently
         return _flash_bwd_pallas(q, k, v, out, lse, do, causal, sm_scale,
                                  block_q, block_k,
-                                 interpret=(impl == "interpret"))
+                                 interpret=(impl == "interpret"),
+                                 bwd_tiles=bwd_tiles)
     return _flash_bwd_blockwise(q, k, v, out, lse, do, causal, sm_scale,
                                 bwd_block_k)
 
@@ -813,6 +839,7 @@ def flash_attention(
     block_k: Optional[int] = None,
     bwd_block_k: Optional[int] = None,
     impl: Optional[str] = None,
+    bwd_tiles: Optional[Tuple[int, int]] = None,
 ) -> jax.Array:
     """Memory-efficient attention. q,k,v: (B, H, S, D) or (BH, S, D).
 
@@ -827,9 +854,12 @@ def flash_attention(
     not the MXU, binds; PROFILE_r04/attn_block_sweep.log), the XLA scan
     wants SMALL kv blocks (128 — its per-block elementwise chain stays
     cache-resident).
-    `bwd_block_k` applies only to the impl='xla' scan backward. All are
-    clamped to the sequence lengths, so short sequences run a
-    single-tile kernel.
+    `bwd_block_k` applies only to the impl='xla' scan backward.
+    `bwd_tiles=(bq, bk)` overrides the FUSED Mosaic backward's tiles
+    (default: the fwd blocks, q-tile halved first until bq·bk fits the
+    VMEM cap — 512x1024 at the default fwd blocks, re-confirmed optimal
+    by the round-5 sweep). All are clamped to the sequence lengths, so
+    short sequences run a single-tile kernel.
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -844,7 +874,8 @@ def flash_attention(
         k = k.reshape(b * h, sk, k.shape[-1])
         v = v.reshape(b * h, sk, v.shape[-1])
     out = _flash_core(q, k, v, causal, float(sm_scale), block_q, block_k,
-                      bwd_block_k, impl)
+                      bwd_block_k, impl,
+                      None if bwd_tiles is None else tuple(bwd_tiles))
     if squeeze:
         out = out.reshape(b, h, s, -1)
     return out

@@ -17,7 +17,13 @@ from bigdl_tpu.parallel.tensor_parallel import (
     make_transformer_train_step, shard_params, slot_specs_for,
     transformer_tp_specs,
 )
-from bigdl_tpu.parallel.pipeline import make_pipeline_train_step, pipeline_specs
+from bigdl_tpu.parallel.pipeline import (
+    interleaved_bubble_fraction,
+    make_pipeline_train_step,
+    pipeline_bubble_fraction,
+    pipeline_specs,
+    to_virtual_layout,
+)
 from bigdl_tpu.parallel.moe import (
     MoE, make_moe_lm_train_step, moe_lm_specs, moe_specs,
 )

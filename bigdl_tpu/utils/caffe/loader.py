@@ -54,6 +54,18 @@ def _fill_blob(blob, arr: np.ndarray) -> None:
     blob.data.extend(float(v) for v in np.asarray(arr, np.float32).ravel())
 
 
+def _sym_pad(mod) -> Tuple[int, int]:
+    """Caffe's proto has only symmetric uint32 pad_h/pad_w. Tuple
+    (low, high) padding (e.g. a space-to-depth stem) must fail loudly
+    here, not as an opaque protobuf TypeError at field assignment."""
+    if isinstance(mod.pad_h, tuple) or isinstance(mod.pad_w, tuple):
+        raise ValueError(
+            "Caffe has no asymmetric padding: layer %r has pad_h=%r, "
+            "pad_w=%r; re-export with symmetric integer padding"
+            % (mod.name, mod.pad_h, mod.pad_w))
+    return mod.pad_h, mod.pad_w
+
+
 def _zeros_variables(module: Module) -> Dict[str, Any]:
     import jax
 
@@ -675,7 +687,7 @@ class CaffePersister:
             cp.num_output = mod.n_output_plane
             cp.kernel_h, cp.kernel_w = mod.kernel_h, mod.kernel_w
             cp.stride_h, cp.stride_w = mod.stride_h, mod.stride_w
-            cp.pad_h, cp.pad_w = mod.pad_h, mod.pad_w
+            cp.pad_h, cp.pad_w = _sym_pad(mod)
             cp.bias_term = mod.with_bias
             w = np.asarray(p["weight"]).transpose(3, 2, 0, 1)  # HWOI→IOHW
             _fill_blob(l.blobs.add(), w)
@@ -689,7 +701,7 @@ class CaffePersister:
             cp.num_output = mod.n_output_plane
             cp.kernel_h, cp.kernel_w = mod.kernel_h, mod.kernel_w
             cp.stride_h, cp.stride_w = mod.stride_h, mod.stride_w
-            cp.pad_h, cp.pad_w = mod.pad_h, mod.pad_w
+            cp.pad_h, cp.pad_w = _sym_pad(mod)
             cp.group = mod.n_group
             cp.bias_term = mod.with_bias
             if isinstance(mod, nn.SpatialDilatedConvolution):

@@ -68,6 +68,20 @@ _BINARY_OPS = {
     "Maximum": nn.CMaxTable, "Minimum": nn.CMinTable,
 }
 
+# constant folding: frozen keras graphs decompose BatchNorm into
+# rsqrt(var+eps)*gamma / beta-mean*... chains whose inner nodes are
+# pure-const arithmetic — fold them at load so only the data-path
+# Mul/Add (affine scale/bias, below) needs a module
+_FOLDABLE = {
+    "Add": np.add, "AddV2": np.add, "Sub": np.subtract,
+    "Mul": np.multiply, "RealDiv": np.divide,
+    "Maximum": np.maximum, "Minimum": np.minimum,
+    "Rsqrt": lambda a: 1.0 / np.sqrt(a), "Sqrt": np.sqrt,
+    "Square": np.square, "Neg": np.negative, "Exp": np.exp,
+    "Log": np.log, "Abs": np.abs,
+    "Reshape": lambda a, s: np.reshape(a, [int(x) for x in s]),
+}
+
 
 def _tensor_to_np(t) -> np.ndarray:
     dtype = _NP_DTYPES.get(t.dtype)
@@ -184,6 +198,19 @@ class TensorflowLoader:
                 if ins and ins[0] in mod_node:
                     mod_node[name] = mod_node[ins[0]]
                 continue
+            if op in _FOLDABLE and ins and not any(i in mod_node
+                                                   for i in ins):
+                vals = [const_of(i) for i in ins]
+                if all(v is not None for v in vals):
+                    consts[name] = np.asarray(_FOLDABLE[op](*vals))
+                    continue
+            if op == "Squeeze" and ins and ins[0] not in mod_node:
+                val = const_of(ins[0])
+                if val is not None:
+                    dims = tuple(int(d) for d in
+                                 tf_node.attr["squeeze_dims"].list.i)
+                    consts[name] = np.squeeze(val, dims or None)
+                    continue
             handled = self._convert(tf_node, op, ins, consts, const_of,
                                     mod_node, wire)
             if handled is not None:
@@ -320,6 +347,26 @@ class TensorflowLoader:
                 c = float(lhs.reshape(()))
                 scale, shift = (c, 0.0) if op == "Mul" else (1.0, c)
                 return wire(nn.Power(1.0, scale, shift), [parent(1)], name)
+            # data (×|+) const VECTOR — the data-path half of a frozen
+            # decomposed BatchNorm: an affine CMul/CAdd with the folded
+            # constant as its (trainable, fine-tunable) weight
+            cv, pi = (rhs, 0) if rhs is not None else (lhs, 1)
+            if cv is not None and (pi == 0 or op in ("Add", "AddV2",
+                                                     "Mul")):
+                w = cv.astype(np.float32)
+                if op == "Mul":
+                    return wire(nn.CMul(w.shape), [parent(pi)], name,
+                                {"params": {"weight": w}, "state": {}})
+                if op == "RealDiv":
+                    return wire(nn.CMul(w.shape), [parent(pi)], name,
+                                {"params": {"weight": 1.0 / w},
+                                 "state": {}})
+                if op in ("Add", "AddV2"):
+                    return wire(nn.CAdd(w.shape), [parent(pi)], name,
+                                {"params": {"bias": w}, "state": {}})
+                if op == "Sub":  # data - const
+                    return wire(nn.CAdd(w.shape), [parent(pi)], name,
+                                {"params": {"bias": -w}, "state": {}})
             return wire(_BINARY_OPS[op](), [parent(0), parent(1)], name)
 
         if op in ("MaxPool", "AvgPool"):

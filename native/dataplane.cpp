@@ -261,6 +261,16 @@ static int map_shard(const char* path, MappedShard* out, BdlsHeader* hdr) {
     ::close(fd);
     return -4;
   }
+  // bound each dim before multiplying: h*w*c of hostile u32 headers can
+  // overflow int64 and wrap to a small positive rec, defeating the
+  // division-form check below (65535^3 alone is within int64, but the
+  // bound also keeps rec sane for the prefetch arithmetic downstream)
+  if (hdr->h == 0 || hdr->w == 0 || hdr->c == 0 ||
+      hdr->h > (1u << 16) || hdr->w > (1u << 16) || hdr->c > (1u << 10)) {
+    ::munmap(m, st.st_size);
+    ::close(fd);
+    return -4;
+  }
   const int64_t rec = 4 + static_cast<int64_t>(hdr->h) * hdr->w * hdr->c;
   // division form: the multiplication `rec * n` could wrap for a
   // corrupt/hostile header and bypass validation

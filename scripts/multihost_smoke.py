@@ -1,4 +1,4 @@
-"""Multi-process multi-host smoke test on CPU (no cluster needed).
+"""Multi-process multi-host smoke + failure-recovery test on CPU.
 
 Reference parity: the reference proves its distributed plane without a
 cluster by running Spark `local[N]` (SURVEY.md §4 "Distributed-without-
@@ -7,14 +7,20 @@ processes × M virtual CPU devices each — the same code path a v5e pod
 runs (PJRT process group, global mesh, cross-process collectives),
 minus the ICI.
 
-Launcher mode (no --process-id): spawns NUM_PROCESSES children of this
-script, waits, and writes MULTIHOST.json. Child mode: initializes the
-process group through Engine.init_distributed (the product path), runs
-DP/ZeRO-1 training steps through Optimizer.set_mesh → DistriOptimizer
-with per-host sharded data, checkpoints, resumes, and verifies losses
-are finite and identical across processes.
+Leg 1 (smoke): 2 procs × 4 devices, DP/ZeRO-1 training through
+Optimizer.set_mesh → DistriOptimizer with per-host sharded data,
+checkpoint + in-process resume, digests identical across processes.
 
-    python scripts/multihost_smoke.py          # 2 procs x 4 devices
+Leg 2 (kill/resume — SURVEY §5.3, reference anchor DistriOptimizer
+retry/getLatestFile): 4 procs × 2 devices. An uninterrupted 12-step
+run records a sha256 parameter digest; a second run is SIGKILLed
+mid-training (one worker first — the pod failure model: one host dies,
+the synchronous collective wedges the rest, the launcher reaps the
+job), then ALL processes restart with --resume and reload the latest
+atomic checkpoint. Digests must be bit-identical to the uninterrupted
+run on every process.
+
+    python scripts/multihost_smoke.py          # both legs
 """
 
 import argparse
@@ -31,7 +37,7 @@ PORT = 12000 + (os.getpid() % 2000)  # avoid collisions across runs
 def child(args):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                                f" --xla_force_host_platform_device_count="
-                               f"{DEVICES_PER_PROC}")
+                               f"{args.devices_per_proc}")
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
@@ -52,7 +58,8 @@ def child(args):
 
     Engine.init_distributed()
     assert jax.process_count() == args.num_processes, jax.process_count()
-    assert jax.device_count() == args.num_processes * DEVICES_PER_PROC
+    assert jax.device_count() == (args.num_processes
+                                  * args.devices_per_proc)
 
     import numpy as np
 
@@ -95,40 +102,53 @@ def child(args):
             opt.resume_from_checkpoint()
         return opt.optimize()
 
-    m1 = train(3, resume=False)       # 3 steps + checkpoint
-    m2 = train(6, resume=True)        # resume, 3 more steps
+    if args.leg == "smoke":
+        m1 = train(3, resume=False)   # 3 steps + checkpoint
+        m2 = train(6, resume=True)    # resume, 3 more steps
+    else:  # kill_resume: one uninterrupted (or resumed) run to the end
+        m2 = train(args.end_iter, resume=args.resume)
 
-    flat = np.concatenate([np.ravel(np.asarray(a))
+    flat = np.concatenate([np.ravel(np.asarray(a, np.float32))
                            for _, a in m2.parameters()])
     assert np.isfinite(flat).all(), "non-finite parameters"
 
     # parameters must be IDENTICAL across processes (replicated plane):
-    # compare a digest via the filesystem
+    # compare digests via the filesystem. sha256 of the raw bytes is the
+    # bit-identity check; the float sum stays for human logs.
+    import hashlib
+
     digest = float(np.sum(np.abs(flat)))
+    sha = hashlib.sha256(flat.tobytes()).hexdigest()
     out = {"process_id": args.process_id, "digest": digest,
+           "sha256": sha,
            "processes": jax.process_count(),
            "devices": jax.device_count(),
-           "checkpoint_resumed": True}
+           "checkpoint_resumed": args.leg == "smoke" or args.resume}
     with open(os.path.join(args.workdir, f"proc{args.process_id}.json"),
               "w") as f:
         json.dump(out, f)
-    print(f"[proc {args.process_id}] OK digest={digest:.6f}")
+    print(f"[proc {args.process_id}] OK digest={digest:.6f} sha={sha[:12]}")
 
 
-def launcher():
-    import tempfile
-
-    workdir = tempfile.mkdtemp(prefix="multihost_smoke_")
+def _spawn_group(leg, n_procs, devices_per_proc, port, workdir,
+                 end_iter=6, resume=False):
     procs = []
-    for pid in range(NUM_PROCESSES):
-        procs.append(subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__),
-             "--process-id", str(pid),
-             "--num-processes", str(NUM_PROCESSES),
-             "--port", str(PORT), "--workdir", workdir],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    for pid in range(n_procs):
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--process-id", str(pid), "--num-processes", str(n_procs),
+               "--devices-per-proc", str(devices_per_proc),
+               "--port", str(port), "--workdir", workdir,
+               "--leg", leg, "--end-iter", str(end_iter)]
+        if resume:
+            cmd.append("--resume")
+        procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT))
+    return procs
+
+
+def _reap(procs, timeout=420):
     try:
-        outs = [p.communicate(timeout=420)[0].decode() for p in procs]
+        outs = [p.communicate(timeout=timeout)[0].decode() for p in procs]
     except subprocess.TimeoutExpired:
         # a hung child must not leak (it holds the coordinator port)
         for p in procs:
@@ -137,20 +157,135 @@ def launcher():
     codes = [p.returncode for p in procs]
     for pid, (c, o) in enumerate(zip(codes, outs)):
         if c != 0:
-            print(f"--- proc {pid} (rc={c}) ---\n{o}")
+            print(f"--- proc {pid} (rc={c}) ---\n{o[-2000:]}")
+    return codes
+
+
+def _collect(workdir, n_procs):
+    digests, shas = [], []
+    for pid in range(n_procs):
+        with open(os.path.join(workdir, f"proc{pid}.json")) as f:
+            d = json.load(f)
+        digests.append(d["digest"])
+        shas.append(d["sha256"])
+    return digests, shas
+
+
+def _leg_smoke(port):
+    import tempfile
+
+    workdir = tempfile.mkdtemp(prefix="multihost_smoke_")
+    procs = _spawn_group("smoke", NUM_PROCESSES, DEVICES_PER_PROC, port,
+                         workdir)
+    codes = _reap(procs)
     ok = all(c == 0 for c in codes)
     digests = []
     if ok:
-        for pid in range(NUM_PROCESSES):
-            with open(os.path.join(workdir, f"proc{pid}.json")) as f:
-                digests.append(json.load(f)["digest"])
+        digests, _ = _collect(workdir, NUM_PROCESSES)
         ok = len(set(digests)) == 1
-    result = {"ok": ok, "processes": NUM_PROCESSES,
-              "devices_per_process": DEVICES_PER_PROC,
-              "return_codes": codes, "digests": digests,
-              "steps": 6, "grad_accum": 2, "checkpoint_resume": True}
+    return {"ok": ok, "processes": NUM_PROCESSES,
+            "devices_per_process": DEVICES_PER_PROC,
+            "return_codes": codes, "digests": digests,
+            "steps": 6, "grad_accum": 2, "checkpoint_resume": True}
+
+
+def _leg_kill_resume(port):
+    """4-process job, one worker SIGKILLed mid-training, full restart
+    with --resume: parameter sha256 must equal the uninterrupted run's
+    on every process."""
+    import tempfile
+    import time
+
+    n, dpp, end = 4, 2, 12
+    # uninterrupted reference run
+    wd_ref = tempfile.mkdtemp(prefix="multihost_ref_")
+    codes_ref = _reap(_spawn_group("kill_resume", n, dpp, port, wd_ref,
+                                   end_iter=end))
+    if any(c != 0 for c in codes_ref):
+        return {"ok": False, "stage": "reference", "return_codes": codes_ref}
+    _, shas_ref = _collect(wd_ref, n)
+
+    # interrupted run: kill worker 2 as soon as the FIRST checkpoint
+    # (checkpoint-3 of 12 steps) is published — earliest point where a
+    # resume is possible, widest remaining-training window for the kill
+    # to land mid-run. Poll fast: the whole CPU job takes seconds.
+    wd = tempfile.mkdtemp(prefix="multihost_kill_")
+    procs = _spawn_group("kill_resume", n, dpp, port + 1, wd,
+                         end_iter=end)
+    ckdir = os.path.join(wd, "ckpt")
+    marker = os.path.join(ckdir, "checkpoint-3")
+    deadline = time.time() + 300
+    saw_ckpt = False
+    while time.time() < deadline:
+        if os.path.isdir(marker):
+            saw_ckpt = True
+            break
+        if any(p.poll() is not None for p in procs):
+            break  # someone already exited — fail below
+        time.sleep(0.05)
+    killed_mid_training = False
+    latest_at_kill = None
+    if saw_ckpt and all(p.poll() is None for p in procs):
+        procs[2].kill()              # the dying host
+        killed_mid_training = True
+        import re
+        published = [d for d in os.listdir(ckdir)
+                     if re.fullmatch(r"checkpoint-(\d+)", d)]
+        latest_at_kill = max(published,
+                             key=lambda d: int(d.split("-")[1]))
+        time.sleep(5)                # collective wedges; reap the job
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    _reap(procs, timeout=30)
+    if not killed_mid_training:
+        return {"ok": False, "stage": "kill",
+                "detail": "training finished (or a worker exited) before "
+                          "the kill could land after checkpoint-3 — "
+                          "no mid-training recovery was exercised"}
+
+    # full restart with --resume: reload latest checkpoint, finish
+    codes_res = _reap(_spawn_group("kill_resume", n, dpp, port + 2, wd,
+                                   end_iter=end, resume=True))
+    if any(c != 0 for c in codes_res):
+        return {"ok": False, "stage": "resume", "return_codes": codes_res}
+    _, shas_res = _collect(wd, n)
+
+    ok = (len(set(shas_res)) == 1 and len(set(shas_ref)) == 1
+          and shas_res[0] == shas_ref[0])
+    return {"ok": ok, "processes": n, "devices_per_process": dpp,
+            "steps": end, "killed_process": 2,
+            "latest_checkpoint_at_kill": latest_at_kill,
+            "sha256_uninterrupted": shas_ref[0][:16],
+            "sha256_resumed": shas_res[0][:16],
+            "bit_identical": ok}
+
+
+def launcher(legs):
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "MULTIHOST.json")
+    # merge-preserving: running a subset of legs keeps the other legs'
+    # last recorded results in the artifact
+    result = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                result = json.load(f)
+        except Exception:
+            result = {}
+    ok = True
+    if "smoke" in legs:
+        smoke = _leg_smoke(PORT)
+        kill_prev = result.get("kill_resume")
+        result = dict(smoke)  # legacy top-level shape for leg 1
+        if kill_prev is not None:
+            result["kill_resume"] = kill_prev
+        ok = ok and smoke["ok"]
+    if "kill_resume" in legs:
+        kill = _leg_kill_resume(PORT + 10)
+        result["kill_resume"] = kill
+        ok = ok and kill.get("ok", False)
+    result["ok"] = bool(ok and result.get("ok", True))
     with open(path, "w") as f:
         json.dump(result, f)
     print(json.dumps(result))
@@ -161,11 +296,19 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--process-id", type=int, default=None)
     ap.add_argument("--num-processes", type=int, default=NUM_PROCESSES)
+    ap.add_argument("--devices-per-proc", type=int,
+                    default=DEVICES_PER_PROC)
     ap.add_argument("--port", type=int, default=PORT)
     ap.add_argument("--workdir", default=None)
+    ap.add_argument("--leg", default="smoke",
+                    choices=["smoke", "kill_resume"])
+    ap.add_argument("--legs", default="smoke,kill_resume",
+                    help="launcher mode: comma subset of legs to run")
+    ap.add_argument("--end-iter", type=int, default=6)
+    ap.add_argument("--resume", action="store_true")
     args = ap.parse_args()
     if args.process_id is None:
-        launcher()
+        launcher(set(args.legs.split(",")))
     else:
         child(args)
 

@@ -430,3 +430,16 @@ def test_deconvolution_matches_torch(tmp_path):
                            training=False)
     np.testing.assert_allclose(np.asarray(out2), np.asarray(out),
                                rtol=1e-5, atol=1e-5)
+
+def test_persist_asymmetric_padding_clear_error(tmp_path):
+    """Tuple (low, high) padding (s2d stem) has no Caffe encoding —
+    must raise a clear ValueError, not an opaque protobuf TypeError."""
+    seq = nn.Sequential()
+    seq.add(nn.SpatialConvolution(3, 4, 2, 2, 2, 2,
+                                  pad_w=(0, 1), pad_h=(0, 1)
+                                  ).set_name("s2d"))
+    variables = seq.init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="asymmetric"):
+        caffe.persist(str(tmp_path / "m.prototxt"),
+                      str(tmp_path / "m.caffemodel"),
+                      seq, variables, (1, 3, 8, 8))

@@ -93,6 +93,28 @@ class TestGraph:
         assert len(out) == 2
         assert out[1].shape == (1, 3)
 
+    def test_shared_stateful_module_composes_state(self):
+        # a module object used at two graph nodes shares weights AND
+        # must COMPOSE running-stat updates: the second application
+        # starts from the first's new state (not overwrite it)
+        bn = nn.BatchNormalization(4, momentum=0.1)
+        x = nn.Input()
+        h = bn(x)
+        y = bn(nn.ReLU()(h))
+        g = nn.Graph(x, y).build(KEY)
+        xv = jnp.arange(12.0).reshape(3, 4)
+        _, new_state = g.apply(g.variables, xv, training=True)
+        key = [k for k in new_state if new_state[k]][0]
+        got = np.asarray(new_state[key]["running_mean"])
+
+        # oracle: two sequential EMA updates through the same bn
+        v1 = {"params": g.variables["params"][key], "state": bn.init_state()}
+        o1, s1 = bn.apply(v1, xv, training=True)
+        _, s2 = bn.apply({"params": v1["params"], "state": s1},
+                         jnp.maximum(o1, 0.0), training=True)
+        np.testing.assert_allclose(got, np.asarray(s2["running_mean"]),
+                                   rtol=1e-6, atol=1e-6)
+
     def test_grad_through_graph(self):
         x = nn.Input()
         y = nn.Linear(3, 1)(nn.Tanh()(nn.Linear(3, 3)(x)))

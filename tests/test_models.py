@@ -80,6 +80,49 @@ class TestInception:
         m = inception.build(1000, has_dropout=False).build(KEY)
         assert 5.5e6 < n_params(m) < 7.5e6
 
+    def test_fused_branches_numerically_identical(self):
+        """The reduce-merged layer must be EXACTLY the 4-branch layer
+        with the three reduce-conv weights concatenated (ReLU commutes
+        with the channel slice)."""
+        cfg = ((64,), (96, 128), (16, 32), (32,))
+        lu = inception.inception_layer_v1(192, cfg, "3a/")
+        lf = inception.inception_layer_v1_fused(192, cfg, "3a/")
+        vu = lu.init(KEY)
+        vf = lf.init(KEY)
+        pu, pf = vu["params"], vf["params"]
+        # merged reduce conv = concat of 1x1 / 3x3r / 5x5r over out-chans
+        mg = pf["1_Sequential"]["0_3a/reduce_merged/conv1x1"]
+        mg["weight"] = jnp.concatenate([
+            pu["0_Sequential"]["0_3a/1x1/conv1x1"]["weight"],
+            pu["1_Sequential"]["0_Sequential"]["0_3a/3x3r/conv1x1"]["weight"],
+            pu["2_Sequential"]["0_Sequential"]["0_3a/5x5r/conv1x1"]["weight"],
+        ], axis=3)
+        mg["bias"] = jnp.concatenate([
+            pu["0_Sequential"]["0_3a/1x1/conv1x1"]["bias"],
+            pu["1_Sequential"]["0_Sequential"]["0_3a/3x3r/conv1x1"]["bias"],
+            pu["2_Sequential"]["0_Sequential"]["0_3a/5x5r/conv1x1"]["bias"],
+        ])
+        pf["4_Sequential"]["0_3a/3x3/conv3x3"] = \
+            pu["1_Sequential"]["1_Sequential"]["0_3a/3x3/conv3x3"]
+        pf["6_Sequential"]["0_3a/5x5/conv5x5"] = \
+            pu["2_Sequential"]["1_Sequential"]["0_3a/5x5/conv5x5"]
+        pf["7_Sequential"]["1_Sequential"]["0_3a/pool/conv1x1"] = \
+            pu["3_Sequential"]["1_Sequential"]["0_3a/pool/conv1x1"]
+
+        x = jax.random.normal(jax.random.PRNGKey(3), (2, 28, 28, 192))
+        yu, _ = lu.apply(vu, x, training=False)
+        yf, _ = lf.apply(vf, x, training=False)
+        # one merged gemm vs three: accumulation order differs at ulp
+        np.testing.assert_allclose(np.asarray(yf), np.asarray(yu),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_fused_build_shapes_and_params(self):
+        m = inception.build(1000, has_dropout=False,
+                            fused_branches=True).build(KEY)
+        out = m.evaluate().forward(jnp.ones((1, 224, 224, 3)))
+        assert out.shape == (1, 1000)
+        assert 5.5e6 < n_params(m) < 7.5e6  # same params, merged layout
+
 
 class TestAlexNetVgg:
     def test_alexnet(self):

@@ -115,3 +115,15 @@ def test_train_replay_stateless(tmp_path):
     it2 = ds.data(train=True)
     run2 = [int(next(it2).label) for _ in range(20)]
     assert run1 == run2
+
+def test_count_tfrecords_seek_and_sidecar(tmp_path):
+    from bigdl_tpu.dataset.tfrecord import count_tfrecords
+
+    images = np.zeros((10, 4, 4, 1), np.uint8)
+    p = str(tmp_path / "c.tfrecord")
+    write_image_examples(p, images, list(range(10)))
+    assert count_tfrecords(p) == 10          # framing-seek path
+    (tmp_path / "c.tfrecord.count").write_text("10\n")
+    assert count_tfrecords(p) == 10          # sidecar path
+    ds = TFRecordDataSet(str(tmp_path))
+    assert ds.size() == 10
