@@ -212,7 +212,10 @@ def count_tfrecords(path: str) -> int:
     holding the integer short-circuits even that (write one when
     producing ImageNet-scale shards)."""
     sidecar = path + ".count"
-    if os.path.exists(sidecar):
+    # trust the sidecar only if it's at least as new as the shard — a
+    # regenerated shard with a stale sidecar must fall back to the scan
+    if (os.path.exists(sidecar)
+            and os.path.getmtime(sidecar) >= os.path.getmtime(path)):
         with open(sidecar) as f:
             return int(f.read().strip())
     n = 0

@@ -110,10 +110,10 @@ class TransformerConfig:
     moe_aux_weight: float = 0.01
 
     def __post_init__(self):
-        if self.remat_policy not in ("full", "dots"):
+        if self.remat_policy not in ("full", "dots", "attn_saved"):
             raise ValueError(
-                f"remat_policy {self.remat_policy!r}: expected 'full' "
-                "or 'dots'")
+                f"remat_policy {self.remat_policy!r}: expected 'full', "
+                "'dots' or 'attn_saved'")
         if self.moe_experts and self.moe_top_k not in (1, 2):
             raise ValueError("moe_top_k must be 1 or 2")
 
@@ -243,13 +243,21 @@ class TransformerLM(Module):
         return flash_attention(q, k, v, causal=self.cfg.causal,
                                impl=self.attn_impl)
 
-    def _block(self, x, bp, dropout_rng, training):
+    def _block(self, x, bp, dropout_rng, training, remat_mlp=False):
         """One pre-LN block. Works unchanged under tensor parallelism:
         with `tp_axis` set (inside shard_map), wq/wk/wv/w1 arrive
         column-sharded and wo/w2 row-sharded, so the local head count is
         inferred from the weight shape and the two row-parallel matmuls
         are followed by a psum — the Megatron-style split expressed as
-        per-device code + XLA collectives."""
+        per-device code + XLA collectives.
+
+        remat_mlp=True (the "attn_saved" policy) checkpoints ONLY the
+        FFN half: the attention half runs outside any remat region, so
+        the flash kernel's custom-vjp residuals (q,k,v,out,lse) stay
+        saved and the backward does NOT re-run the forward kernel —
+        under a whole-block policy nothing saves the Pallas call's
+        outputs (it is not a dot_general), so the fwd kernel reruns
+        once per layer in the backward (PROFILE_r05)."""
         c = self.cfg
         b, s, e = x.shape
         d = self.head_dim
@@ -279,26 +287,30 @@ class TransformerLM(Module):
                           a, 0.0) / keep
         x = x + a
 
-        y = self._ln(x, bp["ln2_g"], bp["ln2_b"])
-        aux = jnp.zeros((), jnp.float32)
-        if c.moe_experts:
-            moe_p = {"router": bp["router"], "w1": bp["w1"],
-                     "b1": bp["b1"], "w2": bp["w2"], "b2": bp["b2"]}
-            (y, aux), _ = self._moe.apply({"params": moe_p, "state": {}},
-                                          y)
-        else:
-            if self.tp_axis is not None:
-                y = tp_identity(y, self.tp_axis)
-            y = jax.nn.gelu(y @ bp["w1"] + bp["b1"])
-            y = y @ bp["w2"]                  # row-parallel: partial sums
-            if self.tp_axis is not None:
-                y = tp_reduce(y, self.tp_axis)
-            y = y + bp["b2"]
-        if training and c.dropout > 0.0:
-            keep = 1.0 - c.dropout
-            k2, _ = jax.random.split(dropout_rng)
-            y = jnp.where(jax.random.bernoulli(k2, keep, y.shape),
-                          y, 0.0) / keep
+        def ffn(xres):
+            y = self._ln(xres, bp["ln2_g"], bp["ln2_b"])
+            aux = jnp.zeros((), jnp.float32)
+            if c.moe_experts:
+                moe_p = {"router": bp["router"], "w1": bp["w1"],
+                         "b1": bp["b1"], "w2": bp["w2"], "b2": bp["b2"]}
+                (y, aux), _ = self._moe.apply(
+                    {"params": moe_p, "state": {}}, y)
+            else:
+                if self.tp_axis is not None:
+                    y = tp_identity(y, self.tp_axis)
+                y = jax.nn.gelu(y @ bp["w1"] + bp["b1"])
+                y = y @ bp["w2"]              # row-parallel: partial sums
+                if self.tp_axis is not None:
+                    y = tp_reduce(y, self.tp_axis)
+                y = y + bp["b2"]
+            if training and c.dropout > 0.0:
+                keep = 1.0 - c.dropout
+                k2, _ = jax.random.split(dropout_rng)
+                y = jnp.where(jax.random.bernoulli(k2, keep, y.shape),
+                              y, 0.0) / keep
+            return y, aux
+
+        y, aux = (jax.checkpoint(ffn) if remat_mlp else ffn)(x)
         return x + y, aux
 
     def apply_hidden(self, variables, tokens, training=False, rng=None,
@@ -342,10 +354,13 @@ class TransformerLM(Module):
             raise ValueError(f"{self.name}: dropout needs rng in training")
         base_rng = rng if rng is not None else jax.random.PRNGKey(0)
 
+        remat_mlp = c.remat and c.remat_policy == "attn_saved"
+
         def body(carry, layer):
             x, aux_sum = carry
             bp, lrng = layer
-            x, aux = self._block(x, bp, lrng, training)
+            x, aux = self._block(x, bp, lrng, training,
+                                 remat_mlp=remat_mlp)
             return (x, aux_sum + aux), None
 
         if c.remat:
@@ -353,6 +368,8 @@ class TransformerLM(Module):
                 body = jax.checkpoint(
                     body, policy=jax.checkpoint_policies
                     .dots_with_no_batch_dims_saveable)
+            elif c.remat_policy == "attn_saved":
+                pass  # per-block FFN checkpoint only (see _block)
             else:
                 body = jax.checkpoint(body)
         layer_rngs = jax.random.split(base_rng, c.num_layers)

@@ -149,11 +149,16 @@ class SpatialDilatedConvolution(SpatialConvolution):
 
 class SpatialFullConvolution(Module):
     """Transposed convolution (reference: nn/SpatialFullConvolution.scala;
-    adjW/adjH map to extra output padding)."""
+    adjW/adjH map to extra output padding). `n_group`/`dilation_*`
+    mirror torch ConvTranspose2d's groups/dilation: group j maps input
+    channel block j to output channel block j (the exact adjoint of a
+    grouped forward conv); dilation spreads the kernel taps."""
 
     def __init__(self, n_input_plane, n_output_plane, kernel_w, kernel_h=None,
                  stride_w=1, stride_h=None, pad_w=0, pad_h=None,
                  adj_w: int = 0, adj_h: int = 0, with_bias: bool = True,
+                 n_group: int = 1, dilation_w: int = 1,
+                 dilation_h: Optional[int] = None,
                  name: Optional[str] = None):
         super().__init__(name=name)
         self.n_input_plane = n_input_plane
@@ -166,15 +171,25 @@ class SpatialFullConvolution(Module):
         self.pad_h = pad_h if pad_h is not None else pad_w
         self.adj_w, self.adj_h = adj_w, adj_h
         self.with_bias = with_bias
+        if n_input_plane % n_group or n_output_plane % n_group:
+            raise ValueError(
+                f"n_group {n_group} must divide n_input_plane "
+                f"{n_input_plane} and n_output_plane {n_output_plane}")
+        self.n_group = n_group
+        self.dilation_w = dilation_w
+        self.dilation_h = (dilation_h if dilation_h is not None
+                           else dilation_w)
 
     def init_params(self, rng):
         wk, bk = jax.random.split(rng)
         fan_in = self.n_input_plane * self.kernel_h * self.kernel_w
         fan_out = self.n_output_plane * self.kernel_h * self.kernel_w
         p = {
+            # HWOI with O = total out channels, I = in/groups; O block j
+            # pairs with lhs channel block j under feature_group_count
             "weight": Xavier()(
                 wk, (self.kernel_h, self.kernel_w, self.n_output_plane,
-                     self.n_input_plane),
+                     self.n_input_plane // self.n_group),
                 fan_in=fan_in, fan_out=fan_out,
             )
         }
@@ -185,8 +200,12 @@ class SpatialFullConvolution(Module):
     def apply(self, variables, x, training=False, rng=None):
         p = variables["params"]
         kh, kw = self.kernel_h, self.kernel_w
-        pad_h = (kh - 1 - self.pad_h, kh - 1 - self.pad_h + self.adj_h)
-        pad_w = (kw - 1 - self.pad_w, kw - 1 - self.pad_w + self.adj_w)
+        dh, dw = self.dilation_h, self.dilation_w
+        # dilated kernel extent replaces k-1 in the adjoint padding
+        pad_h = (dh * (kh - 1) - self.pad_h,
+                 dh * (kh - 1) - self.pad_h + self.adj_h)
+        pad_w = (dw * (kw - 1) - self.pad_w,
+                 dw * (kw - 1) - self.pad_w + self.adj_w)
         # transposed conv = cross-correlation of the lhs-dilated input
         # with the kernel ROTATED 180° — the flip is what makes this the
         # exact adjoint of SpatialConvolution (torch ConvTranspose2d
@@ -199,7 +218,9 @@ class SpatialFullConvolution(Module):
             window_strides=(1, 1),
             padding=[pad_h, pad_w],
             lhs_dilation=(self.stride_h, self.stride_w),
+            rhs_dilation=(dh, dw),
             dimension_numbers=dn,
+            feature_group_count=self.n_group,
         )
         if self.with_bias:
             y = y + p["bias"]

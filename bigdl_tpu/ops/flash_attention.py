@@ -539,8 +539,27 @@ def _flash_bwd_pallas_fused(q, k, v, o, lse, do, causal, sm_scale,
 _FUSED_BWD_MAX_RESIDENT_BYTES = 13 * 1024 * 1024
 
 
-_FUSED_BWD_MAX_TILE = 1024 * 512  # bq*bk above this fails to compile
-                                  # (s-tile + dq scratch exceed VMEM)
+def _env_bwd_tiles():
+    """Optional `BIGDL_FLASH_BWD_TILES=BQxBK` override for the fused
+    backward's tiles — the perf-tuning knob the tile sweeps drive
+    (PROFILE_r05/bwd_tile_sweep: the optimum is shape-dependent —
+    1024x1024 at BH=128, kv-wide 1024x2048 at BH=64)."""
+    import os
+
+    v = os.environ.get("BIGDL_FLASH_BWD_TILES")
+    if not v:
+        return None
+    bq, bk = v.lower().split("x")
+    return int(bq), int(bk)
+
+
+_FUSED_BWD_MAX_TILE = 1024 * 512  # bq*bk cap for the fused backward's
+# DEFAULT tile derivation (512x1024 at the default fwd blocks). Round-5
+# re-swept with the 64 MiB kernel-vmem limit: true 1024x1024 and
+# kv-wide 1024x2048 tiles now COMPILE but are in-model neutral (186M:
+# 259.4 vs 258.7 ms) to slightly worse (43M op-level 9.70/10.67 vs
+# 9.43 ms) — PROFILE_r05/bwd_tile_sweep. Explicit bwd_tiles/env
+# overrides bypass this cap entirely.
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q,
@@ -559,17 +578,21 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q,
         # 15.94 at 512x512 — PROFILE_r05/bwd_tile_sweep.log); the
         # serial kv loop amortizes better with a WIDE kv tile.
         # `bwd_tiles` overrides for experimentation.
+        if bwd_tiles is None:
+            bwd_tiles = _env_bwd_tiles()
         if bwd_tiles is not None:
-            fb_q, fb_k = bwd_tiles
-            fb_q = _clamp_block(fb_q, q.shape[1])
-            fb_k = _clamp_block(fb_k, k.shape[1])
+            # explicit/env tiles are trusted as-is (only seq-clamped):
+            # the auto-shrink below would silently rewrite a swept
+            # override into a different config
+            fb_q = _clamp_block(bwd_tiles[0], q.shape[1])
+            fb_k = _clamp_block(bwd_tiles[1], k.shape[1])
         else:
             fb_q, fb_k = block_q, block_k
-        while fb_q * fb_k > _FUSED_BWD_MAX_TILE:
-            if fb_q >= fb_k:
-                fb_q //= 2
-            else:
-                fb_k //= 2
+            while fb_q * fb_k > _FUSED_BWD_MAX_TILE:
+                if fb_q >= fb_k:
+                    fb_q //= 2
+                else:
+                    fb_k //= 2
         return _flash_bwd_pallas_fused(q, k, v, o, lse, do, causal,
                                        sm_scale, fb_q, fb_k, interpret)
     return _flash_bwd_pallas_split(q, k, v, o, lse, do, causal, sm_scale,

@@ -308,7 +308,8 @@ class CaffeLoader:
     def _deconv(self, p, blobs, in_shape=None):
         """Caffe Deconvolution → SpatialFullConvolution (transposed
         conv). Blob layout is (I, O/g, kH, kW) — input channels FIRST,
-        the transpose of Convolution's (O, I/g, kH, kW)."""
+        the transpose of Convolution's (O, I/g, kH, kW). Grouped and
+        dilated variants map onto the module's n_group/dilation."""
         cp = p.convolution_param
         kh = int(cp.kernel_h or (cp.kernel_size[0] if cp.kernel_size else 1))
         kw = int(cp.kernel_w or (cp.kernel_size[-1] if cp.kernel_size else 1))
@@ -316,10 +317,8 @@ class CaffeLoader:
         sw = int(cp.stride_w or (cp.stride[-1] if cp.stride else 1))
         ph = int(cp.pad_h or (cp.pad[0] if cp.pad else 0))
         pw = int(cp.pad_w or (cp.pad[-1] if cp.pad else 0))
-        if int(cp.group) > 1:
-            raise NotImplementedError("grouped Deconvolution")
-        if cp.dilation and int(cp.dilation[0]) > 1:
-            raise NotImplementedError("dilated Deconvolution")
+        group = int(cp.group) if cp.group else 1
+        dil = int(cp.dilation[0]) if cp.dilation else 1
         n_out = int(cp.num_output)
         if not blobs:
             if in_shape is None or len(in_shape) != 4:
@@ -328,13 +327,23 @@ class CaffeLoader:
                     "shape (declare input_shape in the prototxt)")
             m = nn.SpatialFullConvolution(
                 int(in_shape[-1]), n_out, kw, kh, sw, sh, pw, ph,
-                with_bias=cp.bias_term)
+                with_bias=cp.bias_term, n_group=group, dilation_w=dil)
             return m, None
-        w = _blob_array(blobs[0])  # (I, O, kH, kW)
+        w = _blob_array(blobs[0])  # (I, O/g, kH, kW)
+        n_in = int(w.shape[0])
         m = nn.SpatialFullConvolution(
-            int(w.shape[0]), n_out, kw, kh, sw, sh, pw, ph,
-            with_bias=cp.bias_term)
-        params = {"weight": w.transpose(2, 3, 1, 0)}  # IOHW → HWOI
+            n_in, n_out, kw, kh, sw, sh, pw, ph,
+            with_bias=cp.bias_term, n_group=group, dilation_w=dil)
+        if group == 1:
+            wn = w.transpose(2, 3, 1, 0)          # IOHW → HWOI
+        else:
+            # per-group (I/g, O/g, kH, kW) slices stack along the module
+            # weight's O axis: (kH, kW, O_total, I/g)
+            ig = n_in // group
+            wn = np.concatenate(
+                [w[g * ig:(g + 1) * ig].transpose(2, 3, 1, 0)
+                 for g in range(group)], axis=2)
+        params = {"weight": wn}
         if cp.bias_term:
             params["bias"] = _blob_array(blobs[1]).reshape(-1)
         return m, {"params": params, "state": {}}
@@ -689,7 +698,21 @@ class CaffePersister:
             cp.stride_h, cp.stride_w = mod.stride_h, mod.stride_w
             cp.pad_h, cp.pad_w = _sym_pad(mod)
             cp.bias_term = mod.with_bias
-            w = np.asarray(p["weight"]).transpose(3, 2, 0, 1)  # HWOI→IOHW
+            if mod.n_group > 1:
+                cp.group = mod.n_group
+            if mod.dilation_h != mod.dilation_w:
+                raise ValueError(
+                    "Caffe Deconvolution has one dilation field; "
+                    f"{mod.name!r} has {mod.dilation_h}x{mod.dilation_w}")
+            if mod.dilation_w > 1:
+                cp.dilation.append(mod.dilation_w)
+            wm = np.asarray(p["weight"])               # (kH,kW,O_tot,I/g)
+            g = mod.n_group
+            og = mod.n_output_plane // g
+            # inverse of the loader mapping: O-blocks → caffe I axis
+            w = np.concatenate(
+                [wm[:, :, j * og:(j + 1) * og, :].transpose(3, 2, 0, 1)
+                 for j in range(g)], axis=0)           # (I, O/g, kH, kW)
             _fill_blob(l.blobs.add(), w)
             if mod.with_bias:
                 _fill_blob(l.blobs.add(), np.asarray(p["bias"]))

@@ -110,7 +110,7 @@ def main():
     ap.add_argument("--trace", default=None, help="jax.profiler trace dir")
     ap.add_argument("--remat", action="store_true")
     ap.add_argument("--remat-policy", default="full",
-                    choices=["full", "dots"])
+                    choices=["full", "dots", "attn_saved"])
     ap.add_argument("--attn-impl", default=None,
                     choices=[None, "pallas", "reference", "xla"],
                     help="attention implementation for the in-model runs")

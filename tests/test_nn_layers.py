@@ -116,6 +116,31 @@ class TestConv:
             np.asarray(out), want.numpy().transpose(0, 2, 3, 1),
             rtol=1e-4, atol=1e-4)
 
+    def test_transposed_grouped_dilated_matches_torch(self):
+        import torch
+
+        rng = np.random.RandomState(4)
+        m = nn.SpatialFullConvolution(4, 6, 3, 3, 2, 2, 1, 1,
+                                      n_group=2, dilation_w=2)
+        v = m.init(jax.random.PRNGKey(0))
+        x = rng.randn(2, 5, 5, 4).astype(np.float32)
+        out, _ = m.apply(v, jnp.asarray(x))
+        # ours (kH,kW,O_total,I/g); torch wants (I_total, O/g, kH, kW):
+        # stack the per-group O-blocks along the input axis
+        w = np.asarray(v["params"]["weight"])       # (3,3,6,2)
+        w_t = np.concatenate([w[:, :, g * 3:(g + 1) * 3, :]
+                              .transpose(3, 2, 0, 1)
+                              for g in range(2)], axis=0)  # (4,3,3,3)
+        want = torch.nn.functional.conv_transpose2d(
+            torch.from_numpy(x.transpose(0, 3, 1, 2)),
+            torch.from_numpy(w_t),
+            torch.from_numpy(np.asarray(v["params"]["bias"])),
+            stride=2, padding=1, groups=2, dilation=2)
+        assert out.shape == want.numpy().transpose(0, 2, 3, 1).shape
+        np.testing.assert_allclose(
+            np.asarray(out), want.numpy().transpose(0, 2, 3, 1),
+            rtol=1e-4, atol=1e-4)
+
 
 class TestPooling:
     def test_max_pool(self):

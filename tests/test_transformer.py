@@ -91,8 +91,11 @@ def test_sequence_parallel_matches_single_device():
                                atol=2e-5, rtol=2e-5)
 
 
-def test_remat_matches_no_remat():
-    """jax.checkpoint must not change values or grads, only memory."""
+@pytest.mark.parametrize("policy", ["full", "dots", "attn_saved"])
+def test_remat_matches_no_remat(policy):
+    """jax.checkpoint must not change values or grads, only memory —
+    for EVERY policy, including attn_saved (FFN-half-only checkpoint,
+    the bench.py LM default)."""
     import numpy as np
 
     from bigdl_tpu.models.transformer import (
@@ -103,7 +106,8 @@ def test_remat_matches_no_remat():
     base = dict(vocab_size=50, max_len=16, dim=32, num_heads=4,
                 num_layers=2)
     m1 = TransformerLM(TransformerConfig(**base, remat=False))
-    m2 = TransformerLM(TransformerConfig(**base, remat=True))
+    m2 = TransformerLM(TransformerConfig(**base, remat=True,
+                                         remat_policy=policy))
     v = m1.init(jax.random.PRNGKey(0))
 
     def loss(model, p):
