@@ -40,6 +40,7 @@ Measurement notes:
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -296,6 +297,157 @@ def bench_resnet_diskpipe(batch, iters, on_tpu, synthetic_step_s=None):
             "native_plane": pf.native,
         }), flush=True)
         pf.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_lm_diskpipe(iters, on_tpu):
+    """43M-LM training fed from TFRecord shards ON DISK with the
+    double-buffered input pipeline. The ResNet diskpipe row cannot
+    demonstrate overlap through the dev tunnel (38 MB/batch vs a
+    ~2-15 MB/s H2D link: input is 100x the step, nothing can hide);
+    tokens are 64 KB/batch, so here input MUST vanish under the step —
+    step ≈ max(compute, input), overlap_hide_frac ≈ 1. This is the
+    framework-property demonstration VERDICT r4 item 4 asked for.
+    """
+    import shutil
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset.tfrecord import (decode_example,
+                                            encode_example,
+                                            read_tfrecords,
+                                            write_tfrecords)
+    from bigdl_tpu.models.transformer import TransformerConfig, TransformerLM
+    from bigdl_tpu.ops.losses import build_train_loss
+    from bigdl_tpu.optim import Adam
+    from bigdl_tpu.utils.precision import DEFAULT_MIXED as POLICY
+
+    batch, seq, vocab = (8, 2048, 32000) if on_tpu else (2, 128, 256)
+    dim, layers, heads = (512, 8, 8) if on_tpu else (64, 2, 2)
+    tmp = tempfile.mkdtemp(prefix="lmpipe_")
+    try:
+        rng = np.random.RandomState(0)
+        n_seqs = batch * (iters + 8)
+        for s in range(4):
+            payloads = [encode_example({
+                "tokens": rng.randint(0, vocab, seq + 1).astype(np.int64),
+            }) for _ in range(n_seqs // 4)]
+            write_tfrecords(os.path.join(tmp, f"s{s}.tfrecord"), payloads)
+
+        cfg = TransformerConfig(vocab_size=vocab, max_len=seq, dim=dim,
+                                num_heads=heads, num_layers=layers,
+                                remat=on_tpu,
+                                remat_policy="attn_saved" if on_tpu
+                                else "full")
+        model = TransformerLM(cfg)
+        variables = model.init(jax.random.PRNGKey(0))
+        method = Adam(3e-4)
+        loss_call = build_train_loss(model, nn.ChunkedSoftmaxCE(), POLICY)
+
+        @jax.jit
+        def step(bx, by, carry):
+            params, slots = carry
+            (loss, _), grads = jax.value_and_grad(
+                lambda p: loss_call(p, {}, bx, by, jax.random.PRNGKey(1)),
+                has_aux=True)(params)
+            new_params, new_slots = method.update(
+                grads, params, slots, jnp.asarray(3e-4), jnp.asarray(0))
+            return (new_params, new_slots), loss
+
+        def reader():
+            """Endless host pipeline: shards → decoded → batches."""
+            while True:
+                for s in range(4):
+                    buf = []
+                    for raw in read_tfrecords(
+                            os.path.join(tmp, f"s{s}.tfrecord")):
+                        toks = np.asarray(
+                            decode_example(raw)["tokens"], np.int32)
+                        buf.append(toks)
+                        if len(buf) == batch:
+                            b = np.stack(buf)
+                            buf = []
+                            yield b[:, :-1], b[:, 1:]
+
+        it = reader()
+        carry = (variables["params"],
+                 method.init_slots(variables["params"]))
+        bx, by = next(it)
+        carry, loss = step(jnp.asarray(bx), jnp.asarray(by), carry)
+        float(loss)
+
+        # host-pipeline rate alone
+        t0 = time.perf_counter()
+        for _ in range(8):
+            next(it)
+        host_s = (time.perf_counter() - t0) / 8
+
+        # compute-only rate: device-resident batch pool, no input work
+        # in the loop (a standalone H2D probe can't be fenced honestly
+        # through the tunnel — a fetch adds the full RTT; instead the
+        # hideable input time is derived as serial - compute below)
+        pool = []
+        for _ in range(3):
+            bx, by = next(it)
+            pool.append((jax.device_put(bx), jax.device_put(by)))
+        t0 = time.perf_counter()
+        for i in range(max(iters // 2, 3)):
+            carry, loss = step(*pool[i % 3], carry)
+        float(loss)
+        dt_compute = (time.perf_counter() - t0) / max(iters // 2, 3)
+
+        # serial: read + H2D + step, one after another
+        t0 = time.perf_counter()
+        for _ in range(max(iters // 2, 3)):
+            bx, by = next(it)
+            carry, loss = step(jnp.asarray(bx), jnp.asarray(by), carry)
+        float(loss)
+        dt_serial = (time.perf_counter() - t0) / max(iters // 2, 3)
+
+        # double-buffered: stage batch N+1 under step N
+        ex = ThreadPoolExecutor(1)
+
+        def stage():
+            bx, by = next(it)
+            return jax.device_put(bx), jax.device_put(by)
+
+        fut = ex.submit(stage)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            bx, by = fut.result()
+            fut = ex.submit(stage)
+            carry, loss = step(bx, by, carry)
+        final = float(loss)
+        dt = (time.perf_counter() - t0) / iters
+        fut.result()
+        ex.shutdown(wait=True)
+        import math
+
+        assert math.isfinite(final)
+        platform = "tpu" if on_tpu else "cpu"
+        # input cost the serial loop pays per step (host read + H2D),
+        # derived self-consistently from the three measured loops
+        input_s = max(dt_serial - dt_compute, 1e-9)
+        hide_frac = max(0.0, dt_serial - dt) / min(input_s, dt_serial)
+        tag = "43m" if on_tpu else "tiny"
+        print(json.dumps({
+            "metric": f"transformer_lm_{tag}_train_diskpipe_tokens_per_sec"
+                      f"_per_chip[{platform}]",
+            "value": round(batch * seq / dt, 2), "unit": "tokens/sec",
+            "vs_baseline": None,
+            "step_ms": round(dt * 1e3, 2),
+            "step_serial_ms": round(dt_serial * 1e3, 2),
+            "step_compute_ms": round(dt_compute * 1e3, 2),
+            "host_pipeline_ms": round(host_s * 1e3, 2),
+            "input_serial_cost_ms": round(input_s * 1e3, 2),
+            "overlap_hide_frac": round(min(hide_frac, 1.0), 3),
+        }), flush=True)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -622,8 +774,12 @@ def main(argv=None) -> None:
             bench_lm(512, 8, 8, 8, 2048, 10, on_tpu, "43m")
         if sel("lm186m"):
             bench_lm(1024, 12, 16, 8, 2048, 10, on_tpu, "186m")
+        if sel("lmdiskpipe"):
+            bench_lm_diskpipe(10, on_tpu)
     elif want is None or any(w.startswith("lm") for w in want):
         bench_lm(64, 2, 2, 2, 128, 2, on_tpu, "tiny")
+        if "lmdiskpipe" in (want or ()):
+            bench_lm_diskpipe(4, on_tpu)
 
 
 if __name__ == "__main__":

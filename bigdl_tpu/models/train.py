@@ -50,6 +50,8 @@ def main(argv=None):
                     help="transformer only: Switch/GShard-MoE FFN with "
                          "this many experts (0 = dense)")
     ap.add_argument("--moeTopK", type=int, default=1, choices=[1, 2])
+    ap.add_argument("--moeRouting", default="top_k",
+                    choices=["top_k", "expert_choice"])
     ap.add_argument("--tfrecords", default=None, metavar="DIR|GLOB",
                     help="train a vision model from TFRecord shards of "
                          "tf.train.Examples (image/shape/label layout; "
@@ -132,7 +134,7 @@ def main(argv=None):
         model = TransformerLM(TransformerConfig(
             vocab_size=64, dim=128, num_heads=4, num_layers=2,
             max_len=seq, moe_experts=args.moeExperts,
-            moe_top_k=args.moeTopK))
+            moe_top_k=args.moeTopK, moe_routing=args.moeRouting))
         train = synthetic_next_token(args.batchSize * 4, 64, seq)
         val = train[:args.batchSize]
     else:

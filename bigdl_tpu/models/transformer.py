@@ -108,6 +108,10 @@ class TransformerConfig:
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # "top_k" (Switch/GShard, capacity dropping) or "expert_choice"
+    # (dropless: experts pick tokens, perfectly balanced, aux==0;
+    # NOT causally masked — see parallel/moe.py)
+    moe_routing: str = "top_k"
 
     def __post_init__(self):
         if self.remat_policy not in ("full", "dots", "attn_saved"):
@@ -116,6 +120,10 @@ class TransformerConfig:
                 "'dots' or 'attn_saved'")
         if self.moe_experts and self.moe_top_k not in (1, 2):
             raise ValueError("moe_top_k must be 1 or 2")
+        if self.moe_routing not in ("top_k", "expert_choice"):
+            raise ValueError(
+                f"moe_routing {self.moe_routing!r}: expected 'top_k' "
+                "or 'expert_choice'")
 
 
 class TransformerLM(Module):
@@ -171,6 +179,7 @@ class TransformerLM(Module):
                             config.moe_experts,
                             capacity_factor=config.moe_capacity_factor,
                             top_k=config.moe_top_k,
+                            routing=config.moe_routing,
                             expert_axis=ep_axis, name="moe_ffn")
         if config.dim % config.num_heads:
             raise ValueError("dim must be divisible by num_heads")

@@ -549,8 +549,13 @@ def _env_bwd_tiles():
     v = os.environ.get("BIGDL_FLASH_BWD_TILES")
     if not v:
         return None
-    bq, bk = v.lower().split("x")
-    return int(bq), int(bk)
+    try:
+        bq, bk = v.lower().split("x")
+        return int(bq), int(bk)
+    except ValueError:
+        raise ValueError(
+            f"BIGDL_FLASH_BWD_TILES={v!r}: expected 'BQxBK', e.g. "
+            "'512x1024'") from None
 
 
 _FUSED_BWD_MAX_TILE = 1024 * 512  # bq*bk cap for the fused backward's

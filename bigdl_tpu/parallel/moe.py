@@ -50,16 +50,26 @@ class MoE(Module):
     def __init__(self, dim: int, hidden: int, num_experts: int,
                  capacity_factor: float = 1.25,
                  expert_axis: Optional[str] = None, top_k: int = 1,
+                 routing: str = "top_k",
                  name: Optional[str] = None):
         super().__init__(name=name)
         if top_k not in (1, 2):
             raise ValueError(f"top_k must be 1 or 2, got {top_k}")
+        if routing not in ("top_k", "expert_choice"):
+            raise ValueError(
+                f"routing must be top_k|expert_choice, got {routing!r}")
+        if routing == "expert_choice" and top_k != 1:
+            raise ValueError(
+                "top_k has no meaning under expert_choice routing "
+                "(experts pick tokens; capacity_factor is the knob) — "
+                "leave top_k=1")
         self.dim = dim
         self.hidden = hidden
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
         self.expert_axis = expert_axis
         self.top_k = top_k
+        self.routing = routing
 
     def init_params(self, rng):
         e, d, f = self.num_experts, self.dim, self.hidden
@@ -126,6 +136,36 @@ class MoE(Module):
         combine = d1 * w1[:, None, None] + d2 * w2[:, None, None]
         return dispatch, combine, aux, cap
 
+    def _route_expert_choice(self, x2, router):
+        """Expert-choice routing (Zhou et al. 2022) — the dropless
+        answer to Switch's capacity dropping: instead of tokens picking
+        experts (and overflowing their buffers), each EXPERT picks its
+        top-C tokens by affinity. Every expert buffer is exactly full —
+        perfect load balance BY CONSTRUCTION, so there is no capacity
+        overflow, no dropped-token path, and no load-balancing
+        auxiliary loss (aux ≡ 0).
+
+        Static shapes throughout: `lax.top_k` over the token axis per
+        expert, dense one-hot dispatch — the same (T, E, C) dispatch /
+        combine tensors the top-k router emits, so the expert-parallel
+        all_to_all plumbing is shared unchanged.
+
+        Caveat (documented, inherent to the method): expert selections
+        depend on ALL tokens in the batch/sequence, so it is not
+        causally masked — use for encoder-style models, or accept the
+        train-time approximation for decoder LMs.
+        """
+        t = x2.shape[0]
+        e = self.num_experts
+        cap = max(1, min(t, int(self.capacity_factor * t / e)))
+        scores = jax.nn.softmax(x2 @ router, axis=-1)         # (T, E)
+        g, idx = lax.top_k(scores.T, cap)                     # (E, C)
+        # dispatch[t, e, c] = 1 iff expert e picked token t for slot c
+        dispatch = jax.nn.one_hot(idx, t, dtype=jnp.float32,
+                                  axis=-1).transpose(2, 0, 1)  # (T,E,C)
+        combine = dispatch * g[None, :, :]    # affinity as gate weight
+        return dispatch, combine, cap
+
     def _experts(self, p, xin):
         """xin: (E_local, C_tot, D) → same shape through each expert."""
         h = jnp.einsum("ecd,edf->ecf", xin, p["w1"]) + p["b1"][:, None, :]
@@ -136,7 +176,12 @@ class MoE(Module):
         p = variables["params"]
         shape = x.shape
         x2 = x.reshape(-1, self.dim)
-        dispatch, combine, aux, cap = self._route(x2, p["router"])
+        if self.routing == "expert_choice":
+            dispatch, combine, cap = self._route_expert_choice(
+                x2, p["router"])
+            aux = jnp.zeros((), jnp.float32)  # balanced by construction
+        else:
+            dispatch, combine, aux, cap = self._route(x2, p["router"])
 
         if self.expert_axis is None:
             xin = jnp.einsum("tec,td->ecd", dispatch, x2)

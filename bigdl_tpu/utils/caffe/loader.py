@@ -318,7 +318,10 @@ class CaffeLoader:
         ph = int(cp.pad_h or (cp.pad[0] if cp.pad else 0))
         pw = int(cp.pad_w or (cp.pad[-1] if cp.pad else 0))
         group = int(cp.group) if cp.group else 1
-        dil = int(cp.dilation[0]) if cp.dilation else 1
+        # dilation is a repeated field with the same per-axis [0]/[-1]
+        # convention as kernel_size/stride/pad (h first, then w)
+        dil_h = int(cp.dilation[0]) if cp.dilation else 1
+        dil_w = int(cp.dilation[-1]) if cp.dilation else 1
         n_out = int(cp.num_output)
         if not blobs:
             if in_shape is None or len(in_shape) != 4:
@@ -327,13 +330,15 @@ class CaffeLoader:
                     "shape (declare input_shape in the prototxt)")
             m = nn.SpatialFullConvolution(
                 int(in_shape[-1]), n_out, kw, kh, sw, sh, pw, ph,
-                with_bias=cp.bias_term, n_group=group, dilation_w=dil)
+                with_bias=cp.bias_term, n_group=group,
+                dilation_w=dil_w, dilation_h=dil_h)
             return m, None
         w = _blob_array(blobs[0])  # (I, O/g, kH, kW)
         n_in = int(w.shape[0])
         m = nn.SpatialFullConvolution(
             n_in, n_out, kw, kh, sw, sh, pw, ph,
-            with_bias=cp.bias_term, n_group=group, dilation_w=dil)
+            with_bias=cp.bias_term, n_group=group,
+            dilation_w=dil_w, dilation_h=dil_h)
         if group == 1:
             wn = w.transpose(2, 3, 1, 0)          # IOHW → HWOI
         else:
@@ -701,10 +706,9 @@ class CaffePersister:
             if mod.n_group > 1:
                 cp.group = mod.n_group
             if mod.dilation_h != mod.dilation_w:
-                raise ValueError(
-                    "Caffe Deconvolution has one dilation field; "
-                    f"{mod.name!r} has {mod.dilation_h}x{mod.dilation_w}")
-            if mod.dilation_w > 1:
+                # repeated field, h first then w (loader convention)
+                cp.dilation.extend([mod.dilation_h, mod.dilation_w])
+            elif mod.dilation_w > 1:
                 cp.dilation.append(mod.dilation_w)
             wm = np.asarray(p["weight"])               # (kH,kW,O_tot,I/g)
             g = mod.n_group
