@@ -434,7 +434,7 @@ def bench_lm_diskpipe(iters, on_tpu):
         # input cost the serial loop pays per step (host read + H2D),
         # derived self-consistently from the three measured loops
         input_s = max(dt_serial - dt_compute, 1e-9)
-        hide_frac = max(0.0, dt_serial - dt) / min(input_s, dt_serial)
+        hide_frac = max(0.0, dt_serial - dt) / min(input_s, dt_compute)
         tag = "43m" if on_tpu else "tiny"
         print(json.dumps({
             "metric": f"transformer_lm_{tag}_train_diskpipe_tokens_per_sec"

@@ -39,6 +39,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 
 _NEG_INF = -1e30
+_LOG2E = 1.4426950408889634  # MUST match between _bwd_recompute (s2) and _bwd_prep (lse2)
 
 
 # --------------------------------------------------------------------------
@@ -265,12 +266,23 @@ def _bwd_recompute(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     p/ds in f32 — callers cast p/ds to the operand dtype at their dots.
     A pre-dot f32 cast would force multi-pass f32 MXU mode (~3-6x
     slower on v5e) — measured as the dominant term of the round-4
-    backward (PROFILE_r05)."""
+    backward (PROFILE_r05).
+
+    VPU-chain economies (the backward's bound is the elementwise chain
+    over the s/p/ds tiles, not the MXU — PROFILE_r05 per-cell
+    arithmetic): (1) p is computed in base 2 — _bwd_prep pre-multiplies
+    lse by log2(e) and the s tile is scaled once by sm_scale·log2(e),
+    so `exp2` needs no hidden ×log2(e) tile op; (2) `do` is pre-scaled
+    by sm_scale at tile load (a (bq,D) op) and delta arrives pre-scaled
+    from _bwd_prep, so ds = p·(dp′−delta′) drops its ×sm_scale tile op.
+    Consequence for callers: the returned `do` is SCALED — dv
+    accumulators must be divided by sm_scale once at finalize."""
     q = q_ref[0]                                             # (bq, D)
     k = k_ref[0]                                             # (bk, D)
-    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                        preferred_element_type=jnp.float32) * sm_scale
-    lse = lse_ref[0, 0, pl.dslice(q_start, block_q)][:, None]
+    s2 = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32) \
+        * (sm_scale * _LOG2E)
+    lse2 = lse_ref[0, 0, pl.dslice(q_start, block_q)][:, None]
     delta = delta_ref[0, 0, pl.dslice(q_start, block_q)][:, None]
     if masked:
         row = q_start + lax.broadcasted_iota(jnp.int32,
@@ -282,14 +294,19 @@ def _bwd_recompute(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = (col < seq_k) & (row < seq_q)
         if causal:
             mask = mask & (col <= row + (seq_k - seq_q))
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)           # (bq, bk)
+        p = jnp.where(mask, jnp.exp2(s2 - lse2), 0.0)        # (bq, bk)
     else:
-        p = jnp.exp(s - lse)
-    do = do_ref[0]                                           # (bq, D)
+        p = jnp.exp2(s2 - lse2)
+    if sm_scale == 0.0:  # degenerate static case: ds is exactly zero
+        do = do_ref[0]
+        ds = jnp.zeros_like(p)
+        return q, k, do, p, ds
+    do = (do_ref[0].astype(jnp.float32)
+          * sm_scale).astype(do_ref.dtype)                   # (bq, D)
     dp = lax.dot_general(do, v_ref[0],
                          (((1,), (1,)), ((), ())),
                          preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * sm_scale
+    ds = p * (dp - delta)
     return q, k, do, p, ds
 
 
@@ -363,14 +380,19 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(qi == num_q - 1)
     def _finalize():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
-        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+        # do arrived pre-scaled by sm_scale (see _bwd_recompute)
+        inv = 1.0 / sm_scale if sm_scale != 0.0 else 1.0
+        dv_ref[0] = (dv_scr[:] * inv).astype(dv_ref.dtype)
 
 
-def _bwd_prep(q, k, v, o, lse, do, block_q, block_k):
+def _bwd_prep(q, k, v, o, lse, do, block_q, block_k, sm_scale):
     """Shared backward setup (fused AND split wrappers): pad operands to
     block/lane multiples, precompute delta = sum(do*o), reshape lse and
     delta to the (BH, 1, sq) layout Mosaic accepts, and build the
-    (bh, kv, q)-grid input BlockSpecs."""
+    (bh, kv, q)-grid input BlockSpecs.
+
+    lse ships PRE-MULTIPLIED by log2(e) and delta PRE-MULTIPLIED by
+    sm_scale — the per-tile VPU economies _bwd_recompute documents."""
     qp = _pad_to(_pad_to(q, 1, block_q), 2, 128)
     dop = _pad_to(_pad_to(do, 1, block_q), 2, 128)
     kp = _pad_to(_pad_to(k, 1, block_k), 2, 128)
@@ -379,10 +401,11 @@ def _bwd_prep(q, k, v, o, lse, do, block_q, block_k):
     sk = kp.shape[1]
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)                                 # (BH, Sq)
+                    axis=-1) * sm_scale                      # (BH, Sq)
     # (BH, 1, sq): Mosaic wants the last two block dims (8,128)-tileable
     # OR equal to the array dims — (1, sq) matches exactly
-    lse_p = _pad_to(lse.astype(jnp.float32), 1, block_q)[:, None, :]
+    lse_p = _pad_to(lse.astype(jnp.float32) * _LOG2E,
+                    1, block_q)[:, None, :]
     delta_p = _pad_to(delta, 1, block_q)[:, None, :]
 
     col_specs = [
@@ -472,7 +495,9 @@ def _fa_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(qi == num_q - 1)
     def _finalize_dkv():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
-        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+        # do arrived pre-scaled by sm_scale (see _bwd_recompute)
+        inv = 1.0 / sm_scale if sm_scale != 0.0 else 1.0
+        dv_ref[0] = (dv_scr[:] * inv).astype(dv_ref.dtype)
 
     # dq row-block i has received every contribution once the kv sweep
     # is past its diagonal; emitting during the LAST kv sweep is always
@@ -494,7 +519,8 @@ def _flash_bwd_pallas_fused(q, k, v, o, lse, do, causal, sm_scale,
     bh, seq_q, dim = q.shape
     seq_k = k.shape[1]
     (qp, kp, vp, dop, lse_p, delta_p, sq, sk, dp_,
-     col_specs) = _bwd_prep(q, k, v, o, lse, do, block_q, block_k)
+     col_specs) = _bwd_prep(q, k, v, o, lse, do, block_q, block_k,
+                            sm_scale)
     num_q, num_kv = sq // block_q, sk // block_k
 
     dq_p, dk_p, dv_p = pl.pallas_call(
@@ -614,7 +640,8 @@ def _flash_bwd_pallas_split(q, k, v, o, lse, do, causal, sm_scale,
     bh, seq_q, dim = q.shape
     seq_k = k.shape[1]
     (qp, kp, vp, dop, lse_p, delta_p, sq, sk, dp_,
-     col_specs) = _bwd_prep(q, k, v, o, lse, do, block_q, block_k)
+     col_specs) = _bwd_prep(q, k, v, o, lse, do, block_q, block_k,
+                            sm_scale)
     num_q, num_kv = sq // block_q, sk // block_k
 
     # dq kernel iterates (bh, q, kv): same specs, swapped grid axes

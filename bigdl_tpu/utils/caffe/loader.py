@@ -271,7 +271,9 @@ class CaffeLoader:
         sw = int(cp.stride_w or (cp.stride[-1] if cp.stride else 1))
         ph = int(cp.pad_h or (cp.pad[0] if cp.pad else 0))
         pw = int(cp.pad_w or (cp.pad[-1] if cp.pad else 0))
-        dil = int(cp.dilation[0]) if cp.dilation else 1
+        dil_h = int(cp.dilation[0]) if cp.dilation else 1
+        dil_w = int(cp.dilation[-1]) if cp.dilation else 1
+        dil = max(dil_h, dil_w)
         n_out = int(cp.num_output)
         group = int(cp.group)
         if not blobs:
@@ -284,7 +286,8 @@ class CaffeLoader:
             if dil > 1:
                 m = nn.SpatialDilatedConvolution(
                     n_in, n_out, kw, kh, sw, sh, pw, ph,
-                    dilation_w=dil, dilation_h=dil, with_bias=cp.bias_term)
+                    dilation_w=dil_w, dilation_h=dil_h,
+                    with_bias=cp.bias_term)
             else:
                 m = nn.SpatialConvolution(
                     n_in, n_out, kw, kh, sw, sh, pw, ph, n_group=group,
@@ -295,7 +298,8 @@ class CaffeLoader:
         if dil > 1:
             m = nn.SpatialDilatedConvolution(
                 n_in, n_out, kw, kh, sw, sh, pw, ph,
-                dilation_w=dil, dilation_h=dil, with_bias=cp.bias_term)
+                dilation_w=dil_w, dilation_h=dil_h,
+                with_bias=cp.bias_term)
         else:
             m = nn.SpatialConvolution(
                 n_in, n_out, kw, kh, sw, sh, pw, ph, n_group=group,
@@ -732,7 +736,11 @@ class CaffePersister:
             cp.group = mod.n_group
             cp.bias_term = mod.with_bias
             if isinstance(mod, nn.SpatialDilatedConvolution):
-                cp.dilation.append(mod.dilation_h)
+                if mod.dilation_h != mod.dilation_w:
+                    # repeated field, h first then w (loader convention)
+                    cp.dilation.extend([mod.dilation_h, mod.dilation_w])
+                else:
+                    cp.dilation.append(mod.dilation_h)
             w = np.asarray(p["weight"]).transpose(3, 2, 0, 1)  # HWIO→OIHW
             _fill_blob(l.blobs.add(), w)
             if mod.with_bias:
