@@ -225,6 +225,11 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k,
     out_p, lse_p = pl.pallas_call(
         kernel,
         grid=(bh, num_q, num_kv),
+        # bh and q rows are independent; only the kv sweep carries the
+        # online-softmax scratch. Marking them parallel lets Mosaic
+        # overlap/reorder grid cells (the library kernel's convention).
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         in_specs=[
             pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, dp), lambda b, i, j: (b, j, 0)),
@@ -531,9 +536,11 @@ def _flash_bwd_pallas_fused(q, k, v, o, lse, do, causal, sm_scale,
         grid=(bh, num_kv, num_q),
         # the full-sequence dq residents exceed Mosaic's default 16 MiB
         # scoped-vmem budget at long context (18.1 MiB at S=16384 with
-        # native-dtype dots); v5e has 128 MiB — raise the kernel's cap
+        # native-dtype dots); v5e has 128 MiB — raise the kernel's cap.
+        # Only bh is parallel: the dq plane persists across kv AND q.
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=64 * 1024 * 1024),
+            vmem_limit_bytes=64 * 1024 * 1024,
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         in_specs=col_specs,
         out_specs=[
             # whole dq row plane per bh: index map constant in (j, i),
@@ -659,6 +666,8 @@ def _flash_bwd_pallas_split(q, k, v, o, lse, do, causal, sm_scale,
             block_q=block_q, block_k=block_k, seq_q=seq_q, seq_k=seq_k,
             num_kv=num_kv),
         grid=(bh, num_q, num_kv),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         in_specs=row_specs,
         out_specs=pl.BlockSpec((1, block_q, dp_), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, dp_), q.dtype),
@@ -672,6 +681,8 @@ def _flash_bwd_pallas_split(q, k, v, o, lse, do, causal, sm_scale,
             block_q=block_q, block_k=block_k, seq_q=seq_q, seq_k=seq_k,
             num_q=num_q),
         grid=(bh, num_kv, num_q),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         in_specs=col_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, dp_), lambda b, j, i: (b, j, 0)),

@@ -287,7 +287,7 @@ class CaffeLoader:
                 m = nn.SpatialDilatedConvolution(
                     n_in, n_out, kw, kh, sw, sh, pw, ph,
                     dilation_w=dil_w, dilation_h=dil_h,
-                    with_bias=cp.bias_term)
+                    n_group=group, with_bias=cp.bias_term)
             else:
                 m = nn.SpatialConvolution(
                     n_in, n_out, kw, kh, sw, sh, pw, ph, n_group=group,
@@ -299,7 +299,7 @@ class CaffeLoader:
             m = nn.SpatialDilatedConvolution(
                 n_in, n_out, kw, kh, sw, sh, pw, ph,
                 dilation_w=dil_w, dilation_h=dil_h,
-                with_bias=cp.bias_term)
+                n_group=group, with_bias=cp.bias_term)
         else:
             m = nn.SpatialConvolution(
                 n_in, n_out, kw, kh, sw, sh, pw, ph, n_group=group,
