@@ -280,8 +280,11 @@ def bench_resnet_diskpipe(batch, iters, on_tpu, synthetic_step_s=None):
         input_s = host_s + h2d_s
         hideable = (min(input_s, synthetic_step_s)
                     if synthetic_step_s else None)
-        hide_frac = (round(max(0.0, dt_serial - dt) / hideable, 3)
-                     if hideable else None)
+        # clamp: at tunnel H2D rates the hideable window (~the 0.1 s
+        # step) is far below serial-vs-overlap run jitter, so the raw
+        # ratio is noise above 1; ≥1.0 reads "fully hidden or jitter"
+        hide_frac = (round(min(max(0.0, dt_serial - dt) / hideable, 1.0),
+                           3) if hideable else None)
         print(json.dumps({
             "metric": f"resnet50_bf16_train_diskpipe_images_per_sec_per_chip"
                       f"[{platform}]",
