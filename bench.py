@@ -705,15 +705,9 @@ def main(argv=None) -> None:
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         # CPU-only runs must drop the axon remote-TPU factory before
         # first backend use (tests/conftest.py documents why)
-        import jax
+        from bigdl_tpu.utils.engine import ensure_cpu_platform
 
-        jax.config.update("jax_platforms", "cpu")
-        try:
-            from jax._src import xla_bridge
-
-            xla_bridge._backend_factories.pop("axon", None)
-        except Exception:
-            pass
+        ensure_cpu_platform()
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--only", default=None,

@@ -20,6 +20,26 @@ import jax
 import numpy as np
 
 
+def ensure_cpu_platform():
+    """Honor `JAX_PLATFORMS=cpu` on images whose PJRT plugin (e.g. the
+    axon remote-TPU tunnel) would otherwise win backend selection.
+
+    Call BEFORE first backend use when simulating a mesh with
+    `--xla_force_host_platform_device_count=N`. No-op unless the env
+    var requests cpu. (tests/conftest.py and the scripts/ harnesses
+    inline the same dance; this is the public entry for examples and
+    user code.)"""
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        return
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge
+
+        xla_bridge._backend_factories.pop("axon", None)
+    except Exception:  # pragma: no cover - jax internals moved
+        pass
+
+
 class Engine:
     """Process-wide runtime info. All methods are class-level, mirroring the
     reference's singleton `Engine` object."""

@@ -9,6 +9,10 @@ sharded update / all_gather). Run with real chips, or simulate:
 import os, sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from bigdl_tpu.utils.engine import ensure_cpu_platform
+
+ensure_cpu_platform()  # honor JAX_PLATFORMS=cpu despite the PJRT plugin
+
 import numpy as np
 
 from bigdl_tpu import nn

@@ -42,6 +42,9 @@ def child(args):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # intentional inline copy of utils/engine.ensure_cpu_platform:
+    # this runs before bigdl_tpu is importable (or with conditional
+    # platform logic)
     from jax._src import xla_bridge
 
     xla_bridge._backend_factories.pop("axon", None)

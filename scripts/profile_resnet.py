@@ -34,15 +34,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 if os.environ.get("JAX_PLATFORMS", "") == "cpu":
     # CPU-only runs must also drop the axon remote-TPU factory before
     # first backend use (tests/conftest.py documents why)
-    import jax
+    from bigdl_tpu.utils.engine import ensure_cpu_platform
 
-    jax.config.update("jax_platforms", "cpu")
-    try:
-        from jax._src import xla_bridge
-
-        xla_bridge._backend_factories.pop("axon", None)
-    except Exception:
-        pass
+    ensure_cpu_platform()
 
 PEAK_BF16 = 197e12  # TPU v5e peak bf16 FLOP/s
 

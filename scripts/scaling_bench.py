@@ -37,15 +37,9 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-    import jax
+    from bigdl_tpu.utils.engine import ensure_cpu_platform
 
-    jax.config.update("jax_platforms", "cpu")
-    try:
-        from jax._src import xla_bridge
-
-        xla_bridge._backend_factories.pop("axon", None)
-    except Exception:
-        pass
+    ensure_cpu_platform()
 
 # TPU v5e ICI: ~400 GB/s aggregate off-chip bandwidth per chip
 # (2 links/axis bidirectional). Override per topology with --ici-gbps.
