@@ -751,7 +751,8 @@ def main(argv=None) -> None:
                      12 if on_tpu else 2, on_tpu)
     # NOT in the default set: the lenet TRAIN-step compile reproducibly
     # hangs the remote-TPU compile service (fwd compiles fine; grad+SGD
-    # does not return within 15 min) — run explicitly via --only lenet.
+    # does not return within 15 min; re-confirmed round 5) — run
+    # explicitly via --only lenet.
     # The 5 BASELINE.md configs are the rows above/below.
     if want is not None and "lenet" in want:
         bench_vision("lenet", lambda: lenet.build(10), (28, 28, 1),
