@@ -228,8 +228,11 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k,
         # bh and q rows are independent; only the kv sweep carries the
         # online-softmax scratch. Marking them parallel lets Mosaic
         # overlap/reorder grid cells (the library kernel's convention).
+        # vmem cap raised like the fused backward's so 2048-row tiles
+        # compile (default 16 MiB rejects them).
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=64 * 1024 * 1024),
         in_specs=[
             pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, dp), lambda b, i, j: (b, j, 0)),
@@ -572,14 +575,12 @@ def _flash_bwd_pallas_fused(q, k, v, o, lse, do, causal, sm_scale,
 _FUSED_BWD_MAX_RESIDENT_BYTES = 13 * 1024 * 1024
 
 
-def _env_bwd_tiles():
-    """Optional `BIGDL_FLASH_BWD_TILES=BQxBK` override for the fused
-    backward's tiles — the perf-tuning knob the tile sweeps drive
-    (PROFILE_r05/bwd_tile_sweep: the optimum is shape-dependent —
-    1024x1024 at BH=128, kv-wide 1024x2048 at BH=64)."""
+def _env_tiles(var):
+    """Parse a `BQxBK` tile override from the named env var (the
+    perf-tuning knobs the tile sweeps drive; see PROFILE_r05)."""
     import os
 
-    v = os.environ.get("BIGDL_FLASH_BWD_TILES")
+    v = os.environ.get(var)
     if not v:
         return None
     try:
@@ -587,8 +588,12 @@ def _env_bwd_tiles():
         return int(bq), int(bk)
     except ValueError:
         raise ValueError(
-            f"BIGDL_FLASH_BWD_TILES={v!r}: expected 'BQxBK', e.g. "
-            "'512x1024'") from None
+            f"{var}={v!r}: expected 'BQxBK', e.g. '512x1024'") from None
+
+
+def _env_bwd_tiles():
+    """`BIGDL_FLASH_BWD_TILES` — fused-backward tile override."""
+    return _env_tiles("BIGDL_FLASH_BWD_TILES")
 
 
 _FUSED_BWD_MAX_TILE = 1024 * 512  # bq*bk cap for the fused backward's
@@ -866,15 +871,35 @@ def _resolve_impl_and_blocks(q, k, block_q, block_k, impl):
     impl (Mosaic kernels on TPU, reference elsewhere), then per-impl
     default tiles, clamped to the sequences.
 
-    Mosaic default tiles are 1024x1024 (round-4 sweep,
-    PROFILE_r04/attn_block_sweep.log: fwd 5.84 ms vs 6.23 at 512x512 at
-    the 186M shape, fwd+bwd 15.6 — the grid-cell count, not the MXU, is
-    the binding constraint, so fewer/bigger cells win; 2048-row tiles
-    regress and 2048x1024 fails to compile). The XLA scan keeps 128."""
+    Mosaic default tiles are 1024x1024 (round-4 sweep: the grid-cell
+    count, not the MXU, binds, so fewer/bigger cells win), EXCEPT the
+    single-tile-per-bh regime bh<=64 AND S<=2048 where one whole-
+    sequence 2048x2048 tile per batch-head wins (+3.6% in-model at the
+    43M shape — PROFILE_r05/fwd2048_43m_inmodel_ab.log; at BH>=128
+    2048-row tiles regress, r4+r5 sweeps). `BIGDL_FLASH_FWD_TILES=BQxBK`
+    overrides when no explicit blocks are passed. The XLA scan keeps
+    128."""
     impl = impl or _default_impl()
     big = impl in ("pallas", "interpret")
-    block_q = _clamp_block(block_q or (1024 if big else 128), q.shape[-2])
-    block_k = _clamp_block(block_k or (1024 if big else 128), k.shape[-2])
+    env = _env_tiles("BIGDL_FLASH_FWD_TILES") if big else None
+    if env is not None and (block_q is None and block_k is None):
+        block_q, block_k = env
+    default = 1024
+    if big and block_q is None and block_k is None:
+        # single-tile-per-bh regime: at few batch*heads the grid has too
+        # few cells to amortize per-cell overhead — one whole-sequence
+        # tile per bh wins (43M in-model: 202.0k -> 209.4k tok/s,
+        # +3.6%, PROFILE_r05). At BH>=128 2048-tiles regress (r4+r5
+        # sweeps), and at long context the 1024 default stays.
+        import math as _math
+
+        bh = int(_math.prod(q.shape[:-2])) if q.ndim >= 3 else 1
+        if bh <= 64 and q.shape[-2] <= 2048 and k.shape[-2] <= 2048:
+            default = 2048
+    block_q = _clamp_block(block_q or (default if big else 128),
+                           q.shape[-2])
+    block_k = _clamp_block(block_k or (default if big else 128),
+                           k.shape[-2])
     return impl, block_q, block_k
 
 
@@ -915,11 +940,12 @@ def flash_attention(
     | 'interpret' (Pallas interpreter mode, for CPU tests) |
     'reference'.
 
-    Block sizes default per impl from measurement (round 4): the
-    Mosaic kernels want LARGE tiles (1024x1024 — the grid-cell count,
-    not the MXU, binds; PROFILE_r04/attn_block_sweep.log), the XLA scan
-    wants SMALL kv blocks (128 — its per-block elementwise chain stays
-    cache-resident).
+    Block sizes default per impl from measurement: the Mosaic kernels
+    want LARGE tiles — 1024x1024, or one whole-sequence 2048x2048 tile
+    per batch-head when bh<=64 and S<=2048 (see
+    _resolve_impl_and_blocks) — while the XLA scan wants SMALL kv
+    blocks (128 — its per-block elementwise chain stays
+    cache-resident). `BIGDL_FLASH_FWD_TILES` overrides the fwd default.
     `bwd_block_k` applies only to the impl='xla' scan backward.
     `bwd_tiles=(bq, bk)` overrides the FUSED Mosaic backward's tiles
     (default: the fwd blocks, q-tile halved first until bq·bk fits the
