@@ -124,6 +124,21 @@ class TransformerConfig:
             raise ValueError(
                 f"moe_routing {self.moe_routing!r}: expected 'top_k' "
                 "or 'expert_choice'")
+        if (self.moe_experts and self.moe_routing == "expert_choice"
+                and self.causal):
+            # expert-choice routing selects tokens per expert over the
+            # WHOLE sequence, so at train time an expert's choice for
+            # position t depends on tokens after t — future-token
+            # leakage under a causal LM objective (parallel/moe.py).
+            # Surfaced here too, where the model is configured.
+            import logging
+
+            logging.getLogger("bigdl_tpu.models").warning(
+                "moe_routing='expert_choice' with causal=True: "
+                "expert-choice token selection reads the full sequence, "
+                "leaking future tokens into the routing decision at "
+                "train time; causal-LM eval/teacher-forcing metrics may "
+                "be optimistic (see parallel/moe.py)")
 
 
 class TransformerLM(Module):
@@ -346,7 +361,8 @@ class TransformerLM(Module):
                 raise ValueError(
                     f"zigzag sp_mode needs an even local sequence "
                     f"length, got {s}")
-            n = lax.axis_size(self.sp_axis)
+            from bigdl_tpu.parallel.shard_map_compat import axis_size
+            n = axis_size(self.sp_axis)
             my = lax.axis_index(self.sp_axis)
             # positions(i) for traced i: both half starts are affine
             # in the device index, so index the stacked table

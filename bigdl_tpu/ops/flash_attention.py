@@ -26,17 +26,28 @@ Design
 Numerics: masked logits use a large finite negative (-1e30), not -inf,
 so fully-masked rows produce zeros (not NaN) after normalization — the
 convention the ring combine relies on.
+
+Env tile overrides (`BIGDL_FLASH_FWD_TILES` / `BIGDL_FLASH_BWD_TILES`)
+are read at TRACE time: the value in the environment when a given
+(shape, dtype, flags) combination first compiles is baked into that
+executable, and changing the env afterwards is a silent no-op for
+shapes already in jit's cache. Sweeps must set the env before the first
+call — or run each config in a fresh process, as the sweep scripts do
+(scripts/sweep_attn_blocks.py, scripts/sweep_attn_bwd_tiles.py).
 """
 
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+
+logger = logging.getLogger("bigdl_tpu.ops")
 
 _NEG_INF = -1e30
 _LOG2E = 1.4426950408889634  # MUST match between _bwd_recompute (s2) and _bwd_prep (lse2)
@@ -45,6 +56,14 @@ _LOG2E = 1.4426950408889634  # MUST match between _bwd_recompute (s2) and _bwd_p
 # --------------------------------------------------------------------------
 # jnp oracle / CPU fallback
 # --------------------------------------------------------------------------
+
+def _tpu_compiler_params(pltpu, **kw):
+    """pltpu.CompilerParams was TPUCompilerParams before jax 0.5 —
+    same fields, renamed class."""
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
+    return cls(**kw)
+
 
 def attention_reference(
     q: jax.Array,
@@ -230,7 +249,7 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q, block_k,
         # overlap/reorder grid cells (the library kernel's convention).
         # vmem cap raised like the fused backward's so 2048-row tiles
         # compile (default 16 MiB rejects them).
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(pltpu,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=64 * 1024 * 1024),
         in_specs=[
@@ -541,7 +560,7 @@ def _flash_bwd_pallas_fused(q, k, v, o, lse, do, causal, sm_scale,
         # scoped-vmem budget at long context (18.1 MiB at S=16384 with
         # native-dtype dots); v5e has 128 MiB — raise the kernel's cap.
         # Only bh is parallel: the dq plane persists across kv AND q.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(pltpu,
             vmem_limit_bytes=64 * 1024 * 1024,
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         in_specs=col_specs,
@@ -638,6 +657,17 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q,
                     fb_k //= 2
         return _flash_bwd_pallas_fused(q, k, v, o, lse, do, causal,
                                        sm_scale, fb_q, fb_k, interpret)
+    override = bwd_tiles if bwd_tiles is not None else _env_bwd_tiles()
+    if override is not None:
+        # the override names FUSED-backward tiles; routing to the split
+        # kernels here would silently measure the wrong kernel in a
+        # sweep (ADVICE r05) — warn at trace time, once per compile
+        logger.warning(
+            "flash backward: bwd_tiles override %dx%d ignored — "
+            "full-sequence residents (%d bytes > %d cap) route this "
+            "shape to the SPLIT backward, which tiles at the forward "
+            "blocks %dx%d", override[0], override[1], resident,
+            _FUSED_BWD_MAX_RESIDENT_BYTES, block_q, block_k)
     return _flash_bwd_pallas_split(q, k, v, o, lse, do, causal, sm_scale,
                                    block_q, block_k, interpret)
 
@@ -671,7 +701,7 @@ def _flash_bwd_pallas_split(q, k, v, o, lse, do, causal, sm_scale,
             block_q=block_q, block_k=block_k, seq_q=seq_q, seq_k=seq_k,
             num_kv=num_kv),
         grid=(bh, num_q, num_kv),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(pltpu,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         in_specs=row_specs,
         out_specs=pl.BlockSpec((1, block_q, dp_), lambda b, i, j: (b, i, 0)),
@@ -686,7 +716,7 @@ def _flash_bwd_pallas_split(q, k, v, o, lse, do, causal, sm_scale,
             block_q=block_q, block_k=block_k, seq_q=seq_q, seq_k=seq_k,
             num_q=num_q),
         grid=(bh, num_kv, num_q),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(pltpu,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         in_specs=col_specs,
         out_specs=[

@@ -51,7 +51,13 @@ def _batch_iterator(dataset: AbstractDataSet, train: bool,
     re-aligns the stream and makes resumed training bit-for-bit equal to
     the uninterrupted run. Samples are skipped without stacking (train
     streams are infinite, every batch is full), so the cost is bare
-    iteration."""
+    iteration.
+
+    Training streams pass through the fault-injection point
+    `data@<position>` (utils/faults): a data-loader failure fires when
+    the batch at that global stream position (skip + local index — the
+    step number that will consume it) is fetched, so injected loader
+    faults are deterministic across resumes."""
     it = dataset.data(train=train)
     first = next(it, None)
     if first is None:
@@ -62,12 +68,28 @@ def _batch_iterator(dataset: AbstractDataSet, train: bool,
     if isinstance(first, MiniBatch):
         for _ in range(skip):
             next(chained, None)
-        return chained
+        return _fault_gate(chained, skip) if train else chained
     if batch_size is None:
         raise ValueError("dataset yields Samples; batch_size is required")
     for _ in range(skip * batch_size):
         next(chained, None)
-    return SampleToMiniBatch(batch_size)(chained)
+    batched = SampleToMiniBatch(batch_size)(chained)
+    return _fault_gate(batched, skip) if train else batched
+
+
+def _fault_gate(it, start: int):
+    """Wrap a training batch stream with the `data` fault point; the
+    skip fast-forward is NOT gated (replays must not re-fire)."""
+    from bigdl_tpu.utils import faults
+
+    def gen():
+        pos = start
+        for mb in it:
+            faults.get_plan().maybe_raise("data", pos)
+            pos += 1
+            yield mb
+
+    return gen()
 
 
 def _to_device(x):
@@ -107,6 +129,7 @@ class Optimizer:
         self.mesh_axis = "data"
         self.precision = None  # None → full fp32; Policy → mixed precision
         self.grad_accum = 1
+        self.anomaly_guard = None  # utils.anomaly.AnomalyGuard or None
 
     # ------------------------------------------------------- builder surface
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
@@ -185,6 +208,29 @@ class Optimizer:
         self.precision = policy
         return self
 
+    def set_anomaly_guard(self, guard="skip_step", **kwargs) -> "Optimizer":
+        """Arm the numeric-anomaly guard (utils/anomaly.py): every train
+        step checks loss + global grad-norm finiteness (and, with
+        `spike_factor`, a norm-spike threshold) inside the jitted step
+        and discards anomalous updates on device. `guard` is an
+        AnomalyGuard, a policy string ('skip_step' | 'rollback' |
+        'halt'; kwargs forward to AnomalyGuard), or None to disarm.
+        The reference has no such monitoring — a NaN loss silently
+        poisons the weights; TensorFlow's health-monitoring contract
+        (arXiv 1605.08695 §4.3) is the model here."""
+        from bigdl_tpu.utils.anomaly import AnomalyGuard
+
+        if isinstance(guard, str):
+            guard = AnomalyGuard(policy=guard, **kwargs)
+        elif guard is not None and not isinstance(guard, AnomalyGuard):
+            raise TypeError(
+                f"expected AnomalyGuard, policy str or None, got "
+                f"{type(guard).__name__}")
+        elif kwargs:
+            raise ValueError("kwargs only apply when guard is a policy str")
+        self.anomaly_guard = guard
+        return self
+
     def set_constant_gradient_clipping(self, min_v: float, max_v: float) -> "Optimizer":
         self.grad_clip_const = (min_v, max_v)
         return self
@@ -227,6 +273,7 @@ class LocalOptimizer:
         clip_const, clip_norm = self.o.grad_clip_const, self.o.grad_clip_norm
         precision = self.o.precision
         accum = self.o.grad_accum
+        guarded = self.o.anomaly_guard is not None
 
         from bigdl_tpu.ops.losses import build_train_loss
 
@@ -250,6 +297,28 @@ class LocalOptimizer:
             return method.update(grads, params, slots, lr, stepno)
 
         if accum == 1:
+            if guarded:
+                from bigdl_tpu.utils.anomaly import (
+                    global_norm, health_ok, select_update)
+
+                def gstep(params, mod_state, slots, bx, by, lr, stepno,
+                          rng, max_gnorm):
+                    (loss, new_state), grads = grads_of(params, mod_state,
+                                                        bx, by, rng)
+                    gnorm = global_norm(grads)  # pre-clip, like the guard
+                    ok = health_ok(loss, gnorm, max_gnorm)
+                    new_params, new_slots = clip_and_update(
+                        grads, params, slots, lr, stepno)
+                    # anomalous step: every output is the bit-identical
+                    # input — params, slots AND module state keep their
+                    # pre-step values on device
+                    return (select_update(ok, new_params, params),
+                            select_update(ok, new_state, mod_state),
+                            select_update(ok, new_slots, slots),
+                            loss, ok, gnorm)
+
+                return jax.jit(gstep, donate_argnums=(0, 2))
+
             def step(params, mod_state, slots, bx, by, lr, stepno, rng):
                 (loss, new_state), grads = grads_of(params, mod_state, bx,
                                                     by, rng)
@@ -270,10 +339,26 @@ class LocalOptimizer:
                 params, slots, lr, stepno),
             donate_argnums=(0, 1, 2))
         micro = {"acc": None, "n": 0}
+        if guarded:
+            from bigdl_tpu.utils.anomaly import global_norm, health_ok
 
-        def step(params, mod_state, slots, bx, by, lr, stepno, rng):
+            def _health(loss, grads, thr):
+                g = global_norm(grads)
+                return health_ok(loss, g, thr), g
+
+            health_fn = jax.jit(_health)
+
+        def step(params, mod_state, slots, bx, by, lr, stepno, rng,
+                 max_gnorm=None):
             (loss, new_state), grads = grad_fn(params, mod_state, bx, by,
                                                rng)
+            if guarded:
+                ok, gnorm = health_fn(loss, grads, max_gnorm)
+                if not bool(ok):
+                    # anomalous micro-batch: its gradients never touch
+                    # the accumulator and the NaN-tainted module state
+                    # is dropped; the cycle extends by one batch
+                    return params, mod_state, slots, loss, ok, gnorm
             micro["acc"] = grads if micro["acc"] is None \
                 else add_fn(micro["acc"], grads)
             micro["n"] += 1
@@ -282,6 +367,8 @@ class LocalOptimizer:
                                        stepno,
                                        jnp.asarray(accum, jnp.float32))
                 micro["acc"], micro["n"] = None, 0
+            if guarded:
+                return params, new_state, slots, loss, ok, gnorm
             return params, new_state, slots, loss
 
         def flush(params, slots, lr, stepno):
@@ -312,6 +399,7 @@ class LocalOptimizer:
         step.flush = flush
         step.micro_state = lambda: (micro["acc"], micro["n"])
         step.restore_micro = restore_micro
+        step.clear_micro = lambda: micro.update(acc=None, n=0)
         return step
 
     def _make_eval(self) -> Callable:
@@ -344,20 +432,50 @@ class LocalOptimizer:
                 results[i] = results[i] + ValidationResult(float(s), float(c))
         return {m.name: r for m, r in zip(o.validation_methods, results)}
 
+    def _require_rollback_checkpoint(self) -> None:
+        """The anomaly guard's 'rollback' policy has nothing to roll
+        back to without a saved checkpoint — shared precondition of the
+        local and distributed run loops."""
+        from bigdl_tpu.utils.anomaly import AnomalyError
+
+        o = self.o
+        if o.checkpoint is None or not o.checkpoint.latest():
+            raise AnomalyError(
+                "anomaly policy 'rollback' needs a checkpoint "
+                "(set_checkpoint) with at least one save; none found")
+
     # ------------------------------------------------------------------ run
     def run(self) -> Module:
         o = self.o
         rng = jax.random.PRNGKey(o.seed)
         variables = dict(o.model.variables)  # uses existing build or default init
         slots = o.optim_method.init_slots(variables["params"])
+        # "nupdates" counts optimizer updates actually APPLIED — it is
+        # the stepno/schedule clock. Without the anomaly guard it always
+        # equals neval // grad_accum; with the guard, a discarded update
+        # (skip_step) or uncounted micro-batch does NOT advance it, so
+        # Adam bias correction and LR schedules never skip a step index
+        # over an anomaly.
         train_state: Dict[str, Any] = {"epoch": 1, "neval": 0,
-                                       "records": 0, "loss": None, "score": None}
+                                       "nupdates": 0, "records": 0,
+                                       "loss": None, "score": None}
+        guard = o.anomaly_guard
 
-        saved_accum = None
-        if o._resume and o.checkpoint is not None and o.checkpoint.latest():
+        from bigdl_tpu.utils import faults
+
+        plan = faults.get_plan()
+        batches = None  # built below; restore() rebuilds it on rollback
+
+        def restore_from_checkpoint(rebuild_stream=True):
+            """Reload model/optim/train_state from the newest VALID
+            checkpoint (Checkpoint.load falls back past corrupt dirs);
+            returns the saved mid-cycle accumulator (or None). Used at
+            startup resume and by the anomaly guard's rollback policy."""
+            nonlocal variables, slots, batches
             variables, slots, saved, optim_meta = o.checkpoint.load(
                 with_optim_meta=True)
             flat_layout = (optim_meta or {}).get("layout") == "zero1_flat"
+            spec = None
             if flat_layout:
                 # checkpoint written by DistriOptimizer: each slot is a flat
                 # (padded,) vector over the whole parameter set — unflatten
@@ -372,19 +490,44 @@ class LocalOptimizer:
                 saved_accum = {"g_acc": spec.unflatten(saved_accum["g_acc"]),
                                "micro_n": saved_accum["micro_n"]}
             train_state.update(saved)
-            logger.info("resumed from %s at %s", o.checkpoint.latest(), saved)
+            if "nupdates" not in saved:  # pre-counter checkpoint
+                train_state["nupdates"] = \
+                    train_state["neval"] // o.grad_accum
+            if rebuild_stream:
+                batches = _batch_iterator(o.dataset, True, o.batch_size,
+                                          skip=train_state["neval"])
+            return saved_accum
 
-        self._step = self._make_step()
-        if saved_accum is not None:
+        # host mirror of the step closure's micro-batch count — drives
+        # the nupdates increment at each completed accumulation cycle
+        micro_seen = [0]
+
+        def install_accum(saved_accum):
+            micro_seen[0] = 0
+            if saved_accum is None:
+                return
             if hasattr(self._step, "restore_micro"):
                 self._step.restore_micro(saved_accum["g_acc"],
                                          int(saved_accum["micro_n"]))
+                # mirror what restore_micro actually installed — it
+                # refuses (leaves 0) a cycle that doesn't fit this
+                # run's grad_accum
+                micro_seen[0] = int(self._step.micro_state()[1])
             else:
                 logger.warning(
                     "checkpoint holds a mid-cycle accumulator (%d "
                     "micro-batches) but this run has grad_accum=1; the "
                     "partial gradients are discarded",
                     int(saved_accum["micro_n"]))
+
+        saved_accum = None
+        if o._resume and o.checkpoint is not None and o.checkpoint.latest():
+            saved_accum = restore_from_checkpoint(rebuild_stream=False)
+            logger.info("resumed from %s at %s",
+                        o.checkpoint._last_loaded, train_state)
+
+        self._step = self._make_step()
+        install_accum(saved_accum)
         if o.validation_methods:
             self._eval_step = self._make_eval()
 
@@ -399,22 +542,48 @@ class LocalOptimizer:
         iter_start = time.perf_counter()
 
         while not o.end_when(train_state):
+            plan.maybe_raise("step", train_state["neval"])
             with Timer(self.metrics, "data_fetch_s"):
                 mb = next(batches)
+            if plan.fires("nan", train_state["neval"]):
+                mb = faults.poison_minibatch(mb)
             step_rng = jax.random.fold_in(rng, train_state["neval"])
-            # under gradient accumulation, schedules and the optimizer's
-            # step counter advance per UPDATE, not per micro-batch
-            eff_step = train_state["neval"] // o.grad_accum
-            lr_state = train_state if o.grad_accum == 1 \
+            # schedules and the optimizer's step counter advance per
+            # APPLIED update, not per (micro-)batch: a guard-discarded
+            # update re-uses its step index, so the schedule clock
+            # never skips over an anomaly
+            eff_step = train_state["nupdates"]
+            lr_state = train_state if o.grad_accum == 1 and guard is None \
                 else {**train_state, "neval": eff_step}
             lr = o.optim_method.current_rate(lr_state)
             with Timer(self.metrics, "dispatch_s"):
-                variables["params"], variables["state"], slots, loss = self._step(
+                step_args = (
                     variables["params"], variables["state"], slots,
                     _to_device(mb.input), _to_device(mb.target),
                     jnp.asarray(lr, jnp.float32),
                     jnp.asarray(eff_step, jnp.int32),
                     step_rng)
+                if guard is None:
+                    (variables["params"], variables["state"], slots,
+                     loss) = self._step(*step_args)
+                else:
+                    (variables["params"], variables["state"], slots, loss,
+                     ok_d, gnorm_d) = self._step(
+                        *step_args,
+                        jnp.asarray(guard.threshold(), jnp.float32))
+            if guard is not None:
+                # scalar fetch syncs the step — the documented cost of
+                # arming the guard (utils/anomaly.py); an anomalous
+                # update was already discarded on device either way
+                action = guard.observe(bool(ok_d), float(gnorm_d),
+                                       train_state["neval"])
+                if action == "rollback":
+                    self._require_rollback_checkpoint()
+                    saved_accum = restore_from_checkpoint()
+                    if hasattr(self._step, "clear_micro"):
+                        self._step.clear_micro()
+                    install_accum(saved_accum)
+                    continue
             # NOTE: `loss` stays a device array — converting here would
             # block the host on every step and kill async dispatch
             # pipelining. Log/summary emission for step N happens after
@@ -422,6 +591,17 @@ class LocalOptimizer:
             # overlaps the next step's device compute instead of stalling.
             real = getattr(mb, "real_size", mb.size)
             train_state["neval"] += 1
+            # advance the update clock only when an update was (or, for
+            # a mid-cycle micro-batch, will be) applied: anomalous
+            # steps/micro-batches were discarded on device
+            if o.grad_accum == 1:
+                train_state["nupdates"] += 1 if guard is None \
+                    else int(bool(ok_d))
+            elif guard is None or bool(ok_d):
+                micro_seen[0] += 1
+                if micro_seen[0] == o.grad_accum:
+                    train_state["nupdates"] += 1
+                    micro_seen[0] = 0
             train_state["records"] += real
             train_state["loss"] = loss
             now = time.perf_counter()
@@ -484,7 +664,8 @@ class LocalOptimizer:
                                        "micro_n": mn}
                 path = o.checkpoint.save(train_state["neval"], variables, slots,
                                          {k: train_state[k] for k in
-                                          ("epoch", "neval", "records")},
+                                          ("epoch", "neval", "nupdates",
+                                           "records")},
                                          accum_state=accum_state)
                 logger.info("checkpoint -> %s", path)
 
@@ -492,7 +673,7 @@ class LocalOptimizer:
         # accumulator so those micro-batches' gradients aren't discarded
         flush = getattr(self._step, "flush", None)
         if flush is not None:
-            eff_step = train_state["neval"] // o.grad_accum
+            eff_step = train_state["nupdates"]
             lr = o.optim_method.current_rate(
                 {**train_state, "neval": eff_step})
             variables["params"], slots = flush(

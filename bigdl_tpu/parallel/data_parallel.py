@@ -39,11 +39,9 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bigdl_tpu.nn.module import Criterion, Module
+from bigdl_tpu.utils.anomaly import health_ok, select_update as _select_update
 
-try:  # jax >= 0.8 moved shard_map out of experimental
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+from bigdl_tpu.parallel.shard_map_compat import shard_map
 
 
 class FlatParamSpec:
@@ -182,11 +180,22 @@ def make_dp_train_step(
     clip_const: Optional[Tuple[float, float]] = None,
     clip_norm: Optional[float] = None,
     precision=None,
+    health: bool = False,
 ) -> Callable:
     """Build the jitted SPMD train step.
 
     Signature: (flat_w, slots, mod_state, bx, by, lr, stepno, rng)
              -> (flat_w', slots', mod_state', mean_loss)
+
+    With `health=True` (anomaly guard armed on the Optimizer) the step
+    takes a trailing `max_gnorm` scalar and returns two extra scalars
+    `(ok, gnorm)`: the pre-clip global gradient norm and the
+    utils/anomaly health predicate over (mean loss, norm, threshold).
+    When `ok` is false the update is discarded ON DEVICE — the returned
+    flat_w/slots/mod_state are the bit-identical inputs — so an
+    anomalous step can never write to the weights regardless of host
+    policy. Costs two scalar collectives; `health=False` builds exactly
+    the historical step.
 
     Shardings: flat_w replicated; slots sharded on `axis` (ZeRO-1);
     mod_state replicated; batch sharded on `axis`. `precision` is a
@@ -197,9 +206,19 @@ def make_dp_train_step(
     scattered_grads = _make_scattered_grads(model, criterion, spec, axis,
                                             grad_dtype, precision)
 
-    def body(flat_w, slots, mod_state, bx, by, lr, stepno, rng):
+    def body(flat_w, slots, mod_state, bx, by, lr, stepno, rng,
+             max_gnorm=None):
         g_my, new_state, loss = scattered_grads(flat_w, mod_state, bx, by,
                                                 rng)
+        mean_loss = lax.pmean(loss, axis)
+        new_state = _reduce_state(new_state, axis)
+        if other_axes:
+            mean_loss = lax.pmean(mean_loss, tuple(other_axes))
+        if health:
+            # pre-clip global norm of the mean gradient: the shards are
+            # disjoint slices of the flat vector, so one scalar psum
+            gnorm = jnp.sqrt(lax.psum(jnp.sum(g_my * g_my), axis))
+            ok = health_ok(mean_loss, gnorm, max_gnorm)
         g_my = _clip_shard(g_my, clip_const, clip_norm, axis)
 
         my_index = lax.axis_index(axis)
@@ -208,17 +227,23 @@ def make_dp_train_step(
         new_w_my, new_slots = method.update(g_my, w_my, slots, lr, stepno)
         new_flat_w = lax.all_gather(new_w_my, axis, axis=0, tiled=True)
 
-        mean_loss = lax.pmean(loss, axis)
-        new_state = _reduce_state(new_state, axis)
-        if other_axes:
-            mean_loss = lax.pmean(mean_loss, tuple(other_axes))
+        if health:
+            new_flat_w = _select_update(ok, new_flat_w, flat_w)
+            new_slots = _select_update(ok, new_slots, slots)
+            new_state = _select_update(ok, new_state, mod_state)
+            return new_flat_w, new_slots, new_state, mean_loss, ok, gnorm
         return new_flat_w, new_slots, new_state, mean_loss
 
     batch_spec = P(axis)
+    in_specs = (P(), P(axis), P(), batch_spec, batch_spec, P(), P(), P())
+    out_specs = (P(), P(axis), P(), P())
+    if health:
+        in_specs += (P(),)
+        out_specs += (P(), P())
     smapped = shard_map(
         body, mesh=mesh,
-        in_specs=(P(), P(axis), P(), batch_spec, batch_spec, P(), P(), P()),
-        out_specs=(P(), P(axis), P(), P()),
+        in_specs=in_specs,
+        out_specs=out_specs,
         check_vma=False,
     )
     return jax.jit(smapped, donate_argnums=(0, 1))
@@ -235,6 +260,7 @@ def make_dp_accum_steps(
     clip_const: Optional[Tuple[float, float]] = None,
     clip_norm: Optional[float] = None,
     precision=None,
+    health: bool = False,
 ) -> Tuple[Callable, Callable]:
     """Gradient accumulation on the mesh: the accumulator lives SHARDED
     (shard_size,) per device — micro-steps reduce-scatter then add, so
@@ -250,18 +276,34 @@ def make_dp_accum_steps(
               -> (flat_w', slots', zeroed g_acc)
     Clipping applies to the averaged accumulated gradient at update time
     (same semantics as the local path's clip_and_update).
+
+    With `health=True` micro_fn takes a trailing `max_gnorm` and returns
+    extra `(ok, gnorm)` scalars; an anomalous micro-gradient is NOT
+    added to the accumulator (and module state keeps its inputs), so
+    the guard screens each micro-batch before it can poison the cycle —
+    the host skips its micro_n increment, extending the cycle by one
+    batch. apply_fn is unchanged: it only ever sees screened gradients.
     """
     other_axes = [a for a in mesh.axis_names if a != axis]
     scattered_grads = _make_scattered_grads(model, criterion, spec, axis,
                                             grad_dtype, precision)
 
-    def micro_body(flat_w, g_acc, mod_state, bx, by, rng):
+    def micro_body(flat_w, g_acc, mod_state, bx, by, rng, max_gnorm=None):
         g_my, new_state, loss = scattered_grads(flat_w, mod_state, bx, by,
                                                 rng)
         mean_loss = lax.pmean(loss, axis)
         new_state = _reduce_state(new_state, axis)
         if other_axes:
             mean_loss = lax.pmean(mean_loss, tuple(other_axes))
+        if health:
+            gnorm = jnp.sqrt(lax.psum(jnp.sum(g_my * g_my), axis))
+            ok = health_ok(mean_loss, gnorm, max_gnorm)
+            # where-select the SUM, not the addend: adding 0.0 would
+            # flip -0.0 accumulator elements to +0.0 and break the
+            # bit-identical-discard contract
+            new_acc = jnp.where(ok, g_acc + g_my, g_acc)
+            new_state = _select_update(ok, new_state, mod_state)
+            return new_acc, new_state, mean_loss, ok, gnorm
         return g_acc + g_my, new_state, mean_loss
 
     def apply_body(flat_w, slots, g_acc, lr, stepno, n_micro):
@@ -274,10 +316,15 @@ def make_dp_accum_steps(
         return new_flat_w, new_slots, jnp.zeros_like(g_acc)
 
     batch_spec = P(axis)
+    micro_in = (P(), P(axis), P(), batch_spec, batch_spec, P())
+    micro_out = (P(axis), P(), P())
+    if health:
+        micro_in += (P(),)
+        micro_out += (P(), P())
     micro_fn = jax.jit(shard_map(
         micro_body, mesh=mesh,
-        in_specs=(P(), P(axis), P(), batch_spec, batch_spec, P()),
-        out_specs=(P(axis), P(), P()),
+        in_specs=micro_in,
+        out_specs=micro_out,
         check_vma=False,
     ), donate_argnums=(1,))
     apply_fn = jax.jit(shard_map(

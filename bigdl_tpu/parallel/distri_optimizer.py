@@ -15,6 +15,14 @@ partitions data".
 Failure recovery (reference: DistriOptimizer retry + reload-last-
 checkpoint, SURVEY.md §5.3): on a step exception with a checkpoint
 configured, reload the latest checkpoint and continue (`max_retries`).
+The reference gets its *guarantees* from Spark task retry + lineage
+(arXiv 1804.05839 §4); the substitutes here are explicit and tested:
+checkpoint loads verify per-array checksums and fall back past corrupt
+dirs (serialization/checkpoint.py), the numeric-anomaly guard discards
+NaN/Inf/spike updates on device with skip/rollback/halt policies
+(utils/anomaly.py, `Optimizer.set_anomaly_guard`), and every recovery
+path is exercised deterministically by fault injection
+(utils/faults.py, scripts/fault_drill.py).
 """
 
 from __future__ import annotations
@@ -35,7 +43,7 @@ from bigdl_tpu.parallel.data_parallel import (
     FlatParamSpec, make_dp_accum_steps, make_dp_eval_step,
     make_dp_train_step,
 )
-from bigdl_tpu.parallel.mesh import host_to_global
+from bigdl_tpu.parallel.mesh import host_to_global, place_global
 
 logger = logging.getLogger("bigdl_tpu.optim")
 
@@ -65,9 +73,9 @@ class DistriOptimizer(LocalOptimizer):
         return host_to_global(self.mesh, self._batch_spec(arr), arr)
 
     def _place_sharded_slots(self, slots):
-        shard = NamedSharding(self.mesh, P(self.axis))
-        return jax.tree_util.tree_map(
-            lambda s: jax.device_put(s, shard), slots)
+        # multi-process safe: every process holds the identical global
+        # slot values (same init / same checkpoint files)
+        return place_global(self.mesh, P(self.axis), slots)
 
     def _gather(self, tree):
         """Fetch a (possibly cross-process-sharded) ZeRO-1 tree to host.
@@ -147,41 +155,49 @@ class DistriOptimizer(LocalOptimizer):
                     "(padded %d, %d per shard)", n, self.axis, spec.total,
                     spec.padded, spec.shard_size)
 
+        guard = o.anomaly_guard
         accum = o.grad_accum
         if accum == 1:
             step_fn = make_dp_train_step(
                 o.model, o.criterion, o.optim_method, self.mesh, spec,
                 axis=self.axis, grad_dtype=self.grad_dtype,
                 clip_const=o.grad_clip_const, clip_norm=o.grad_clip_norm,
-                precision=o.precision)
+                precision=o.precision, health=guard is not None)
         else:
             micro_fn, apply_fn = make_dp_accum_steps(
                 o.model, o.criterion, o.optim_method, self.mesh, spec,
                 axis=self.axis, grad_dtype=self.grad_dtype,
                 clip_const=o.grad_clip_const, clip_norm=o.grad_clip_norm,
-                precision=o.precision)
+                precision=o.precision, health=guard is not None)
         if o.validation_methods:
             eval_fn = make_dp_eval_step(o.model, o.validation_methods,
                                         self.mesh, self.axis)
 
-        replicated = NamedSharding(self.mesh, P())
-        flat_w = jax.device_put(spec.flatten(variables["params"]), replicated)
-        mod_state = jax.device_put(variables["state"], replicated)
+        flat_w = place_global(self.mesh, P(), spec.flatten(variables["params"]))
+        mod_state = place_global(self.mesh, P(), variables["state"])
         # slot arrays are GLOBAL (padded,) shapes, device-placed sharded on
         # the data axis — each device materializes only its (shard_size,)
         # slice: the ZeRO-1 optimizer-state sharding
         slots = self._place_sharded_slots(
             o.optim_method.init_slots(jnp.zeros((spec.padded,), jnp.float32)))
-        sharded = NamedSharding(self.mesh, P(self.axis))
 
         def fresh_acc():
-            return jax.device_put(jnp.zeros((spec.padded,), jnp.float32),
-                                  sharded)
+            return place_global(self.mesh, P(self.axis),
+                                jnp.zeros((spec.padded,), jnp.float32))
 
         g_acc = fresh_acc() if accum > 1 else None
         micro_n = 0
+        # "nupdates" is the applied-update clock (stepno/schedules);
+        # see LocalOptimizer.run — guard-discarded updates and
+        # uncounted micro-batches do not advance it
         train_state: Dict[str, Any] = {"epoch": 1, "neval": 0,
-                                       "records": 0, "loss": None, "score": None}
+                                       "nupdates": 0, "records": 0,
+                                       "loss": None, "score": None}
+
+        def adopt_train_state(saved_ts):
+            train_state.update(saved_ts)
+            if "nupdates" not in saved_ts:  # pre-counter checkpoint
+                train_state["nupdates"] = train_state["neval"] // accum
 
         def restore_accum(optim_meta):
             """Reinstall a checkpointed mid-cycle accumulator (or reset).
@@ -220,20 +236,44 @@ class DistriOptimizer(LocalOptimizer):
                             f"{flat.shape[0]} to padded {spec.padded}")
                     flat = jnp.pad(flat[:old_total],
                                    (0, spec.padded - old_total))
-            g_acc = jax.device_put(flat, sharded)
+            g_acc = place_global(self.mesh, P(self.axis), flat)
             micro_n = int(saved["micro_n"])
+
+        def recover():
+            """Reload the newest VALID checkpoint (Checkpoint.load skips
+            corrupt dirs) and re-align the batch stream — shared by the
+            step-exception retry path and the anomaly guard's rollback
+            policy (the reference's reload-last-checkpoint recovery,
+            SURVEY.md §5.3)."""
+            nonlocal flat_w, mod_state, slots, batches
+            saved_vars, saved_slots, saved_ts, om = o.checkpoint.load(
+                with_optim_meta=True)
+            flat_w = place_global(self.mesh, P(),
+                                  spec.flatten(saved_vars["params"]))
+            mod_state = place_global(self.mesh, P(), saved_vars["state"])
+            slots = self._place_sharded_slots(
+                self._adapt_slots(saved_slots, om, spec))
+            adopt_train_state(saved_ts)
+            batches = _batch_iterator(o.dataset, True, self._local_bs,
+                                      skip=train_state["neval"])
+            restore_accum(om)
 
         if o._resume and o.checkpoint is not None and o.checkpoint.latest():
             saved_vars, saved_slots, saved_ts, optim_meta = o.checkpoint.load(
                 with_optim_meta=True)
-            flat_w = jax.device_put(spec.flatten(saved_vars["params"]), replicated)
-            mod_state = jax.device_put(saved_vars["state"], replicated)
+            flat_w = place_global(self.mesh, P(),
+                                  spec.flatten(saved_vars["params"]))
+            mod_state = place_global(self.mesh, P(), saved_vars["state"])
             slots = self._place_sharded_slots(
                 self._adapt_slots(saved_slots, optim_meta, spec))
-            train_state.update(saved_ts)
+            adopt_train_state(saved_ts)
             restore_accum(optim_meta)
-            logger.info("resumed from %s at %s", o.checkpoint.latest(), saved_ts)
+            logger.info("resumed from %s at %s",
+                        o.checkpoint._last_loaded, saved_ts)
 
+        from bigdl_tpu.utils import faults
+
+        plan = faults.get_plan()
         dataset_size = o.dataset.size()
         # fast-forward the deterministic batch stream past what the
         # checkpointed run consumed (bit-for-bit resume; no-op fresh)
@@ -244,29 +284,51 @@ class DistriOptimizer(LocalOptimizer):
 
         while not o.end_when(train_state):
             try:
+                plan.maybe_raise("step", train_state["neval"])
                 with Timer(self.metrics, "data_fetch_s"):
                     mb = next(batches)
+                if plan.fires("nan", train_state["neval"]):
+                    mb = faults.poison_minibatch(mb)
                 # schedules and the optimizer's step counter advance per
-                # UPDATE, not per micro-batch (mirrors LocalOptimizer)
-                eff_step = train_state["neval"] // accum
+                # APPLIED update, not per (micro-)batch (mirrors
+                # LocalOptimizer): a guard-discarded update re-uses its
+                # step index
+                eff_step = train_state["nupdates"]
                 lr = o.optim_method.current_rate(
-                    train_state if accum == 1
+                    train_state if accum == 1 and guard is None
                     else {**train_state, "neval": eff_step})
                 step_rng = jax.random.fold_in(rng, train_state["neval"])
+                thr = None if guard is None else jnp.asarray(
+                    guard.threshold(), jnp.float32)
                 with Timer(self.metrics, "dispatch_s"):
                     if accum == 1:
-                        flat_w, slots, mod_state, loss = step_fn(
+                        step_args = (
                             flat_w, slots, mod_state,
                             self._global(mb.input), self._global(mb.target),
                             jnp.asarray(lr, jnp.float32),
                             jnp.asarray(eff_step, jnp.int32),
                             step_rng)
+                        if guard is None:
+                            flat_w, slots, mod_state, loss = step_fn(
+                                *step_args)
+                        else:
+                            (flat_w, slots, mod_state, loss, ok_d,
+                             gnorm_d) = step_fn(*step_args, thr)
                     else:
-                        g_acc, mod_state, loss = micro_fn(
+                        micro_args = (
                             flat_w, g_acc, mod_state,
                             self._global(mb.input), self._global(mb.target),
                             step_rng)
-                        micro_n += 1
+                        if guard is None:
+                            g_acc, mod_state, loss = micro_fn(*micro_args)
+                            micro_n += 1
+                        else:
+                            (g_acc, mod_state, loss, ok_d,
+                             gnorm_d) = micro_fn(*micro_args, thr)
+                            # an anomalous micro-gradient was zeroed out
+                            # of the accumulator on device; don't count
+                            # it toward the cycle either
+                            micro_n += int(bool(ok_d))
                         if micro_n == accum:
                             flat_w, slots, g_acc = apply_fn(
                                 flat_w, slots, g_acc,
@@ -274,6 +336,7 @@ class DistriOptimizer(LocalOptimizer):
                                 jnp.asarray(eff_step, jnp.int32),
                                 jnp.asarray(accum, jnp.float32))
                             micro_n = 0
+                            train_state["nupdates"] += 1
             except Exception:
                 if (o.checkpoint is not None and o.checkpoint.latest()
                         and retries < self.max_retries):
@@ -281,20 +344,20 @@ class DistriOptimizer(LocalOptimizer):
                     logger.exception(
                         "step failed; recovering from checkpoint "
                         "(retry %d/%d)", retries, self.max_retries)
-                    saved_vars, saved_slots, saved_ts, om = o.checkpoint.load(
-                        with_optim_meta=True)
-                    flat_w = jax.device_put(
-                        spec.flatten(saved_vars["params"]), replicated)
-                    mod_state = jax.device_put(saved_vars["state"], replicated)
-                    slots = self._place_sharded_slots(
-                        self._adapt_slots(saved_slots, om, spec))
-                    train_state.update(saved_ts)
-                    batches = _batch_iterator(o.dataset, True,
-                                              self._local_bs,
-                                              skip=train_state["neval"])
-                    restore_accum(om)
+                    recover()
                     continue
                 raise
+
+            if guard is not None:
+                # scalar fetch syncs the step (the documented guard
+                # cost); the anomalous update is already discarded on
+                # device — the host only applies policy
+                action = guard.observe(bool(ok_d), float(gnorm_d),
+                                       train_state["neval"])
+                if action == "rollback":
+                    self._require_rollback_checkpoint()
+                    recover()
+                    continue
 
             # consecutive-failure budget, not a lifetime cap (the reference
             # budgets retries against repeated failure of the same step)
@@ -302,6 +365,12 @@ class DistriOptimizer(LocalOptimizer):
 
             real = getattr(mb, "real_size", mb.size)
             train_state["neval"] += 1
+            if accum == 1:
+                # a guard-discarded update keeps its step index for the
+                # next batch; the applied-update clock only advances on
+                # healthy steps (accum>1 advances at apply_fn above)
+                train_state["nupdates"] += 1 if guard is None \
+                    else int(bool(ok_d))
             train_state["records"] += real
             train_state["loss"] = loss
             now = time.perf_counter()
@@ -354,7 +423,8 @@ class DistriOptimizer(LocalOptimizer):
                 path = o.checkpoint.save(
                     train_state["neval"], saved_variables,
                     self._gather(slots),
-                    {k: train_state[k] for k in ("epoch", "neval", "records")},
+                    {k: train_state[k] for k in
+                     ("epoch", "neval", "nupdates", "records")},
                     optim_meta={"layout": "zero1_flat", "num_shards": n,
                                 "total": spec.total, "padded": spec.padded},
                     accum_state=accum_state)
@@ -372,7 +442,7 @@ class DistriOptimizer(LocalOptimizer):
         # accumulator (mean over micro-batches actually seen) so that
         # gradient work isn't silently discarded — mirrors LocalOptimizer
         if accum > 1 and micro_n:
-            eff_step = train_state["neval"] // accum
+            eff_step = train_state["nupdates"]
             lr = o.optim_method.current_rate(
                 {**train_state, "neval": eff_step})
             flat_w, slots, g_acc = apply_fn(

@@ -82,3 +82,29 @@ def host_to_global(mesh: Mesh, spec: P, array: np.ndarray) -> jax.Array:
         return jax.device_put(array, NamedSharding(mesh, spec))
     return jax.make_array_from_process_local_data(
         NamedSharding(mesh, spec), array)
+
+
+def place_global(mesh: Mesh, spec: P, tree):
+    """device_put a host-resident GLOBAL pytree onto the mesh under
+    `spec`, multi-process safe.
+
+    On a multi-process mesh, `jax.device_put` of host data onto a
+    non-addressable sharding first runs a cross-process equality check
+    (`multihost_utils.assert_equal`) — a collective that CPU backends
+    (jax 0.4.x) cannot run outside jit. Every caller here already
+    guarantees value equality across processes (deterministic init,
+    checkpoint loads of the same files), so build each process's
+    addressable shards locally via `make_array_from_callback` instead:
+    no communication, same resulting global array. The weights/slots
+    placement counterpart of `host_to_global` (which handles per-host
+    DATA, where local shards genuinely differ)."""
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(tree, sharding)
+
+    def put(x):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, sharding,
+                                            lambda idx: x[idx])
+
+    return jax.tree_util.tree_map(put, tree)

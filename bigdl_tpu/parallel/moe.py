@@ -31,6 +31,7 @@ from jax import lax
 
 from bigdl_tpu.nn.initialization import Xavier
 from bigdl_tpu.nn.module import Module
+from bigdl_tpu.parallel.shard_map_compat import axis_size
 
 
 class MoE(Module):
@@ -192,7 +193,7 @@ class MoE(Module):
         # expert-parallel: params arrive expert-sharded; route globally,
         # exchange tokens so each device runs only its local experts
         axis = self.expert_axis
-        n = lax.axis_size(axis)
+        n = axis_size(axis)
         e_local = p["w1"].shape[0]                 # num_experts / n
         if e_local * n != self.num_experts:
             raise ValueError(
@@ -264,10 +265,7 @@ def make_moe_lm_train_step(model, method, mesh, ep_axis: str = "expert"):
     import jax
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
+    from bigdl_tpu.parallel.shard_map_compat import shard_map
 
     if getattr(model, "ep_axis", None) != ep_axis:
         raise ValueError(

@@ -32,10 +32,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from bigdl_tpu.models.transformer import TransformerLM, tp_reduce
 
-try:  # jax >= 0.8
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from bigdl_tpu.parallel.shard_map_compat import shard_map
 
 
 def pipeline_specs(pipe_axis: str = "pipe", tie_embeddings: bool = True):

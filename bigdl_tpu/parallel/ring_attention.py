@@ -42,10 +42,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.8
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from bigdl_tpu.parallel.shard_map_compat import axis_size, shard_map
 
 _NEG_INF = -1e30
 
@@ -101,7 +98,7 @@ def ring_attention(
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     my = lax.axis_index(axis)
     s_local = q.shape[-2]
     q_off = my * s_local
@@ -191,7 +188,7 @@ def zigzag_ring_attention(
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     my = lax.axis_index(axis)
     s_local = q.shape[-2]
     if s_local % 2:
@@ -274,7 +271,7 @@ def ulysses_attention(
     """
     from bigdl_tpu.ops.flash_attention import flash_attention
 
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     h = q.shape[1]
     if h % n:
         raise ValueError(f"num_heads {h} not divisible by axis size {n}")

@@ -1,0 +1,48 @@
+"""shard_map compatibility shim.
+
+jax moved `shard_map` out of `jax.experimental` and renamed its
+`check_rep` flag to `check_vma` (jax >= 0.8). The mesh code in this
+package is written against the new spelling; this shim lets the same
+call run on either installed jax by translating the flag to whatever
+the resolved function actually accepts. Every shard_map import in
+bigdl_tpu goes through here — without it, the whole distributed plane
+(and the CPU fault drill that tier-1 runs) breaks on a pre-0.8 jax.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+try:  # jax >= 0.8
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+@functools.wraps(_shard_map)
+def shard_map(*args, **kwargs):
+    if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    elif "check_rep" in kwargs and "check_rep" not in _PARAMS:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    return _shard_map(*args, **kwargs)
+
+
+def axis_size(name: str) -> int:
+    """STATIC size of the named mesh axis from inside shard_map.
+
+    `jax.lax.axis_size` is newer than pre-0.5 jax; the fallback reads
+    the axis frame (an int in those versions). Static matters: callers
+    use it for Python loop bounds (ring attention's N-1 hops), where a
+    traced `psum(1, axis)` would not do."""
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(name)
+    from jax._src import core
+
+    frame = core.axis_frame(name)
+    return frame.size if hasattr(frame, "size") else frame

@@ -5,7 +5,10 @@ from bigdl_tpu.utils.engine import Engine
 from bigdl_tpu.utils.shape import Shape
 from bigdl_tpu.utils.logger_filter import redirect_logs
 from bigdl_tpu.utils.torch_file import load_t7, save_t7
+from bigdl_tpu.utils.anomaly import AnomalyError, AnomalyGuard
+from bigdl_tpu.utils.faults import FaultInjected, FaultPlan
 from bigdl_tpu.utils import profiler, precision
 
 __all__ = ["Table", "T", "Engine", "Shape", "redirect_logs", "profiler",
-           "precision", "load_t7", "save_t7"]
+           "precision", "load_t7", "save_t7", "AnomalyError",
+           "AnomalyGuard", "FaultInjected", "FaultPlan"]

@@ -1,0 +1,207 @@
+"""Numeric-anomaly guard for the training loop.
+
+Reference parity: the reference has NO numeric health monitoring — a NaN
+loss silently poisons the weights and every later checkpoint (SURVEY.md
+§5.3 lists retry/reload as the only safety net, and it only fires on an
+*exception*). TensorFlow's stated fault-tolerance contract is user-level
+checkpointing plus health monitoring (arXiv 1605.08695 §4.3); this
+module is the monitoring half for this framework.
+
+Split of responsibilities (keeps the guard cheap and deterministic):
+
+* Inside the jitted step the loops compute a health pair — the loss's
+  finiteness and the global (pre-clip) gradient norm — and select the
+  update with `jnp.where(ok, new, old)`. An anomalous update is
+  therefore discarded ON DEVICE, bit-exactly (`skip_step`: the returned
+  params/slots/module-state are the inputs, same bits), regardless of
+  how fast the host reacts. `ok = isfinite(loss) & isfinite(gnorm) &
+  (gnorm <= max_gnorm)`; the spike threshold `max_gnorm` is a scalar
+  argument fed by the host each step, so spike policy changes never
+  retrace. `health_ok` below is that predicate.
+* On the host, `AnomalyGuard.observe(ok, gnorm, step)` tracks the
+  gradient-norm EMA (arming the spike threshold after `warmup_steps`),
+  counts consecutive anomalies against `max_consecutive` (mirroring the
+  DistriOptimizer retry budget), and returns the policy action:
+
+      skip_step  "skipped"  — update already discarded on device; the
+                              step still consumes its batch so the loop
+                              advances past bad data
+      rollback   "rollback" — the loop reloads the latest checkpoint
+                              (the existing DistriOptimizer
+                              reload-latest path, now shared)
+      halt       raises AnomalyError immediately
+
+  Exhausting `max_consecutive` raises AnomalyError under every policy:
+  persistent non-finite math means the run is broken, and silently
+  skipping forever would hide it. Rollback has its own budget shape:
+  the replayed steps between reload and the anomaly are healthy, so
+  the consecutive counter alone would reset every cycle and a
+  data-inherent anomaly (a NaN baked into the dataset) would
+  rollback-loop forever — `observe` therefore also counts rollbacks
+  triggered by the SAME step number and raises once that replay streak
+  exceeds `max_consecutive` (progress past the step resets it).
+
+The guard is opt-in (`Optimizer.set_anomaly_guard(...)`); when unset the
+step functions are built exactly as before — zero overhead. When set,
+the extra cost is two scalar reductions in-step and a scalar
+device→host fetch per step — per MICRO-batch under gradient
+accumulation, where each micro-gradient's health must reach the host
+before the accumulation bookkeeping for the next one (the guarded
+accumulation path trades the async-dispatch overlap for screening).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Optional
+
+logger = logging.getLogger("bigdl_tpu.optim")
+
+POLICIES = ("skip_step", "rollback", "halt")
+
+
+class AnomalyError(RuntimeError):
+    """Numeric anomaly under policy 'halt', or anomaly budget exhausted."""
+
+
+def health_ok(loss, gnorm, max_gnorm):
+    """Jit-side health predicate: finite loss, finite grad norm, norm
+    under the host-fed spike threshold. NaN compares false, so the
+    `<=` alone rejects NaN norms; the explicit isfinite terms also
+    reject inf when the threshold itself is inf (disabled)."""
+    import jax.numpy as jnp
+
+    return (jnp.isfinite(loss) & jnp.isfinite(gnorm)
+            & (gnorm <= max_gnorm))
+
+
+def select_update(ok, new, old):
+    """Jit-side per-leaf where(ok): the computed update on healthy
+    steps, the bit-identical inputs on anomalous ones — the single
+    definition of the guard's on-device discard (used by the local
+    step builder and the dp shard_map bodies)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(ok, a, b), new, old)
+
+
+def global_norm(tree):
+    """sqrt(sum of squares) over a pytree or flat vector (jit-side)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in leaves))
+
+
+class AnomalyGuard:
+    """Policy + budget + spike detector for per-step health pairs.
+
+    policy          'skip_step' | 'rollback' | 'halt'
+    max_consecutive raise AnomalyError after this many anomalies in a
+                    row (the consecutive — not lifetime — budget, same
+                    shape as DistriOptimizer.max_retries)
+    spike_factor    None disables spike detection (finiteness only);
+                    else a step whose grad norm exceeds
+                    `spike_factor * EMA(grad norm)` is anomalous
+    ema_decay       EMA smoothing for the grad-norm baseline
+    warmup_steps    healthy steps observed before the spike threshold
+                    arms (early norms are noisy; never arms on NaN)
+    """
+
+    def __init__(self, policy: str = "skip_step", max_consecutive: int = 3,
+                 spike_factor: Optional[float] = None,
+                 ema_decay: float = 0.95, warmup_steps: int = 10):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"policy {policy!r}: expected one of {POLICIES}")
+        if max_consecutive < 1:
+            raise ValueError("max_consecutive must be >= 1")
+        if spike_factor is not None and spike_factor <= 1.0:
+            raise ValueError("spike_factor must be > 1")
+        self.policy = policy
+        self.max_consecutive = max_consecutive
+        self.spike_factor = spike_factor
+        self.ema_decay = ema_decay
+        self.warmup_steps = warmup_steps
+        self._ema: Optional[float] = None
+        self._healthy_seen = 0
+        self.consecutive = 0
+        self.anomalies = 0  # every anomaly observed, any policy
+        self.skipped = 0    # updates discarded-and-moved-past (skip_step)
+        self.rollbacks = 0
+        self.last_anomaly_step: Optional[int] = None
+        self._rollback_step: Optional[int] = None
+        self._rollback_streak = 0
+
+    # ------------------------------------------------------------- threshold
+    def threshold(self) -> float:
+        """Current max allowed grad norm (fed to the jitted step). inf
+        until spike detection is enabled AND warmed up."""
+        if (self.spike_factor is None or self._ema is None
+                or self._healthy_seen < self.warmup_steps):
+            return math.inf
+        return self.spike_factor * self._ema
+
+    # --------------------------------------------------------------- observe
+    def observe(self, ok: bool, gnorm: float, step: int) -> str:
+        """Record one step's health pair; returns 'ok', 'skipped' or
+        'rollback', or raises AnomalyError (halt / budget exhausted)."""
+        if ok:
+            self.consecutive = 0
+            self._healthy_seen += 1
+            if math.isfinite(gnorm):
+                self._ema = gnorm if self._ema is None else (
+                    self.ema_decay * self._ema
+                    + (1.0 - self.ema_decay) * gnorm)
+            return "ok"
+
+        self.consecutive += 1
+        self.anomalies += 1
+        self.last_anomaly_step = step
+        detail = (f"step {step}: non-finite or spiking update "
+                  f"(grad norm {gnorm:g}, threshold {self.threshold():g})")
+        if self.policy == "halt":
+            raise AnomalyError(detail)
+        if self.consecutive > self.max_consecutive:
+            raise AnomalyError(
+                f"{detail} — {self.consecutive} consecutive anomalies "
+                f"exceed max_consecutive={self.max_consecutive}")
+        if self.policy == "rollback":
+            # the replay between reload and this step is healthy, so
+            # `consecutive` resets every cycle — budget the number of
+            # times the SAME step re-triggers a rollback instead, or a
+            # data-inherent anomaly would rollback-loop forever
+            if step == self._rollback_step:
+                self._rollback_streak += 1
+            else:
+                self._rollback_step, self._rollback_streak = step, 1
+            if self._rollback_streak > self.max_consecutive:
+                raise AnomalyError(
+                    f"{detail} — step {step} re-triggered rollback on "
+                    f"{self._rollback_streak} consecutive replays "
+                    f"(max_consecutive={self.max_consecutive}); the "
+                    f"anomaly is deterministic, rolling back again "
+                    f"cannot recover")
+            self.rollbacks += 1
+            logger.warning("anomaly guard: %s; rolling back to the "
+                           "latest checkpoint (replay %d/%d for this "
+                           "step)", detail, self._rollback_streak,
+                           self.max_consecutive)
+            return "rollback"
+        self.skipped += 1
+        logger.warning("anomaly guard: %s; update skipped on device "
+                       "(%d/%d consecutive)", detail, self.consecutive,
+                       self.max_consecutive)
+        return "skipped"
+
+    def stats(self) -> dict:
+        return {"policy": self.policy, "anomalies": self.anomalies,
+                "skipped": self.skipped, "rollbacks": self.rollbacks,
+                "consecutive": self.consecutive,
+                "last_anomaly_step": self.last_anomaly_step,
+                "gnorm_ema": self._ema}

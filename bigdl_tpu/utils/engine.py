@@ -91,6 +91,25 @@ class Engine:
                 num_processes = int(n)
                 process_id = int(pid)
         if coordinator_address is not None:
+            # CPU multi-process (the local[N]-style smoke/drill
+            # topology, scripts/multihost_smoke.py): jax 0.4.x CPU
+            # clients have NO default cross-process collectives — the
+            # first sharded computation dies with "Multiprocess
+            # computations aren't implemented on the CPU backend"
+            # unless an implementation (gloo over TCP) is selected
+            # before the backend is created. Read only at CPU-client
+            # creation, so a no-op on TPU pods.
+            plat = (os.environ.get("JAX_PLATFORMS")
+                    or str(getattr(jax.config, "jax_platforms", None)
+                           or ""))
+            if (plat.startswith("cpu") and
+                    not os.environ.get(
+                        "JAX_CPU_COLLECTIVES_IMPLEMENTATION")):
+                try:
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo")
+                except (AttributeError, ValueError):
+                    pass  # newer jax: flag retired (gloo is the default)
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,

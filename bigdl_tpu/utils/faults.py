@@ -1,0 +1,167 @@
+"""Deterministic fault injection for the training loop.
+
+Reference parity: the reference never tests its recovery path directly —
+it inherits Spark task retry and exercises it only when a node actually
+dies (SURVEY.md §5.3). Here the recovery code (DistriOptimizer
+reload-latest retry, Checkpoint atomic publish + newest-valid fallback,
+utils/anomaly guard) is a tested contract: this registry injects the
+failures on demand, deterministically by step number, so every drill is
+reproducible bit-for-bit (scripts/fault_drill.py, tests/test_fault_drill.py).
+
+Plan syntax (env `BIGDL_FAULTS` or `FaultPlan("...")`):
+
+    kind@step[xN][,kind@step...]     e.g. "nan@4,step@7,ckpt_corrupt@6x2"
+
+Each entry fires at most N times (default 1) when its fault point is
+consulted with that step number. One-shot by default on purpose: the
+recovery path REPLAYS the failed step (reload latest checkpoint +
+deterministic batch-stream fast-forward), so a fault that re-fired on
+the replayed step would spin the retry budget down instead of proving
+recovery.
+
+Fault kinds and where they are consulted:
+
+    step          raise before dispatching train step `step`
+                  (LocalOptimizer.run / DistriOptimizer.run)
+    nan           poison the batch for step `step` with NaNs — loss and
+                  gradients go NaN through the real math, exercising the
+                  anomaly guard end-to-end
+    data          raise from the training batch iterator at global
+                  stream position `step` (optimizer._batch_iterator)
+    ckpt_torn     abort Checkpoint.save(step) after the staging dir is
+                  partially written, before publish — the crash-mid-write
+                  model; latest() must never surface the leftovers
+    ckpt_corrupt  complete Checkpoint.save(step) normally, then truncate
+                  the published model.npz — load() must fall back to the
+                  newest valid checkpoint
+
+The plan is process-global (`get_plan()`/`set_plan()`); `get_plan()`
+lazily builds one from `BIGDL_FAULTS` so subprocess drills (multihost
+legs) inherit injection through the environment.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger("bigdl_tpu.faults")
+
+ENV_VAR = "BIGDL_FAULTS"
+
+KINDS = ("step", "nan", "data", "ckpt_torn", "ckpt_corrupt")
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an injected failure (never by real code paths)."""
+
+
+class FaultPlan:
+    """Parsed injection plan; `fires(kind, step)` consumes one shot."""
+
+    def __init__(self, spec: str = ""):
+        self.spec = spec or ""
+        self._budget: Dict[Tuple[str, int], int] = {}
+        self.fired: List[Tuple[str, int]] = []
+        for entry in filter(None, (e.strip() for e in self.spec.split(","))):
+            m = re.fullmatch(r"([a-z_]+)@(\d+)(?:x(\d+))?", entry)
+            if not m:
+                raise ValueError(
+                    f"bad fault entry {entry!r}: expected 'kind@step[xN]'")
+            kind, step, times = m.group(1), int(m.group(2)), \
+                int(m.group(3) or 1)
+            if kind not in KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r}: expected one of {KINDS}")
+            key = (kind, step)
+            self._budget[key] = self._budget.get(key, 0) + times
+
+    def __bool__(self):
+        return bool(self._budget)
+
+    def fires(self, kind: str, step: int) -> bool:
+        """True (and consumes one shot) if `kind` is armed for `step`."""
+        key = (kind, int(step))
+        left = self._budget.get(key, 0)
+        if left <= 0:
+            return False
+        self._budget[key] = left - 1
+        self.fired.append(key)
+        logger.warning("fault injected: %s@%d", kind, step)
+        return True
+
+    def maybe_raise(self, kind: str, step: int) -> None:
+        if self.fires(kind, step):
+            raise FaultInjected(f"injected fault {kind}@{int(step)}")
+
+
+_NO_FAULTS = FaultPlan("")
+_plan: Optional[FaultPlan] = None
+
+
+def get_plan() -> FaultPlan:
+    """The active plan — from `set_plan`, else `BIGDL_FAULTS`, else empty."""
+    global _plan
+    if _plan is None:
+        _plan = FaultPlan(os.environ.get(ENV_VAR, ""))
+    return _plan
+
+
+def set_plan(plan: Optional[FaultPlan]) -> None:
+    """Install a plan programmatically (None → re-read the env lazily)."""
+    global _plan
+    _plan = plan
+
+
+def poison_minibatch(mb):
+    """A NaN-input copy of a MiniBatch: every float feature becomes NaN,
+    so the step's loss/gradients go non-finite through the real math.
+    Raises if the batch has NO float feature (integer-token models):
+    a 'nan' fault that cannot actually poison anything would otherwise
+    log 'fault injected' and let the drill pass vacuously."""
+    import numpy as np
+
+    from bigdl_tpu.dataset.sample import MiniBatch
+
+    poisoned = [0]
+
+    def nan_like(x):
+        if isinstance(x, tuple):
+            return tuple(nan_like(e) for e in x)
+        a = np.asarray(x)
+        if np.issubdtype(a.dtype, np.floating):
+            poisoned[0] += 1
+            return np.full_like(a, np.nan)
+        return a
+
+    out = MiniBatch(nan_like(mb.input), mb.target)
+    if not poisoned[0]:
+        raise ValueError(
+            "nan fault: minibatch has no floating-point input to poison "
+            "(integer-token model?) — inject 'step' or 'data' faults "
+            "instead, or poison the loss path directly")
+    if hasattr(mb, "real_size"):
+        out.real_size = mb.real_size
+    return out
+
+
+def corrupt_file(path: str, mode: str = "truncate") -> None:
+    """Damage an on-disk checkpoint artifact in place.
+
+    `truncate`: keep the first half of the file (a torn write / partial
+    flush); `garble`: overwrite the middle third with 0xFF (bit rot).
+    Both are detected by checkpoint verification — truncation breaks the
+    npz zip directory, garbling breaks the per-array checksums.
+    """
+    size = os.path.getsize(path)
+    if mode == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+    elif mode == "garble":
+        with open(path, "r+b") as f:
+            f.seek(size // 3)
+            f.write(b"\xff" * max(size // 3, 1))
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")

@@ -1,0 +1,247 @@
+"""Fault drill — deterministic failure injection against the training
+loop's recovery contract (ISSUE 1 tentpole; reference anchor: the
+reference inherits its guarantees from Spark task retry + lineage,
+arXiv 1804.05839 §4, and never tests them directly — here every
+recovery path is exercised on demand, reproducibly, by step number).
+
+Six legs, each a tiny MLP classification run on CPU (the virtual
+8-device mesh for the distributed legs — the same shard_map code a pod
+runs):
+
+    nan_skip        guard policy 'skip_step', injected NaN batch at
+                    step 4: the update is discarded ON DEVICE — weights
+                    after the poisoned step are bit-identical to the
+                    pre-step weights (LocalOptimizer path)
+    nan_skip_mesh   same contract through DistriOptimizer's shard_map
+                    step (psum'd health scalars, replicated ok)
+    rollback        guard policy 'rollback', NaN at step 5: reload the
+                    latest checkpoint, replay deterministically, finish
+                    bit-identical to the clean run
+    step_retry      injected step exception at step 5: DistriOptimizer
+                    retry budget reloads the latest checkpoint and
+                    replays (SURVEY.md §5.3 recovery path)
+    data_retry      injected data-loader failure at stream position 5:
+                    same retry path, entered from the iterator
+    ckpt_torn       save aborted mid-write (crash model): the staging
+                    dir is never published, latest() keeps pointing at
+                    the previous checkpoint, resume is bit-identical
+    ckpt_fallback   published checkpoint truncated after the fact (bit
+                    rot): load() detects the checksum/zip damage and
+                    falls back to the newest VALID checkpoint
+
+Every leg compares parameters BIT-FOR-BIT against an uninterrupted
+reference run (same init, same deterministic batch stream, same rng
+folding), so "recovered" means "indistinguishable from never having
+failed" — not merely "didn't crash".
+
+Usage:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/fault_drill.py            # all legs
+    ... fault_drill.py --legs nan_skip,ckpt_fallback
+
+CI: tests/test_fault_drill.py runs these legs on every tier-1 pass.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    from bigdl_tpu.utils.engine import ensure_cpu_platform
+
+    ensure_cpu_platform()
+
+
+def _flat(model):
+    return np.concatenate([np.ravel(np.asarray(a, np.float32))
+                           for _, a in model.parameters()])
+
+
+def _train(workdir, end_iter, *, faults="", guard=None, mesh=False,
+           ckpt_iter=None, resume=False, tag="run"):
+    """One training run under an injection plan; returns (flat params,
+    the Optimizer) so legs can inspect guard stats / checkpoint state.
+    The plan is installed fresh per run — one-shot budgets never leak
+    across runs, which is what makes every leg reproducible."""
+    import jax
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.sample import Sample
+    from bigdl_tpu.optim import Adam, Optimizer, Trigger
+    from bigdl_tpu.parallel import make_mesh
+    from bigdl_tpu.utils import faults as faults_mod
+
+    rng = np.random.RandomState(11)
+    samples = [Sample(rng.rand(6).astype(np.float32),
+                      int(rng.randint(0, 4))) for _ in range(64)]
+    model = nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 4),
+                          nn.LogSoftMax()).build(jax.random.PRNGKey(3))
+    opt = (Optimizer(model, DataSet.array(samples),
+                     nn.ClassNLLCriterion(), batch_size=8)
+           .set_optim_method(Adam(learningrate=1e-2))
+           .set_end_when(Trigger.max_iteration(end_iter)))
+    if guard is not None:
+        opt.set_anomaly_guard(guard)
+    if ckpt_iter is not None:
+        opt.set_checkpoint(os.path.join(workdir, tag),
+                           Trigger.several_iteration(ckpt_iter))
+    if resume:
+        opt.resume_from_checkpoint()
+    if mesh:
+        opt.set_mesh(make_mesh({"data": jax.device_count()}))
+    faults_mod.set_plan(faults_mod.FaultPlan(faults))
+    try:
+        trained = opt.optimize()
+    finally:
+        plan = faults_mod.get_plan()
+        faults_mod.set_plan(None)
+    return _flat(trained), opt, plan
+
+
+# ------------------------------------------------------------------ legs
+
+def drill_nan_skip(workdir, mesh=False):
+    """NaN batch at step 4 under 'skip_step': weights after the poisoned
+    step must be bit-identical to the PRE-step weights (= a clean run
+    stopped just before it), and the guard must have counted it.
+
+    The reference runs with the guard ARMED too: arming it compiles a
+    different XLA graph (the extra norm reduction changes fusion), which
+    shifts healthy-step float results at the ulp level — the guard's
+    bit-identity promise is against the same armed executable, not
+    against an unguarded run."""
+    ref, _, _ = _train(workdir, end_iter=4, guard="skip_step", mesh=mesh,
+                       tag="nsr")
+    got, opt, plan = _train(workdir, end_iter=5, faults="nan@4",
+                            guard="skip_step", mesh=mesh, tag="nsf")
+    g = opt.anomaly_guard
+    return {"ok": bool(np.array_equal(ref, got)) and g.skipped == 1
+            and ("nan", 4) in plan.fired,
+            "bit_identical_to_pre_step": bool(np.array_equal(ref, got)),
+            "guard": g.stats(), "fired": plan.fired}
+
+
+def drill_rollback(workdir):
+    """NaN at step 5 under 'rollback': reload checkpoint-3, replay the
+    stream deterministically (one-shot fault does not re-fire), finish
+    bit-identical to the uninterrupted run (which also runs armed —
+    see drill_nan_skip on why the reference must share the guard's
+    compiled graph)."""
+    ref, _, _ = _train(workdir, end_iter=8, guard="rollback", ckpt_iter=3,
+                       tag="rbr")
+    got, opt, plan = _train(workdir, end_iter=8, faults="nan@5",
+                            guard="rollback", ckpt_iter=3, tag="rbf")
+    g = opt.anomaly_guard
+    return {"ok": bool(np.array_equal(ref, got)) and g.rollbacks == 1
+            and ("nan", 5) in plan.fired,
+            "bit_identical": bool(np.array_equal(ref, got)),
+            "guard": g.stats(), "fired": plan.fired}
+
+
+def drill_step_retry(workdir):
+    """Step exception at step 5 on the mesh path: the DistriOptimizer
+    retry budget reloads checkpoint-3 and replays to a bit-identical
+    finish (the reference's reload-last-checkpoint recovery)."""
+    ref, _, _ = _train(workdir, end_iter=8, mesh=True, tag="srr")
+    got, _, plan = _train(workdir, end_iter=8, faults="step@5",
+                          mesh=True, ckpt_iter=3, tag="srf")
+    return {"ok": bool(np.array_equal(ref, got))
+            and ("step", 5) in plan.fired,
+            "bit_identical": bool(np.array_equal(ref, got)),
+            "fired": plan.fired}
+
+
+def drill_data_retry(workdir):
+    """Data-loader failure at stream position 5: enters the same retry
+    path from the batch iterator instead of the step dispatch."""
+    ref, _, _ = _train(workdir, end_iter=8, mesh=True, tag="drr")
+    got, _, plan = _train(workdir, end_iter=8, faults="data@5",
+                          mesh=True, ckpt_iter=3, tag="drf")
+    return {"ok": bool(np.array_equal(ref, got))
+            and ("data", 5) in plan.fired,
+            "bit_identical": bool(np.array_equal(ref, got)),
+            "fired": plan.fired}
+
+
+def drill_ckpt_torn(workdir):
+    """Crash mid-checkpoint-write at step 4 (staging dir half-written,
+    never published): the process dies; latest() must keep pointing at
+    checkpoint-2, the torn leftovers must never surface, and the resume
+    finishes bit-identical."""
+    from bigdl_tpu.utils.faults import FaultInjected
+
+    ref, _, _ = _train(workdir, end_iter=6, tag="ctr")
+    died = False
+    try:
+        _train(workdir, end_iter=6, faults="ckpt_torn@4", ckpt_iter=2,
+               tag="ctf")
+    except FaultInjected:
+        died = True  # the modeled crash
+    ckdir = os.path.join(workdir, "ctf")
+    leftovers = [d for d in os.listdir(ckdir) if d.endswith(".inprogress")]
+    got, opt, _ = _train(workdir, end_iter=6, ckpt_iter=2, resume=True,
+                         tag="ctf")
+    latest = opt.checkpoint.latest()
+    return {"ok": died and bool(leftovers)
+            and bool(np.array_equal(ref, got)),
+            "crashed_mid_write": died, "staging_leftovers": leftovers,
+            "latest_after_resume": os.path.basename(latest or ""),
+            "bit_identical": bool(np.array_equal(ref, got))}
+
+
+def drill_ckpt_fallback(workdir):
+    """checkpoint-6 published then truncated (bit-rot model): the resume
+    must DETECT the damage (checksums / zip structure), skip the dir,
+    fall back to checkpoint-3, and still finish bit-identical."""
+    ref, _, _ = _train(workdir, end_iter=9, tag="cfr")
+    _train(workdir, end_iter=7, faults="ckpt_corrupt@6", ckpt_iter=3,
+           tag="cff")
+    got, opt, _ = _train(workdir, end_iter=9, ckpt_iter=3, resume=True,
+                         tag="cff")
+    skipped = [os.path.basename(d) for d in opt.checkpoint.corrupt_skipped]
+    return {"ok": "checkpoint-6" in skipped
+            and bool(np.array_equal(ref, got)),
+            "corrupt_skipped": skipped,
+            "resumed_from": os.path.basename(
+                opt.checkpoint._last_loaded or ""),
+            "bit_identical": bool(np.array_equal(ref, got))}
+
+
+LEGS = {
+    "nan_skip": drill_nan_skip,
+    "nan_skip_mesh": lambda wd: drill_nan_skip(wd, mesh=True),
+    "rollback": drill_rollback,
+    "step_retry": drill_step_retry,
+    "data_retry": drill_data_retry,
+    "ckpt_torn": drill_ckpt_torn,
+    "ckpt_fallback": drill_ckpt_fallback,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--legs", default=",".join(LEGS),
+                    help="comma subset of legs to run")
+    args = ap.parse_args()
+    results, ok = {}, True
+    for name in args.legs.split(","):
+        with tempfile.TemporaryDirectory(prefix=f"fault_{name}_") as wd:
+            r = LEGS[name](wd)
+        results[name] = r
+        ok = ok and r["ok"]
+        print(json.dumps({"leg": name, **r}))
+    print(json.dumps({"ok": ok, "legs": list(results)}))
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -20,7 +20,14 @@ job), then ALL processes restart with --resume and reload the latest
 atomic checkpoint. Digests must be bit-identical to the uninterrupted
 run on every process.
 
-    python scripts/multihost_smoke.py          # both legs
+Leg 3 (ckpt_corrupt — ISSUE 1 verified checkpoint integrity): same
+4×2 job, killed once checkpoint-6 publishes; the newest checkpoint's
+model.npz is truncated on disk before the restart. Every process must
+detect the damage (per-array checksums, serialization/checkpoint.py),
+fall back to the newest VALID checkpoint, and finish bit-identical to
+the uninterrupted run.
+
+    python scripts/multihost_smoke.py          # all legs
 """
 
 import argparse
@@ -103,13 +110,13 @@ def child(args):
                .set_mesh(mesh))
         if resume:
             opt.resume_from_checkpoint()
-        return opt.optimize()
+        return opt.optimize(), opt
 
     if args.leg == "smoke":
-        m1 = train(3, resume=False)   # 3 steps + checkpoint
-        m2 = train(6, resume=True)    # resume, 3 more steps
+        m1, _ = train(3, resume=False)   # 3 steps + checkpoint
+        m2, opt = train(6, resume=True)  # resume, 3 more steps
     else:  # kill_resume: one uninterrupted (or resumed) run to the end
-        m2 = train(args.end_iter, resume=args.resume)
+        m2, opt = train(args.end_iter, resume=args.resume)
 
     flat = np.concatenate([np.ravel(np.asarray(a, np.float32))
                            for _, a in m2.parameters()])
@@ -126,7 +133,14 @@ def child(args):
            "sha256": sha,
            "processes": jax.process_count(),
            "devices": jax.device_count(),
-           "checkpoint_resumed": args.leg == "smoke" or args.resume}
+           "checkpoint_resumed": args.leg == "smoke" or args.resume,
+           # recovery provenance for the ckpt_corrupt leg: which dir
+           # the resume actually loaded, and which it skipped as
+           # corrupt (serialization/checkpoint.py fallback)
+           "resumed_from": os.path.basename(
+               opt.checkpoint._last_loaded or "") if args.resume else None,
+           "corrupt_skipped": [os.path.basename(d) for d
+                               in opt.checkpoint.corrupt_skipped]}
     with open(os.path.join(args.workdir, f"proc{args.process_id}.json"),
               "w") as f:
         json.dump(out, f)
@@ -264,6 +278,85 @@ def _leg_kill_resume(port):
             "bit_identical": ok}
 
 
+def _leg_ckpt_corrupt(port):
+    """kill_resume variant for checkpoint INTEGRITY (ISSUE 1): the whole
+    job is killed once checkpoint-6 publishes, the newest checkpoint's
+    model arrays are truncated on disk (torn flush / bit rot), and the
+    restart must detect the damage (per-array checksums + zip
+    structure), fall back to the newest VALID checkpoint on every
+    process, and still finish bit-identical to the uninterrupted run."""
+    import re
+    import tempfile
+    import time
+
+    n, dpp, end = 4, 2, 12
+    wd_ref = tempfile.mkdtemp(prefix="multihost_ckref_")
+    codes_ref = _reap(_spawn_group("kill_resume", n, dpp, port, wd_ref,
+                                   end_iter=end))
+    if any(c != 0 for c in codes_ref):
+        return {"ok": False, "stage": "reference", "return_codes": codes_ref}
+    _, shas_ref = _collect(wd_ref, n)
+
+    # run until two checkpoints exist (3 and 6), then kill the job
+    wd = tempfile.mkdtemp(prefix="multihost_ckcorrupt_")
+    procs = _spawn_group("kill_resume", n, dpp, port + 1, wd,
+                         end_iter=end)
+    ckdir = os.path.join(wd, "ckpt")
+    marker = os.path.join(ckdir, "checkpoint-6")
+    deadline = time.time() + 300
+    saw = False
+    while time.time() < deadline:
+        if os.path.isdir(marker):
+            saw = True
+            break
+        if any(p.poll() is not None for p in procs):
+            break
+        time.sleep(0.05)
+    for p in procs:
+        p.kill()
+    _reap(procs, timeout=30)
+    if not saw:
+        return {"ok": False, "stage": "kill",
+                "detail": "checkpoint-6 never appeared (or a worker "
+                          "exited first) — nothing to corrupt"}
+
+    # truncate the newest published checkpoint's model arrays (inline —
+    # the launcher stays free of jax imports; same damage model as
+    # utils.faults.corrupt_file 'truncate')
+    published = sorted(
+        (d for d in os.listdir(ckdir)
+         if re.fullmatch(r"checkpoint-(\d+)", d)),
+        key=lambda d: int(d.split("-")[1]))
+    newest, expect_fallback = published[-1], published[-2]
+    npz = os.path.join(ckdir, newest, "model.npz")
+    size = os.path.getsize(npz)
+    with open(npz, "r+b") as f:
+        f.truncate(max(size // 2, 1))
+
+    codes_res = _reap(_spawn_group("kill_resume", n, dpp, port + 2, wd,
+                                   end_iter=end, resume=True))
+    if any(c != 0 for c in codes_res):
+        return {"ok": False, "stage": "resume", "return_codes": codes_res}
+    _, shas_res = _collect(wd, n)
+    resumed_from, skipped = [], []
+    for pid in range(n):
+        with open(os.path.join(wd, f"proc{pid}.json")) as f:
+            d = json.load(f)
+        resumed_from.append(d.get("resumed_from"))
+        skipped.append(d.get("corrupt_skipped", []))
+    fell_back = (all(r == expect_fallback for r in resumed_from)
+                 and all(newest in s for s in skipped))
+    ok = (fell_back and len(set(shas_res)) == 1
+          and len(set(shas_ref)) == 1 and shas_res[0] == shas_ref[0])
+    return {"ok": ok, "processes": n, "devices_per_process": dpp,
+            "steps": end, "corrupted": newest,
+            "resumed_from": resumed_from[0],
+            "fell_back_on_every_process": fell_back,
+            "sha256_uninterrupted": shas_ref[0][:16],
+            "sha256_resumed": shas_res[0][:16],
+            "bit_identical": shas_res[0] == shas_ref[0]}
+
+
 def launcher(legs):
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "MULTIHOST.json")
@@ -279,15 +372,19 @@ def launcher(legs):
     ok = True
     if "smoke" in legs:
         smoke = _leg_smoke(PORT)
-        kill_prev = result.get("kill_resume")
+        prev = {k: result[k] for k in ("kill_resume", "ckpt_corrupt")
+                if k in result}
         result = dict(smoke)  # legacy top-level shape for leg 1
-        if kill_prev is not None:
-            result["kill_resume"] = kill_prev
+        result.update(prev)
         ok = ok and smoke["ok"]
     if "kill_resume" in legs:
         kill = _leg_kill_resume(PORT + 10)
         result["kill_resume"] = kill
         ok = ok and kill.get("ok", False)
+    if "ckpt_corrupt" in legs:
+        corrupt = _leg_ckpt_corrupt(PORT + 20)
+        result["ckpt_corrupt"] = corrupt
+        ok = ok and corrupt.get("ok", False)
     result["ok"] = bool(ok and result.get("ok", True))
     with open(path, "w") as f:
         json.dump(result, f)
@@ -305,7 +402,7 @@ def main():
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--leg", default="smoke",
                     choices=["smoke", "kill_resume"])
-    ap.add_argument("--legs", default="smoke,kill_resume",
+    ap.add_argument("--legs", default="smoke,kill_resume,ckpt_corrupt",
                     help="launcher mode: comma subset of legs to run")
     ap.add_argument("--end-iter", type=int, default=6)
     ap.add_argument("--resume", action="store_true")

@@ -124,10 +124,7 @@ def measure_mesh(n, model_name, per_chip_batch, iters, ici_gbps):
 
     # collective alone: psum_scatter + all_gather at the wire size the
     # DP step uses (bf16 chunks), via shard_map like the real step
-    try:
-        from jax import shard_map
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
+    from bigdl_tpu.parallel.shard_map_compat import shard_map
     from jax import lax
 
     # chained inside one jit AND value-varying every iteration: the

@@ -1,0 +1,192 @@
+"""Fault drill as tier-1 CI (ISSUE 1 satellite): every test run
+exercises injected NaN-skip, step-exception retry, and
+corrupt-checkpoint fallback on the CPU mesh — the recovery paths the
+reference only ever exercised when a node actually died (SURVEY.md
+§5.3). Drill legs live in scripts/fault_drill.py (also a standalone
+driver); unit tests for the injection registry (utils/faults) and the
+anomaly guard (utils/anomaly) ride along."""
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.utils import anomaly, faults
+
+
+def _load_drill():
+    path = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                        "fault_drill.py")
+    spec = importlib.util.spec_from_file_location("fault_drill", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    """No injection plan may leak between tests (process-global)."""
+    faults.set_plan(None)
+    yield
+    faults.set_plan(None)
+
+
+# ------------------------------------------------------------ drill legs
+
+@pytest.mark.parametrize("leg", ["nan_skip", "nan_skip_mesh", "rollback",
+                                 "step_retry", "data_retry", "ckpt_torn",
+                                 "ckpt_fallback"])
+def test_drill_leg(tmp_path, leg):
+    fd = _load_drill()
+    result = fd.LEGS[leg](str(tmp_path))
+    assert result["ok"], result
+
+
+# ------------------------------------------------------------- FaultPlan
+
+def test_plan_parse_and_one_shot():
+    plan = faults.FaultPlan("nan@4,step@7,ckpt_corrupt@6x2")
+    assert plan
+    assert plan.fires("nan", 4)
+    assert not plan.fires("nan", 4), "one-shot by default"
+    assert not plan.fires("nan", 5)
+    assert plan.fires("ckpt_corrupt", 6) and plan.fires("ckpt_corrupt", 6)
+    assert not plan.fires("ckpt_corrupt", 6), "xN budget exhausted"
+    assert ("step", 7) not in plan.fired
+    with pytest.raises(faults.FaultInjected):
+        plan.maybe_raise("step", 7)
+    assert plan.fired == [("nan", 4), ("ckpt_corrupt", 6),
+                          ("ckpt_corrupt", 6), ("step", 7)]
+
+
+def test_plan_rejects_bad_specs():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.FaultPlan("frobnicate@3")
+    with pytest.raises(ValueError, match="expected 'kind@step"):
+        faults.FaultPlan("nan@")
+    assert not faults.FaultPlan("")
+
+
+def test_plan_from_env(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "data@2")
+    faults.set_plan(None)  # drop the cached plan; re-read lazily
+    assert faults.get_plan().fires("data", 2)
+
+
+def test_poison_minibatch_floats_only():
+    from bigdl_tpu.dataset.sample import MiniBatch
+
+    mb = MiniBatch((np.ones((2, 3), np.float32),
+                    np.arange(2, dtype=np.int32)),
+                   np.zeros(2, np.int64))
+    out = faults.poison_minibatch(mb)
+    assert np.isnan(out.input[0]).all()
+    np.testing.assert_array_equal(out.input[1], mb.input[1])
+    np.testing.assert_array_equal(out.target, mb.target)
+    # an all-integer batch can't be poisoned — must fail loudly, not
+    # log 'fault injected' and pass vacuously
+    with pytest.raises(ValueError, match="no floating-point"):
+        faults.poison_minibatch(
+            MiniBatch(np.arange(6, dtype=np.int32).reshape(2, 3),
+                      np.zeros(2, np.int64)))
+
+
+def test_corrupt_file_modes(tmp_path):
+    p = tmp_path / "blob"
+    p.write_bytes(b"a" * 300)
+    faults.corrupt_file(str(p), "truncate")
+    assert p.stat().st_size == 150
+    p.write_bytes(b"a" * 300)
+    faults.corrupt_file(str(p), "garble")
+    data = p.read_bytes()
+    assert len(data) == 300 and b"\xff" * 100 in data
+    with pytest.raises(ValueError):
+        faults.corrupt_file(str(p), "shred")
+
+
+# ---------------------------------------------------------- AnomalyGuard
+
+def test_guard_rejects_bad_config():
+    with pytest.raises(ValueError):
+        anomaly.AnomalyGuard(policy="explode")
+    with pytest.raises(ValueError):
+        anomaly.AnomalyGuard(max_consecutive=0)
+    with pytest.raises(ValueError):
+        anomaly.AnomalyGuard(spike_factor=0.5)
+
+
+def test_guard_halt_raises_immediately():
+    g = anomaly.AnomalyGuard(policy="halt")
+    assert g.observe(True, 1.0, 0) == "ok"
+    with pytest.raises(anomaly.AnomalyError):
+        g.observe(False, float("nan"), 1)
+
+
+def test_guard_consecutive_budget():
+    g = anomaly.AnomalyGuard(policy="skip_step", max_consecutive=2)
+    assert g.observe(False, float("inf"), 0) == "skipped"
+    assert g.observe(False, float("inf"), 1) == "skipped"
+    with pytest.raises(anomaly.AnomalyError, match="consecutive"):
+        g.observe(False, float("inf"), 2)
+    g2 = anomaly.AnomalyGuard(policy="skip_step", max_consecutive=2)
+    g2.observe(False, float("inf"), 0)
+    g2.observe(True, 1.0, 1)  # a healthy step resets the budget
+    assert g2.consecutive == 0
+    assert g2.observe(False, float("inf"), 2) == "skipped"
+    assert g2.skipped == 2
+
+
+def test_guard_rollback_replay_budget():
+    """A data-inherent anomaly re-fires on the SAME step after every
+    rollback (the replayed steps in between are healthy and reset the
+    consecutive counter) — the replay streak must hit a budget instead
+    of rollback-looping forever."""
+    g = anomaly.AnomalyGuard(policy="rollback", max_consecutive=2)
+    assert g.observe(False, float("nan"), 5) == "rollback"
+    g.observe(True, 1.0, 3)  # replay from the checkpoint...
+    g.observe(True, 1.0, 4)
+    assert g.observe(False, float("nan"), 5) == "rollback"  # re-fires
+    g.observe(True, 1.0, 3)
+    g.observe(True, 1.0, 4)
+    with pytest.raises(anomaly.AnomalyError, match="replays"):
+        g.observe(False, float("nan"), 5)
+    # progress past the anomalous step resets the streak
+    g2 = anomaly.AnomalyGuard(policy="rollback", max_consecutive=1)
+    assert g2.observe(False, float("nan"), 5) == "rollback"
+    g2.observe(True, 1.0, 5)  # replay got past it this time
+    assert g2.observe(False, float("nan"), 9) == "rollback"
+    assert g2.rollbacks == 2
+
+
+def test_guard_spike_threshold_arms_after_warmup():
+    import math
+
+    g = anomaly.AnomalyGuard(spike_factor=10.0, ema_decay=0.5,
+                             warmup_steps=3)
+    assert g.threshold() == math.inf
+    for i in range(3):
+        g.observe(True, 1.0, i)
+    assert g.threshold() == pytest.approx(10.0)
+    # EMA tracks healthy norms; anomalies must NOT move it
+    g.observe(True, 3.0, 3)
+    assert g.threshold() == pytest.approx(10.0 * 2.0)
+    ema_before = g._ema
+    g.observe(False, 1e9, 4)
+    assert g._ema == ema_before
+
+
+def test_guard_jit_predicate_and_norm():
+    import jax.numpy as jnp
+
+    nan, inf = float("nan"), float("inf")
+    assert bool(anomaly.health_ok(jnp.float32(1.0), jnp.float32(2.0),
+                                  jnp.float32(inf)))
+    assert not bool(anomaly.health_ok(jnp.float32(nan), jnp.float32(2.0),
+                                      jnp.float32(inf)))
+    assert not bool(anomaly.health_ok(jnp.float32(1.0), jnp.float32(nan),
+                                      jnp.float32(inf)))
+    assert not bool(anomaly.health_ok(jnp.float32(1.0), jnp.float32(5.0),
+                                      jnp.float32(4.0)))
+    tree = {"a": jnp.ones((2, 2)), "b": jnp.full((3,), 2.0)}
+    assert float(anomaly.global_norm(tree)) == pytest.approx(4.0)

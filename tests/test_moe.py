@@ -10,10 +10,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from bigdl_tpu.parallel import make_mesh, shard_params
 from bigdl_tpu.parallel.moe import MoE, moe_specs
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from bigdl_tpu.parallel.shard_map_compat import shard_map
 
 DIM, HID, EXPERTS = 16, 32, 8
 
