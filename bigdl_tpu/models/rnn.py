@@ -50,12 +50,16 @@ class _MeanOverTime(nn.Module):
 
 
 def bilstm_sentiment(vocab_size: int, embed_dim: int = 128,
-                     hidden_size: int = 128, class_num: int = 2) -> nn.Sequential:
+                     hidden_size: int = 128, class_num: int = 2,
+                     fused=None) -> nn.Sequential:
     """BiLSTM text classifier (reference: example/ sentiment BiRecurrent
-    config; BASELINE.md config 4)."""
+    config; BASELINE.md config 4). `fused` forwards to BiRecurrent —
+    None auto-selects the one-launch persistent Pallas scan on TPU
+    (ops/fused_rnn.py), False keeps the lax.scan path."""
     return nn.Sequential(
         nn.LookupTable(vocab_size, embed_dim).set_name("embedding"),
-        nn.BiRecurrent(nn.LSTM(embed_dim, hidden_size)).set_name("bilstm"),
+        nn.BiRecurrent(nn.LSTM(embed_dim, hidden_size),
+                       fused=fused).set_name("bilstm"),
         _MeanOverTime(),
         nn.Linear(2 * hidden_size, class_num).set_name("cls"),
         nn.LogSoftMax(),

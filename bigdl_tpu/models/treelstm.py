@@ -18,11 +18,26 @@ and the whole schedule runs as ONE `lax.scan` over node slots with
 `dynamic_index` gathers into the node-state buffer — static shapes,
 batched across trees, MXU-friendly fused gate matmuls.
 
+WAVEFRONT schedule (the default when the encoding carries node levels
+and `max_levels` is set): the slot scan above is `max_nodes` SEQUENTIAL
+steps of tiny (B, ·) gemms — the per-step dispatch/latency floor, not
+the MXU, binds (PROFILE_r04 roofline, same floor as the BiLSTM scan).
+But composition only depends on tree DEPTH: all leaves are ready at
+once, and every node whose children are done can compose together. So:
+leaves run as ONE hoisted (B·T, d) gemm, then a `lax.scan` over depth
+LEVELS (leaf=0, internal = 1+max(child levels)) composes every level-ℓ
+node of every tree in one batched (B·T, 2h) gemm + masked select —
+O(tree depth) sequential steps instead of O(max_nodes), each a full-
+width MXU matmul. Per-level flops rise (all slots compose, most are
+masked), but the recurrent path is latency-bound, not flop-bound — the
+trade is the point.
+
 Tree encoding per sample (all int32 arrays of length `max_nodes`):
     word    — token id for leaves, 0 for internal/pad
     left    — post-order index of left child (internal), -1 otherwise
     right   — likewise for the right child
     is_leaf — 1/0/;  mask — 1 for real nodes, 0 for padding
+    level   — wavefront depth: 0 for leaves, 1+max(children) internal
 Root is the LAST real node in post-order.
 """
 
@@ -40,15 +55,27 @@ from bigdl_tpu.nn.module import Module
 
 
 class BinaryTreeLSTM(Module):
-    """(reference: nn/BinaryTreeLSTM.scala — binary composer variant)"""
+    """(reference: nn/BinaryTreeLSTM.scala — binary composer variant)
+
+    `max_levels`: static wavefront-schedule depth bound. When set AND
+    the input batch carries a `level` array (6th input — emitted by
+    `encode_from_nested`), evaluation is level-batched: one hoisted
+    leaf gemm, then `max_levels - 1` compose steps (vs `max_nodes`
+    serial slot steps). Trees deeper than `max_levels - 1` levels are
+    NOT supported on that path — `encode_from_nested(...,
+    max_levels=...)` enforces the bound at encode time. Without
+    `max_levels` or without `level` input, the legacy serial-slot scan
+    runs (always correct, any depth)."""
 
     def __init__(self, vocab_size: int, embed_dim: int, hidden_size: int,
-                 class_num: int, name: Optional[str] = None):
+                 class_num: int, *, max_levels: Optional[int] = None,
+                 name: Optional[str] = None):
         super().__init__(name=name)
         self.vocab_size = vocab_size
         self.embed_dim = embed_dim
         self.hidden_size = hidden_size
         self.class_num = class_num
+        self.max_levels = max_levels
 
     def init_params(self, rng):
         ks = jax.random.split(rng, 4)
@@ -88,20 +115,36 @@ class BinaryTreeLSTM(Module):
 
     def apply(self, variables, inputs, training=False, rng=None):
         """inputs: dict/Table with word (N,T), left (N,T), right (N,T),
-        is_leaf (N,T), mask (N,T) — or the same five arrays as a tuple in
-        that order. Returns per-node log-probs (N, T, C) in ROOT-FIRST
-        order: node 0 is the tree root (TreeNNAccuracy's convention),
-        node t is the t-th node of REVERSED post-order; padding at the
-        end. Targets must use the same order (see roots_first)."""
+        is_leaf (N,T), mask (N,T) and optionally level (N,T) — or the
+        same arrays as a 5/6-tuple in that order. Returns per-node
+        log-probs (N, T, C) in ROOT-FIRST order: node 0 is the tree root
+        (TreeNNAccuracy's convention), node t is the t-th node of
+        REVERSED post-order; padding at the end. Targets must use the
+        same order (see roots_first)."""
         p = variables["params"]
+        level = None
         if isinstance(inputs, dict):
             word = inputs["word"]
             left = inputs["left"]
             right = inputs["right"]
             is_leaf = inputs["is_leaf"]
             mask = inputs["mask"]
+            level = inputs.get("level")
+        elif len(inputs) == 6:
+            word, left, right, is_leaf, mask, level = inputs
         else:
             word, left, right, is_leaf, mask = inputs
+
+        if level is not None and self.max_levels is not None:
+            h_buf = self._wavefront(p, word, left, right, is_leaf, mask,
+                                    level)
+        else:
+            h_buf = self._slot_scan(p, word, left, right, is_leaf, mask)
+        return self._emit_logits(p, h_buf, mask), variables["state"]
+
+    def _slot_scan(self, p, word, left, right, is_leaf, mask):
+        """Legacy schedule: one serial `lax.scan` step per post-order
+        node slot (any depth; the latency-floor-bound path)."""
         n_batch, t_nodes = word.shape
         h_dim = self.hidden_size
 
@@ -128,7 +171,50 @@ class BinaryTreeLSTM(Module):
 
         h0 = jnp.zeros((n_batch, t_nodes, h_dim))
         (h_buf, _), _ = lax.scan(body, (h0, h0), jnp.arange(t_nodes))
+        return h_buf
 
+    def _wavefront(self, p, word, left, right, is_leaf, mask, level):
+        """Wavefront schedule: all leaves in one hoisted gemm, then one
+        batched compose step per depth level — `max_levels - 1` serial
+        steps instead of `max_nodes`. Every slot runs the compose gemm
+        each level (full-width MXU matmul); the masked select keeps only
+        the slots whose level matches, so math is identical to the slot
+        scan (the equivalence test oracles one against the other)."""
+        n_batch, t_nodes = word.shape
+
+        emb = jnp.take(p["embedding"], word.astype(jnp.int32), axis=0)
+        leaf_h, leaf_c = self._leaf_step(p, emb)          # (N, T, H)
+        leaf_on = (is_leaf * mask).astype(bool)[..., None]
+        h_buf = jnp.where(leaf_on, leaf_h, 0.0)
+        c_buf = jnp.where(leaf_on, leaf_c, 0.0)
+
+        batch_idx = jnp.arange(n_batch)[:, None]
+        li = jnp.clip(left, 0, t_nodes - 1).astype(jnp.int32)
+        ri = jnp.clip(right, 0, t_nodes - 1).astype(jnp.int32)
+        compose_on = ((1 - is_leaf) * mask).astype(bool)
+
+        def body(carry, lvl):
+            h_buf, c_buf = carry
+            hl, cl = h_buf[batch_idx, li], c_buf[batch_idx, li]
+            hr, cr = h_buf[batch_idx, ri], c_buf[batch_idx, ri]
+            comp_h, comp_c = self._compose_step(p, hl, cl, hr, cr)
+            upd = (compose_on & (level == lvl))[..., None]
+            return (jnp.where(upd, comp_h, h_buf),
+                    jnp.where(upd, comp_c, c_buf)), None
+
+        (h_buf, _), _ = lax.scan(body, (h_buf, c_buf),
+                                 jnp.arange(1, self.max_levels))
+        # a tree deeper than the static bound would silently emit the
+        # zero-init h for every never-composed node (confidently wrong
+        # log-probs). Poison the whole buffer with NaN instead — the
+        # anomaly guard / loss checks catch NaN loudly, and
+        # encode_from_nested(max_levels=...) prevents it at encode time.
+        too_deep = jnp.any((level >= self.max_levels) & (mask == 1))
+        return jnp.where(too_deep, jnp.nan, h_buf)
+
+    def _emit_logits(self, p, h_buf, mask):
+        n_batch, t_nodes = mask.shape
+        batch_idx = jnp.arange(n_batch)
         # reorder to root-first (reversed post-order, padding at the end):
         # node 0 of the output is the root, matching TreeNNAccuracy
         n_nodes = jnp.sum(mask.astype(jnp.int32), axis=1)  # (N,)
@@ -143,7 +229,7 @@ class BinaryTreeLSTM(Module):
         # bias toward class 0 on every padding slot. Masked logits give a
         # constant uniform distribution with ZERO gradient to the params.
         logits = (h_out @ p["cls"]["weight"] + p["cls"]["bias"]) * out_mask
-        return jax.nn.log_softmax(logits, axis=-1), variables["state"]
+        return jax.nn.log_softmax(logits, axis=-1)
 
 
 # ----------------------------------------------------------- tree encoding
@@ -155,11 +241,16 @@ def roots_first(per_node: np.ndarray, n_nodes: int, pad=0) -> np.ndarray:
     return out
 
 
-def encode_from_nested(tree, max_nodes: int, word2id=None):
+def encode_from_nested(tree, max_nodes: int, word2id=None,
+                       max_levels: Optional[int] = None):
     """Encode a nested-list binary tree, e.g. ((("a", "b"), "c")) where
     leaves are tokens (str or int). Returns dict of int32 arrays of length
-    max_nodes: word/left/right/is_leaf/mask, plus n_nodes."""
-    word, left, right, is_leaf = [], [], [], []
+    max_nodes: word/left/right/is_leaf/mask/level, plus n_nodes and
+    n_levels (root level + 1 — the wavefront step count). `max_levels`
+    (optional) enforces the model's static wavefront bound at encode
+    time: a tree needing more levels raises here rather than silently
+    mis-evaluating on the level-batched path."""
+    word, left, right, is_leaf, level = [], [], [], [], []
 
     def rec(node):
         if not isinstance(node, (tuple, list)):
@@ -168,6 +259,7 @@ def encode_from_nested(tree, max_nodes: int, word2id=None):
             left.append(-1)
             right.append(-1)
             is_leaf.append(1)
+            level.append(0)
             return len(word) - 1
         l_idx = rec(node[0])
         r_idx = rec(node[1])
@@ -175,12 +267,17 @@ def encode_from_nested(tree, max_nodes: int, word2id=None):
         left.append(l_idx)
         right.append(r_idx)
         is_leaf.append(0)
+        level.append(1 + max(level[l_idx], level[r_idx]))
         return len(word) - 1
 
     rec(tree)
     n = len(word)
     if n > max_nodes:
         raise ValueError(f"tree has {n} nodes > max_nodes {max_nodes}")
+    n_levels = max(level) + 1
+    if max_levels is not None and n_levels > max_levels:
+        raise ValueError(
+            f"tree needs {n_levels} levels > max_levels {max_levels}")
 
     def pad(a, v=0):
         return np.asarray(a + [v] * (max_nodes - n), np.int32)
@@ -188,5 +285,6 @@ def encode_from_nested(tree, max_nodes: int, word2id=None):
     return {
         "word": pad(word), "left": pad(left, -1), "right": pad(right, -1),
         "is_leaf": pad(is_leaf), "mask": pad([1] * n),
-        "n_nodes": n,
+        "level": pad(level),
+        "n_nodes": n, "n_levels": n_levels,
     }

@@ -134,6 +134,19 @@ class LSTM(Cell):
         z = z_t + h_prev @ params["weight"][self.input_size:]
         return self._gates(z, c_prev)
 
+    # ---- persistent-kernel protocol (see Recurrent.apply) -----------
+    def fused_scan(self, params, zx, impl=None):
+        """Whole-sequence persistent Pallas scan over the hoisted feed
+        (ops/fused_rnn.py), or None when the shape/platform resolves to
+        the XLA fallback (the caller's lax.scan IS that fallback)."""
+        from bigdl_tpu.ops import fused_rnn
+
+        impl = fused_rnn.resolve_impl(self.hidden_size, impl)
+        if impl == "xla":
+            return None
+        return fused_rnn.lstm_scan(
+            zx, params["weight"][self.input_size:], impl=impl)
+
 
 class LSTMPeephole(Cell):
     """LSTM with peephole connections (reference: nn/LSTMPeephole.scala)."""
@@ -216,6 +229,19 @@ class GRU(Cell):
         h_new = (1.0 - z) * carry + z * cand
         return h_new, h_new
 
+    # ---- persistent-kernel protocol (see Recurrent.apply) -----------
+    def fused_scan(self, params, zx, impl=None):
+        from bigdl_tpu.ops import fused_rnn
+
+        impl = fused_rnn.resolve_impl(self.hidden_size, impl)
+        if impl == "xla":
+            return None
+        d, h = self.input_size, self.hidden_size
+        return fused_rnn.gru_scan(
+            zx[..., :2 * h], zx[..., 2 * h:],
+            params["gates"]["weight"][d:], params["cand"]["weight"][d:],
+            impl=impl)
+
 
 class Recurrent(Module):
     """Drive a cell across time with `lax.scan`
@@ -227,7 +253,7 @@ class Recurrent(Module):
 
     def __init__(self, cell: Optional[Cell] = None, return_state: bool = False,
                  unroll: int = 1, hoist_inputs: bool = True,
-                 name: Optional[str] = None):
+                 *, fused=None, name: Optional[str] = None):
         """`hoist_inputs` (default on): use the cell's hoisted-input
         protocol when it has one (precompute_inputs/step_precomputed) —
         the time-independent input projection leaves the scan as one
@@ -235,12 +261,21 @@ class Recurrent(Module):
         `unroll`: lax.scan unroll factor — measured SLOWER than 1 at
         the BASELINE BiLSTM shapes (PROFILE_r04 sweep: 8 and 16 both
         regressed); keep the default unless a new shape measures
-        otherwise."""
+        otherwise.
+        `fused`: persistent-kernel selection for cells with a
+        `fused_scan` protocol (LSTM/GRU) — the whole time loop runs in
+        ONE Pallas launch with the (h, c) carries VMEM-resident
+        (ops/fused_rnn.py) instead of one dispatch per lax.scan step.
+        None (default) = auto: kernel on TPU when the shape is
+        eligible, lax.scan otherwise; False = always lax.scan;
+        'pallas'/'interpret' force an impl (tests use 'interpret' on
+        CPU)."""
         super().__init__(name=name)
         self.cell = cell
         self.return_state = return_state
         self.unroll = unroll
         self.hoist_inputs = hoist_inputs
+        self.fused = fused
 
     def add(self, cell: Cell) -> "Recurrent":
         self._record_mutation("add", cell)
@@ -263,11 +298,23 @@ class Recurrent(Module):
             carry0 = self.cell.init_carry(x.shape[0])
         step_fn = self.cell.step
         feed = x
-        if (self.hoist_inputs
-                and hasattr(self.cell, "precompute_inputs")
-                and hasattr(self.cell, "step_precomputed")):
+        hoisted = (self.hoist_inputs
+                   and hasattr(self.cell, "precompute_inputs")
+                   and hasattr(self.cell, "step_precomputed"))
+        if hoisted:
             feed = self.cell.precompute_inputs(cell_params, x)
             step_fn = self.cell.step_precomputed
+            # persistent-kernel path: the whole time loop in one Pallas
+            # launch (cells' steps ignore rng/training, so the scan's
+            # per-step rng folding is not observable here). return_state
+            # needs the final (h, c) carry, which the kernel does not
+            # emit — that rare path keeps the lax.scan.
+            if (self.fused is not False and not self.return_state
+                    and hasattr(self.cell, "fused_scan")):
+                impl = self.fused if isinstance(self.fused, str) else None
+                out = self.cell.fused_scan(cell_params, feed, impl=impl)
+                if out is not None:
+                    return out, variables["state"]
         xs = jnp.swapaxes(feed, 0, 1)  # (T, N, ·) scan-major
         ts = jnp.arange(xs.shape[0])
 
@@ -294,16 +341,18 @@ class BiRecurrent(Module):
 
     def __init__(self, cell_fwd: Cell, cell_bwd: Optional[Cell] = None,
                  merge: str = "concat", unroll: int = 1,
-                 hoist_inputs: bool = True, name: Optional[str] = None):
+                 hoist_inputs: bool = True, *, fused=None,
+                 name: Optional[str] = None):
         super().__init__(name=name)
         import copy
 
         self.fwd = Recurrent(cell_fwd, unroll=unroll,
-                             hoist_inputs=hoist_inputs)
+                             hoist_inputs=hoist_inputs, fused=fused)
         self.bwd = Recurrent(cell_bwd if cell_bwd is not None
                              else copy.deepcopy(cell_fwd), unroll=unroll,
-                             hoist_inputs=hoist_inputs)
+                             hoist_inputs=hoist_inputs, fused=fused)
         self.merge = merge
+        self.fused = fused
 
     def init_params(self, rng):
         k1, k2 = jax.random.split(rng)
@@ -312,15 +361,46 @@ class BiRecurrent(Module):
     def init_state(self):
         return {}
 
+    def _fused_bidir(self, variables, x):
+        """One-launch bidirectional persistent kernel (both directions'
+        time loops in the same Pallas launch, reverse direction
+        time-mirrored via index maps — no jnp.flip HBM passes). Returns
+        (fwd_out, bwd_out) in true time order, or None off the kernel
+        path."""
+        if self.fused is False or not (self.fwd.hoist_inputs
+                                       and self.bwd.hoist_inputs):
+            return None
+        cf, cb = self.fwd.cell, self.bwd.cell
+        if not (isinstance(cf, LSTM) and isinstance(cb, LSTM)
+                and cf.hidden_size == cb.hidden_size
+                and cf.input_size == cb.input_size):
+            return None
+        from bigdl_tpu.ops import fused_rnn
+
+        impl = self.fused if isinstance(self.fused, str) else None
+        impl = fused_rnn.resolve_impl(cf.hidden_size, impl)
+        if impl == "xla":
+            return None
+        pf = variables["params"]["fwd"]["cell"]
+        pb = variables["params"]["bwd"]["cell"]
+        d = cf.input_size
+        return fused_rnn.bilstm_scan(
+            cf.precompute_inputs(pf, x), cb.precompute_inputs(pb, x),
+            pf["weight"][d:], pb["weight"][d:], impl=impl)
+
     def apply(self, variables, x, training=False, rng=None):
-        fwd_out, _ = self.fwd.apply(
-            {"params": variables["params"]["fwd"], "state": {}}, x,
-            training=training, rng=_fold_rng(rng, 0))
-        x_rev = jnp.flip(x, axis=1)
-        bwd_out, _ = self.bwd.apply(
-            {"params": variables["params"]["bwd"], "state": {}}, x_rev,
-            training=training, rng=_fold_rng(rng, 1))
-        bwd_out = jnp.flip(bwd_out, axis=1)
+        both = self._fused_bidir(variables, x)
+        if both is not None:
+            fwd_out, bwd_out = both
+        else:
+            fwd_out, _ = self.fwd.apply(
+                {"params": variables["params"]["fwd"], "state": {}}, x,
+                training=training, rng=_fold_rng(rng, 0))
+            x_rev = jnp.flip(x, axis=1)
+            bwd_out, _ = self.bwd.apply(
+                {"params": variables["params"]["bwd"], "state": {}},
+                x_rev, training=training, rng=_fold_rng(rng, 1))
+            bwd_out = jnp.flip(bwd_out, axis=1)
         if self.merge == "concat":
             out = jnp.concatenate([fwd_out, bwd_out], axis=-1)
         elif self.merge == "add":

@@ -12,9 +12,13 @@ from bigdl_tpu.ops.flash_attention import (
     flash_attention,
     flash_attention_with_lse,
 )
+from bigdl_tpu.ops.fused_rnn import bilstm_scan, gru_scan, lstm_scan
 
 __all__ = [
     "attention_reference",
+    "bilstm_scan",
     "flash_attention",
     "flash_attention_with_lse",
+    "gru_scan",
+    "lstm_scan",
 ]

@@ -1,6 +1,7 @@
 """Hardware validation — run on a real TPU (not CPU sim) to check the
 paths the CPU test suite can only exercise in interpret/simulation mode:
-the Pallas flash-attention kernel lowering, bf16 training numerics, and
+the Pallas flash-attention kernel lowering, the persistent-RNN fused
+scan kernels (fwd + custom_vjp backward), bf16 training numerics, and
 fenced throughput sanity. Usage: python scripts/validate_tpu.py"""
 
 import os
@@ -80,6 +81,29 @@ def main():
         params, slots, loss = step(params, slots, bx, by)
     assert np.isfinite(float(loss))
     print(f"bf16 train step ok, loss={float(loss):.4f}")
+
+    # --- persistent-RNN fused scan kernels lower and match ---
+    from bigdl_tpu.ops import fused_rnn
+
+    h = 128
+    zxf = jnp.asarray(0.2 * rng.randn(32, 64, 4 * h), jnp.float32)
+    zxb = jnp.asarray(0.2 * rng.randn(32, 64, 4 * h), jnp.float32)
+    wf = jnp.asarray(0.1 * rng.randn(h, 4 * h), jnp.float32)
+    wb = jnp.asarray(0.1 * rng.randn(h, 4 * h), jnp.float32)
+    yf, yb = jax.jit(lambda *a: fused_rnn.bilstm_scan(
+        *a, impl="pallas"))(zxf, zxb, wf, wb)
+    rf, rb = fused_rnn.bilstm_scan(zxf, zxb, wf, wb, impl="xla")
+    err_rnn = max(float(jnp.abs(yf - rf).max()),
+                  float(jnp.abs(yb - rb).max()))
+    print(f"fused bilstm pallas err={err_rnn:.4g}")
+    assert err_rnn < 1e-3, "fused RNN kernel diverges from lax.scan"
+    gk = jax.jit(jax.grad(lambda z: jnp.sum(fused_rnn.bilstm_scan(
+        z, zxb, wf, wb, impl="pallas")[0])))(zxf)
+    gr = jax.grad(lambda z: jnp.sum(
+        fused_rnn._lstm_scan_xla(z, wf)))(zxf)
+    err_g = float(jnp.abs(gk - gr).max())
+    print(f"fused bilstm pallas grad err={err_g:.4g}")
+    assert err_g < 1e-2, "fused RNN backward diverges"
 
     # --- int8 quantized path lowers on TPU ---
     lin = nn.Linear(256, 128)

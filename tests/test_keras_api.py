@@ -73,7 +73,11 @@ class TestFit:
         m.compile(optimizer="adam",
                   loss="sparse_categorical_crossentropy",
                   metrics=["accuracy"])
-        m.fit(xs[:192], ys[:192], batch_size=64, epochs=30,
+        # 60 epochs, not 30: at 30 the run is still mid-convergence and
+        # seed-sensitive (measured 0.86/0.62/0.91 across data seeds
+        # 0/1/2); at 60 every probed seed reaches 1.00, so the threshold
+        # tests convergence, not optimizer luck
+        m.fit(xs[:192], ys[:192], batch_size=64, epochs=60,
               validation_data=(xs[192:], ys[192:]))
         scores = m.evaluate(xs[192:], ys[192:])
         acc = scores["Top1Accuracy"]

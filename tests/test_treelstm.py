@@ -110,3 +110,94 @@ class TestBinaryTreeLSTM:
         g = jax.grad(loss)(variables["params"])
         assert float(jnp.abs(g["compose"]["weight"]).sum()) > 0
         assert float(jnp.abs(g["embedding"]).sum()) > 0
+
+
+# SST-style constituency parses: binary, mostly right-branching with
+# left-branching sub-phrases and varying depth — the shapes the
+# wavefront schedule must agree with the slot scan on
+SST_TREES = [
+    ((1, 2), (3, ((4, 5), (6, 7)))),
+    (1, (2, (3, (4, (5, 6))))),            # fully right-branching
+    (((((1, 2), 3), 4), 5), 6),            # fully left-branching
+    ((1, (2, 3)), ((4, 5), (6, (7, 8)))),
+    (1, 2),
+    ((2, 3), 9),
+]
+
+
+class TestWavefront:
+    """Level-batched (wavefront) schedule vs the roots-first serial
+    slot scan — must be numerically interchangeable."""
+
+    def _batch(self, max_nodes=16):
+        encs = [encode_from_nested(t, max_nodes) for t in SST_TREES]
+        stack = lambda k: jnp.asarray(np.stack([e[k] for e in encs]))
+        six = tuple(stack(k) for k in ("word", "left", "right",
+                                       "is_leaf", "mask", "level"))
+        max_lv = max(e["n_levels"] for e in encs)
+        return six, max_lv
+
+    def test_encoding_levels(self):
+        enc = encode_from_nested((1, (2, 3)), max_nodes=8)
+        # post-order: 1, 2, 3, (2,3), root
+        np.testing.assert_array_equal(enc["level"][:5], [0, 0, 0, 1, 2])
+        assert enc["n_levels"] == 3
+        with pytest.raises(ValueError, match="max_levels"):
+            encode_from_nested((1, (2, (3, 4))), 8, max_levels=2)
+
+    def test_forward_equivalence(self):
+        six, max_lv = self._batch()
+        legacy = BinaryTreeLSTM(20, 8, 8, 3).build(KEY).evaluate()
+        wave = BinaryTreeLSTM(20, 8, 8, 3, max_levels=max_lv)
+        out_legacy = legacy.forward(six[:5])
+        out_wave, _ = wave.apply(legacy.variables, six)
+        np.testing.assert_allclose(np.asarray(out_wave),
+                                   np.asarray(out_legacy),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_grad_equivalence(self):
+        six, max_lv = self._batch()
+        legacy = BinaryTreeLSTM(20, 8, 8, 3)
+        wave = BinaryTreeLSTM(20, 8, 8, 3, max_levels=max_lv)
+        v = legacy.init(KEY)
+
+        def loss(params, m, inp):
+            out, _ = m.apply({"params": params, "state": {}}, inp)
+            return jnp.sum(jnp.sin(out))
+
+        g1 = jax.grad(loss)(v["params"], legacy, six[:5])
+        g2 = jax.grad(loss)(v["params"], wave, six)
+        flat1 = jax.tree_util.tree_leaves(g1)
+        flat2 = jax.tree_util.tree_leaves(g2)
+        for a, b in zip(flat1, flat2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_five_tuple_falls_back_to_slot_scan(self):
+        six, max_lv = self._batch()
+        wave = BinaryTreeLSTM(20, 8, 8, 3,
+                              max_levels=max_lv).build(KEY).evaluate()
+        out5 = wave.forward(six[:5])        # no level → slot scan
+        out6 = wave.forward(six)            # wavefront
+        np.testing.assert_allclose(np.asarray(out5), np.asarray(out6),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_too_deep_tree_poisons_not_silently_wrong(self):
+        """A batch deeper than the model's static max_levels must fail
+        LOUDLY (NaN) — never emit confidently-wrong zeros for the
+        never-composed nodes."""
+        six, max_lv = self._batch()
+        shallow = BinaryTreeLSTM(20, 8, 8, 3,
+                                 max_levels=max_lv - 2).build(KEY)
+        out, _ = shallow.evaluate().apply(shallow.variables, six)
+        assert np.isnan(np.asarray(out)).any()
+
+    def test_dict_input_with_level(self):
+        six, max_lv = self._batch()
+        wave = BinaryTreeLSTM(20, 8, 8, 3,
+                              max_levels=max_lv).build(KEY).evaluate()
+        keys = ("word", "left", "right", "is_leaf", "mask", "level")
+        out_d = wave.forward(dict(zip(keys, six)))
+        out_t = wave.forward(six)
+        np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_t),
+                                   rtol=1e-6)
