@@ -433,6 +433,160 @@ class TransformerLM(Module):
         logits = x @ self.head(variables)
         return jax.nn.log_softmax(logits, axis=-1), variables["state"]
 
+    # ------------------------------------------------- incremental decode
+    # The serving plane (bigdl_tpu/serving/): a static-shape per-layer
+    # KV cache + a one-row decode step, so generating T tokens costs
+    # O(T·S) attention instead of the O(T·S²) of re-forwarding the whole
+    # sequence per token — and both steps compile exactly once (fixed
+    # max_len, position-indexed dynamic_update_slice writes; shared
+    # primitives in bigdl_tpu/ops/kv_cache.py).
+
+    def _serving_guard(self):
+        if self.sp_axis is not None or self.tp_axis is not None:
+            raise NotImplementedError(
+                "incremental decode runs single-mesh (no sp/tp axis); "
+                "build a plain TransformerLM for serving")
+        if self.cfg.moe_experts:
+            raise NotImplementedError(
+                "incremental decode for MoE FFNs (routing is per-token; "
+                "not wired yet)")
+        if not self.cfg.causal:
+            raise ValueError("incremental decode requires causal=True")
+
+    def init_cache(self, batch: int, max_len: Optional[int] = None,
+                   dtype=jnp.float32):
+        """Per-layer KV cache: a TUPLE of L dicts {'k','v'}, each
+        (B, H, S, D). Per-layer (not (L, ...)-stacked) on purpose:
+        decode unrolls the layer loop at trace time, and distinct
+        buffers let XLA stream each layer's cache in place — a stacked
+        cache pays a slice + re-stack copy of the whole thing every
+        step (measured on the weights: 148 → 46 ms/token at 43M CPU,
+        see serving_params). Batch-major so a serving engine splices
+        one request into slot `b` with one dynamic_update_slice per
+        layer. `dtype` may be bf16 (halves cache bytes; scores still
+        accumulate fp32)."""
+        from bigdl_tpu.ops.kv_cache import init_layer_cache
+
+        self._serving_guard()
+        c = self.cfg
+        s = c.max_len if max_len is None else max_len
+        if s > c.max_len:
+            raise ValueError(f"cache max_len {s} > positional table "
+                             f"{c.max_len}")
+        return tuple(
+            dict(zip(("k", "v"), init_layer_cache(
+                batch, c.num_heads, s, self.head_dim, dtype)))
+            for _ in range(c.num_layers))
+
+    def serving_params(self, variables):
+        """Repack the stacked (L, ...) training layout into per-layer
+        tuples — the fast serving layout. The training stack is what
+        makes lax.scan compile once and shard cleanly, but at decode
+        time XLA cannot hoist `blocks[l]` slices of a jit argument: it
+        copies every layer's weights out of the stack on every token
+        (43M CPU: 148 ms/token stacked vs 46 unstacked). One-time
+        O(params) repack; pass the result anywhere `variables` goes:
+        `model.prefill({"params": sp}, ...)`."""
+        p = variables["params"] if "params" in variables else variables
+        if isinstance(p["blocks"], (tuple, list)):
+            return p
+        out = dict(p)
+        out["blocks"] = tuple(
+            jax.tree_util.tree_map(lambda a: a[l], p["blocks"])
+            for l in range(self.cfg.num_layers))
+        return out
+
+    def _layer_blocks(self, p):
+        """Per-layer block params from either layout (tuple passthrough;
+        stacked → traced per-layer slices, correct but slow — use
+        serving_params for the hot path)."""
+        blocks = p["blocks"]
+        if isinstance(blocks, (tuple, list)):
+            return blocks
+        return tuple(jax.tree_util.tree_map(lambda a: a[l], blocks)
+                     for l in range(self.cfg.num_layers))
+
+    def _dense_ffn(self, y, bp):
+        y = jax.nn.gelu(y @ bp["w1"] + bp["b1"])
+        return y @ bp["w2"] + bp["b2"]
+
+    def prefill(self, variables, tokens, cache, lengths=None):
+        """Fill cache positions [0, S_p) from a right-padded prompt
+        batch tokens (B, S_p) and return (logits (B, V) of each row's
+        LAST REAL token, cache). `lengths` (B,) int32 — real prompt
+        lengths (default: all S_p). Causal attention makes positions
+        < length independent of the padding after them; the garbage
+        keys/values the pad positions write are never read (decode
+        masks beyond the row clock, then overwrites them in place)."""
+        from bigdl_tpu.ops.flash_attention import flash_attention
+        from bigdl_tpu.ops.kv_cache import write_prefill
+
+        self._serving_guard()
+        c = self.cfg
+        p = variables["params"] if "params" in variables else variables
+        bsz, s = tokens.shape
+        if lengths is None:
+            lengths = jnp.full((bsz,), s, jnp.int32)
+        d = self.head_dim
+        x = p["embed"][tokens] + p["pos"][:s]
+
+        new_cache = []
+        for bp, lc in zip(self._layer_blocks(p), cache):
+            y = self._ln(x, bp["ln1_g"], bp["ln1_b"])
+            q = (y @ bp["wq"] + bp["bq"]).reshape(
+                bsz, s, c.num_heads, d).transpose(0, 2, 1, 3)
+            k = (y @ bp["wk"] + bp["bk"]).reshape(
+                bsz, s, c.num_heads, d).transpose(0, 2, 1, 3)
+            v = (y @ bp["wv"] + bp["bv"]).reshape(
+                bsz, s, c.num_heads, d).transpose(0, 2, 1, 3)
+            new_cache.append(dict(zip(
+                ("k", "v"), write_prefill(lc["k"], lc["v"], k, v))))
+            a = flash_attention(q, k, v, causal=True, impl=self.attn_impl)
+            a = a.transpose(0, 2, 1, 3).reshape(bsz, s, c.num_heads * d)
+            x = x + a @ bp["wo"] + bp["bo"]
+            x = x + self._dense_ffn(
+                self._ln(x, bp["ln2_g"], bp["ln2_b"]), bp)
+
+        h = self._ln(x, p["lnf_g"], p["lnf_b"])
+        last = jnp.take_along_axis(
+            h, (lengths - 1)[:, None, None], axis=1)[:, 0]
+        return last @ self.head({"params": p}), tuple(new_cache)
+
+    def decode_step(self, variables, tokens, pos, cache):
+        """One incremental step: tokens (B,) int32 — the current token
+        per row — written at per-row clock `pos` (B,) int32, attended
+        against the cache. Returns (logits (B, V) predicting the NEXT
+        token, cache). O(S) per token; compiles once for a given cache
+        shape (the layer loop unrolls at trace time)."""
+        from bigdl_tpu.ops.kv_cache import cached_attention, update_cache
+
+        self._serving_guard()
+        c = self.cfg
+        p = variables["params"] if "params" in variables else variables
+        bsz = tokens.shape[0]
+        d = self.head_dim
+        x = p["embed"][tokens] + p["pos"][pos]    # (B, E)
+
+        new_cache = []
+        for bp, lc in zip(self._layer_blocks(p), cache):
+            y = self._ln(x, bp["ln1_g"], bp["ln1_b"])
+            q = (y @ bp["wq"] + bp["bq"]).reshape(
+                bsz, 1, c.num_heads, d).transpose(0, 2, 1, 3)
+            k = (y @ bp["wk"] + bp["bk"]).reshape(
+                bsz, 1, c.num_heads, d).transpose(0, 2, 1, 3)
+            v = (y @ bp["wv"] + bp["bv"]).reshape(
+                bsz, 1, c.num_heads, d).transpose(0, 2, 1, 3)
+            kc, vc = update_cache(lc["k"], lc["v"], k, v, pos)
+            new_cache.append({"k": kc, "v": vc})
+            a = cached_attention(q, kc, vc, pos)  # (B, H, 1, D)
+            a = a.transpose(0, 2, 1, 3).reshape(bsz, c.num_heads * d)
+            x = x + a @ bp["wo"] + bp["bo"]
+            x = x + self._dense_ffn(
+                self._ln(x, bp["ln2_g"], bp["ln2_b"]), bp)
+
+        h = self._ln(x, p["lnf_g"], p["lnf_b"])
+        return h @ self.head({"params": p}), tuple(new_cache)
+
 
 def build_lm(vocab_size: int = 256, dim: int = 128, num_heads: int = 4,
              num_layers: int = 2, max_len: int = 512,

@@ -129,3 +129,68 @@ class MultiHeadAttention(Module):
             mask = jax.random.bernoulli(rng, keep, y.shape)
             y = jnp.where(mask, y, 0.0) / keep
         return y, variables["state"]
+
+    # ------------------------------------------------- incremental decode
+    # KV-cache serving path (bigdl_tpu/ops/kv_cache.py): prefill writes
+    # the prompt's keys/values into a static-shape cache, decode attends
+    # one query row per step — O(S) per token. Self-attention only (the
+    # cross-attention K/V are prompt-static; cache them via
+    # apply_prefill on the encoder output if needed).
+
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
+        from bigdl_tpu.ops.kv_cache import init_layer_cache
+
+        k, v = init_layer_cache(batch, self.num_heads, max_len,
+                                self.head_dim, dtype)
+        return {"k": k, "v": v}
+
+    def apply_prefill(self, variables, x, cache):
+        """Causal self-attention over the prompt x (B, S, E) AND fill
+        cache positions [0, S). Returns (y (B, S, E), cache). Requires
+        `causal=True` (an incremental decode of a non-causal model is
+        not well-defined)."""
+        from bigdl_tpu.ops.flash_attention import flash_attention
+        from bigdl_tpu.ops.kv_cache import write_prefill
+
+        if not self.causal:
+            raise ValueError(f"{self.name}: incremental decode requires "
+                             "causal=True")
+        p = variables["params"]
+        b = (lambda k: p[k]) if self.with_bias else (lambda k: None)
+        q = self._proj(x, p["wq"], b("bq"))
+        k = self._proj(x, p["wk"], b("bk"))
+        v = self._proj(x, p["wv"], b("bv"))
+        cache = dict(zip(("k", "v"),
+                         write_prefill(cache["k"], cache["v"], k, v)))
+        out = flash_attention(q, k, v, causal=True, impl=self.impl)
+        batch, _, seq, _ = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(
+            batch, seq, self.num_heads * self.head_dim)
+        y = out @ p["wo"]
+        if self.with_bias:
+            y = y + p["bo"]
+        return y, cache
+
+    def apply_decode(self, variables, x, cache, pos):
+        """One decode step: x (B, E) — the current token's features —
+        writes its key/value at per-row positions `pos` (B,) int32 and
+        attends against the cache. Returns (y (B, E), cache)."""
+        from bigdl_tpu.ops.kv_cache import cached_attention, update_cache
+
+        if not self.causal:
+            raise ValueError(f"{self.name}: incremental decode requires "
+                             "causal=True")
+        p = variables["params"]
+        b = (lambda k: p[k]) if self.with_bias else (lambda k: None)
+        x3 = x[:, None, :]                       # (B, 1, E)
+        q = self._proj(x3, p["wq"], b("bq"))     # (B, H, 1, D)
+        k = self._proj(x3, p["wk"], b("bk"))
+        v = self._proj(x3, p["wv"], b("bv"))
+        kc, vc = update_cache(cache["k"], cache["v"], k, v, pos)
+        out = cached_attention(q, kc, vc, pos)   # (B, H, 1, D)
+        out = out.transpose(0, 2, 1, 3).reshape(
+            x.shape[0], self.num_heads * self.head_dim)
+        y = out @ p["wo"]
+        if self.with_bias:
+            y = y + p["bo"]
+        return y, {"k": kc, "v": vc}

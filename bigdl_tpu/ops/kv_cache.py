@@ -1,0 +1,97 @@
+"""KV-cache primitives for incremental (autoregressive) decode.
+
+No reference counterpart: the reference's inference surface is batch
+`Predictor.scala` (full forwards only). This is the serving-plane hot
+op: a static-shape per-layer key/value cache plus an O(S)-per-token
+attention read, so generating T tokens costs O(T·S) attention instead
+of the O(T·S²) a full re-forward per token pays. Everything here is
+shape-static — `max_len` is fixed at cache creation, writes are
+position-indexed `dynamic_update_slice`s — so prefill and decode each
+compile exactly once regardless of request lengths (the
+continuous-batching contract, bigdl_tpu/serving/engine.py).
+
+Layout: caches are (B, H, S, D) — batch-major so a serving engine can
+splice one request's rows into a slot with a single
+`dynamic_update_slice` and per-row positions stay independent
+(continuous batching: every slot advances its own clock).
+
+Numerics match bigdl_tpu/ops/flash_attention: fp32 score accumulation,
+masked logits at -1e30 (never -inf), softmax in fp32, output cast back
+to the value dtype. The cache may be held in bf16 (`dtype=` at
+creation) — scores still accumulate in fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def init_layer_cache(batch: int, num_heads: int, max_len: int,
+                     head_dim: int, dtype=jnp.float32
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """One layer's (k, v) cache, each (B, H, max_len, D), zero-filled.
+    Zeros are safe: reads mask every position > the row's clock."""
+    shape = (batch, num_heads, max_len, head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def write_prefill(k_cache: jax.Array, v_cache: jax.Array,
+                  k_new: jax.Array, v_new: jax.Array,
+                  start: int = 0) -> Tuple[jax.Array, jax.Array]:
+    """Bulk-write a prompt's (B, H, S_p, D) keys/values at [start,
+    start+S_p) — same offset for every row (prefill always lands a
+    fresh slot at position 0)."""
+    idx = (0, 0, start, 0)
+    k_cache = lax.dynamic_update_slice(k_cache, k_new.astype(k_cache.dtype), idx)
+    v_cache = lax.dynamic_update_slice(v_cache, v_new.astype(v_cache.dtype), idx)
+    return k_cache, v_cache
+
+
+def update_cache(k_cache: jax.Array, v_cache: jax.Array,
+                 k_new: jax.Array, v_new: jax.Array,
+                 pos: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Write one decode step's (B, H, 1, D) keys/values at per-row
+    positions `pos` (B,) int32. vmapped dynamic_update_slice → a
+    batched scatter; shape-static, so the decode step compiles once."""
+
+    def row(kc, vc, kn, vn, p):
+        idx = (0, p, 0)
+        return (lax.dynamic_update_slice(kc, kn.astype(kc.dtype), idx),
+                lax.dynamic_update_slice(vc, vn.astype(vc.dtype), idx))
+
+    return jax.vmap(row)(k_cache, v_cache, k_new, v_new, pos)
+
+
+def cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                     pos: jax.Array,
+                     sm_scale: Optional[float] = None) -> jax.Array:
+    """One query row per sequence against the cache: q (B, H, 1, D),
+    caches (B, H, S, D), pos (B,) — the row's clock, i.e. the index the
+    current token was just written at. Attends to positions <= pos
+    (earlier garbage beyond the clock is masked; later slots are
+    overwritten before ever becoming visible). Returns (B, H, 1, D).
+
+    O(S·D) per token — the decode-path replacement for the O(S²·D)
+    full-sequence attention."""
+    if q.shape[-2] != 1:
+        raise ValueError(f"cached_attention decodes one row, got q "
+                         f"length {q.shape[-2]}")
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    kf = k_cache.astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), kf) * sm_scale
+    seq = k_cache.shape[-2]
+    visible = (jnp.arange(seq)[None, :] <= pos[:, None])  # (B, S)
+    s = jnp.where(visible[:, None, None, :], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    probs = p / jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs,
+                     v_cache.astype(jnp.float32))
+    return out.astype(q.dtype)

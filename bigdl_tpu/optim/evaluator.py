@@ -103,27 +103,72 @@ class Evaluator:
 
 class Predictor:
     """Batch inference (reference: optim/Predictor.scala). `predict` yields
-    per-sample outputs; `predict_class` yields argmax ids."""
+    per-sample outputs; `predict_class` yields argmax ids.
 
-    def __init__(self, model: Module, batch_size: int = 32):
+    Shape-bucketed compile cache: a ragged batch is padded up (repeat
+    last real row, tail sliced off the output) to a shape the jitted
+    forward has already compiled, instead of presenting XLA a novel
+    shape — so a dataset whose size is not a batch multiple compiles
+    ONCE instead of once per ragged tail (the serving-plane
+    discipline, bigdl_tpu/serving/bucketing.py). By default the
+    bucket set is LEARNED: the first batch of a given size compiles
+    at that exact size, and later batches pad up to the smallest
+    already-compiled size that covers them — so a dataset of uniform
+    small batches never pays padding, while a ragged tail reuses the
+    full-batch executable. Pass `bucket_sizes` to pin an explicit
+    fixed bucket set instead (each bucket used compiles once).
+    `n_traces` counts compilations (the regression-test hook)."""
+
+    def __init__(self, model: Module, batch_size: int = 32,
+                 bucket_sizes: Optional[Sequence[int]] = None):
         self.model = model
         self.batch_size = batch_size
+        self.bucket_sizes = tuple(sorted(bucket_sizes)) \
+            if bucket_sizes else None
+        if self.bucket_sizes and max(self.bucket_sizes) < batch_size:
+            raise ValueError("largest bucket must cover batch_size")
+        self._learned: set = set()     # sizes already compiled (default mode)
+        self.n_traces = 0
+        self._fwd = None
+
+    def _jit_fwd(self):
+        # held on the instance so repeated predict() calls reuse the
+        # per-bucket executables instead of re-tracing
+        if self._fwd is None:
+            model = self.model
+
+            def fwd(params, state, bx):
+                self.n_traces += 1       # runs at trace time only
+                out, _ = model.apply({"params": params, "state": state},
+                                     bx, training=False)
+                return out
+
+            self._fwd = jax.jit(fwd)
+        return self._fwd
 
     def predict(self, dataset: AbstractDataSet) -> np.ndarray:
-        model = self.model
-        variables = model.variables
+        from bigdl_tpu.serving.bucketing import bucket_for, pad_rows
 
-        @jax.jit
-        def fwd(params, state, bx):
-            out, _ = model.apply({"params": params, "state": state}, bx,
-                                 training=False)
-            return out
-
+        variables = self.model.variables
+        fwd = self._jit_fwd()
         outs: List[np.ndarray] = []
         for mb in _batch_iterator(dataset, False, self.batch_size):
             real = getattr(mb, "real_size", mb.size)
+            if self.bucket_sizes:
+                # explicit buckets; pre-batched MiniBatches LARGER than
+                # every bucket run at their own shape (pad up only,
+                # never split)
+                rows = mb.size if mb.size > max(self.bucket_sizes) \
+                    else bucket_for(mb.size, self.bucket_sizes)
+            else:
+                # learned buckets: reuse the smallest compiled size
+                # that covers this batch; otherwise compile at the
+                # exact size (no padding for uniform-size streams)
+                rows = min((s for s in self._learned if s >= mb.size),
+                           default=mb.size)
+                self._learned.add(rows)
             out = np.asarray(fwd(variables["params"], variables["state"],
-                                 _to_device(mb.input)))
+                                 _to_device(pad_rows(mb.input, rows))))
             outs.append(out[:real])
         return np.concatenate(outs, axis=0)
 

@@ -1,0 +1,299 @@
+"""Continuous-batching inference engine — the TPU-native analog of
+BigDL 2.0's low-latency Cluster Serving (arXiv 2204.01715), built on
+the KV-cache incremental decode path (models/transformer.py
+prefill/decode_step, ops/kv_cache.py).
+
+Design
+------
+* **Fixed B cache slots.** The engine owns one KV cache — a per-layer
+  pytree of (B, H, max_len, D) leaves. A request occupies one slot from
+  prefill to finish; finished sequences are evicted and queued
+  requests spliced into free slots BETWEEN decode steps — admission
+  never changes any jitted shape.
+* **One decode executable, ever.** The decode step is a single jitted
+  function over all B slots; per-slot position, current token, PRNG
+  stream and sampling knobs (temperature/top-k/top-p) are (B,)
+  operands, and inactive slots simply compute garbage rows that the
+  host ignores (rows are independent: LN/matmul/attention are
+  per-row). Ragged traffic therefore triggers exactly
+  (#prefill buckets used) + 1 compilations — the compile-count guard
+  test pins this (tests/test_serving.py).
+* **Prefill buckets.** Prompts pad right to the nearest bucket
+  (serving/bucketing.py); causal attention makes real positions
+  independent of the pad, and the pad's cache garbage is never read
+  (decode masks beyond the row clock, then overwrites in place).
+  Prefill for ONE request compiles per bucket and splices its
+  batch-1 cache into the big cache with one batch-axis
+  dynamic_update_slice per leaf — admissions don't depend on how many
+  requests arrive together.
+* **First token via re-decode.** Prefill only fills the cache (its
+  head projection is dead code XLA eliminates). The slot then enters
+  the decode loop with current-token = last prompt token and clock =
+  len-1: the first decode step rewrites that position's k/v with
+  identical values and samples the first new token — every generated
+  token comes from the same executable, and no separate
+  sample-from-prefill path exists to compile or to drift.
+* **Per-request determinism.** Sampling keys are
+  fold_in(PRNGKey(request.seed), #generated) — a request's output is
+  bit-independent of its slot, its co-batch, and arrival order (the
+  batcher-equivalence property the tests assert).
+
+The engine is model-agnostic over anything exposing
+`init_cache(batch, max_len, dtype)` / `prefill(variables, tokens,
+cache, lengths)` / `decode_step(variables, tokens, pos, cache)` whose
+cache is a pytree of batch-leading leaves (and, optionally,
+`serving_params(variables)` for a fast weight layout).
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import warnings
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.serving.bucketing import (bucket_for, default_buckets,
+                                         pad_tokens)
+from bigdl_tpu.serving.sampler import sample_logits
+
+# process-wide trace tallies for the SHARED jitted steps below; an
+# engine snapshots them at creation and reports its own deltas
+_TRACES = {"prefill": 0, "decode": 0}
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3,))
+def _prefill_step(model, cache_dtype, params, cache, tokens, slot):
+    """Prefill ONE request (1, bucket) and splice it into slot `slot`:
+    one batch-axis dynamic_update_slice per cache leaf (the cache is
+    opaque — any per-layer pytree of batch-leading leaves works).
+    `model` is a static argument, so every engine over the same model
+    object shares one executable per bucket shape."""
+    _TRACES["prefill"] += 1               # runs at trace time only
+    small = model.init_cache(1, tokens.shape[1], cache_dtype)
+    _, small = model.prefill({"params": params}, tokens, small)
+    return jax.tree_util.tree_map(
+        lambda big, sm: lax.dynamic_update_slice(
+            big, sm, (slot,) + (0,) * (big.ndim - 1)),
+        cache, small)
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _decode_step(model, params, cache, tok, pos, seed, nout, temp,
+                 topk, topp):
+    """One decode step over all slots + per-row sampling. Shared across
+    engines of the same model (static arg) — ONE executable ever."""
+    _TRACES["decode"] += 1                # runs at trace time only
+    logits, cache = model.decode_step({"params": params}, tok, pos, cache)
+    keys = jax.vmap(lambda s, t: jax.random.fold_in(
+        jax.random.PRNGKey(s), t))(seed, nout)
+    nxt = sample_logits(logits, keys, temp, topk, topp)
+    return nxt, cache
+
+
+@dataclass
+class Request:
+    """One generation request. temperature <= 0 → greedy; top_k <= 0 /
+    top_p >= 1 → that filter off. `stop_ids`: generation ends when one
+    is sampled (the stop token is not emitted)."""
+    prompt: Sequence[int]
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    stop_ids: Sequence[int] = ()
+    seed: int = 0
+    id: Optional[int] = None
+
+
+@dataclass
+class GenerationResult:
+    id: int
+    prompt: List[int]
+    tokens: List[int]
+    finish_reason: str          # "stop_id" | "max_tokens" | "cache_full"
+
+
+class InferenceEngine:
+    """Continuous-batching engine over a fixed number of cache slots.
+
+    >>> eng = InferenceEngine(model, slots=4)
+    >>> eng.submit(Request(prompt=[1, 2, 3], max_new_tokens=16))
+    >>> results = eng.run()          # drain queue + slots
+
+    `stats` self-reports the zero-recompile contract:
+    prefill_traces == #distinct buckets used, decode_traces == 1.
+    """
+
+    def __init__(self, model, variables=None, slots: int = 4,
+                 max_len: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 cache_dtype=jnp.float32):
+        self.model = model
+        self.variables = variables if variables is not None \
+            else model.variables
+        # one-time repack into the per-layer serving layout (stacked
+        # weights pay a full-stack slice copy per decoded token)
+        self._params = model.serving_params(self.variables) \
+            if hasattr(model, "serving_params") \
+            else self.variables["params"]
+        self.slots = slots
+        self.cache_len = max_len if max_len is not None \
+            else model.cfg.max_len
+        self.cache_dtype = cache_dtype
+        self.cache = model.init_cache(slots, self.cache_len, cache_dtype)
+        self.buckets = tuple(sorted(
+            prefill_buckets if prefill_buckets is not None
+            else default_buckets(self.cache_len)))
+        if max(self.buckets) > self.cache_len:
+            raise ValueError(f"bucket {max(self.buckets)} exceeds cache "
+                             f"length {self.cache_len}")
+        self._stats: Dict[str, int] = {
+            "prefill_calls": 0, "decode_steps": 0, "requests_done": 0,
+        }
+        self._trace0 = dict(_TRACES)
+        # finished results not yet handed back by a run(requests=...)
+        # call — retrievable here (results are never silently dropped)
+        self.completed: Dict[int, GenerationResult] = {}
+        self._queue: deque = deque()
+        self._ids = itertools.count()
+        self._req: List[Optional[Request]] = [None] * slots
+        self._gen: List[List[int]] = [[] for _ in range(slots)]
+        self._pos = np.zeros(slots, np.int32)
+        self._tok = np.zeros(slots, np.int32)
+        self._nout = np.zeros(slots, np.int32)   # sampling-stream clock
+        self._seed = np.zeros(slots, np.int32)
+        self._temp = np.zeros(slots, np.float32)
+        self._topk = np.zeros(slots, np.int32)
+        self._topp = np.ones(slots, np.float32)
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        """Counters incl. this engine's trace (compile) deltas — an
+        engine built over a model another engine already served
+        reports 0 new traces (the executables are shared)."""
+        d = dict(self._stats)
+        d["prefill_traces"] = _TRACES["prefill"] - self._trace0["prefill"]
+        d["decode_traces"] = _TRACES["decode"] - self._trace0["decode"]
+        return d
+
+    # --------------------------------------------------------------- host
+    def submit(self, request: Request) -> int:
+        n = len(request.prompt)
+        if n == 0:
+            raise ValueError("empty prompt")
+        if request.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1 (the engine "
+                             "always samples at least one token)")
+        bucket_for(n, self.buckets)      # raises if no bucket fits
+        if request.id is None:
+            request.id = next(self._ids)
+        in_flight = {r.id for r in self._queue} \
+            | {r.id for r in self._req if r is not None} \
+            | set(self.completed)
+        if request.id in in_flight:
+            raise ValueError(f"request id {request.id} already in flight "
+                             "or completed-unclaimed")
+        self._queue.append(request)
+        return request.id
+
+    def _free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self._req) if r is None]
+
+    def _admit(self):
+        for slot in self._free_slots():
+            if not self._queue:
+                return
+            req = self._queue.popleft()
+            prompt = list(req.prompt)
+            b = bucket_for(len(prompt), self.buckets)
+            toks = pad_tokens(prompt, b)[None, :]          # (1, bucket)
+            with warnings.catch_warnings():
+                # donation is a per-call no-op warning on CPU backends;
+                # on TPU it aliases the cache update in place
+                warnings.filterwarnings(
+                    "ignore", message=".*[Dd]onat", category=UserWarning)
+                self.cache = _prefill_step(
+                    self.model, self.cache_dtype, self._params,
+                    self.cache, jnp.asarray(toks), np.int32(slot))
+            self._stats["prefill_calls"] += 1
+            self._req[slot] = req
+            self._gen[slot] = []
+            self._pos[slot] = len(prompt) - 1   # re-decode last prompt tok
+            self._tok[slot] = prompt[-1]
+            self._nout[slot] = 0
+            self._seed[slot] = req.seed
+            self._temp[slot] = req.temperature
+            self._topk[slot] = req.top_k
+            self._topp[slot] = req.top_p
+
+    def _finish(self, slot: int, reason: str) -> GenerationResult:
+        req = self._req[slot]
+        res = GenerationResult(req.id, list(req.prompt),
+                               self._gen[slot], reason)
+        self._req[slot] = None
+        self._gen[slot] = []
+        self._temp[slot] = 0.0
+        self._stats["requests_done"] += 1
+        return res
+
+    def step(self) -> List[GenerationResult]:
+        """Admit queued requests into free slots, run ONE decode step
+        over all slots, evict finished sequences. Returns the requests
+        that finished this step."""
+        self._admit()
+        if all(r is None for r in self._req):
+            return []
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message=".*[Dd]onat", category=UserWarning)
+            nxt, self.cache = _decode_step(
+                self.model, self._params, self.cache,
+                jnp.asarray(self._tok), jnp.asarray(self._pos),
+                jnp.asarray(self._seed), jnp.asarray(self._nout),
+                jnp.asarray(self._temp), jnp.asarray(self._topk),
+                jnp.asarray(self._topp))
+        self._stats["decode_steps"] += 1
+        nxt = np.asarray(nxt)
+        done = []
+        for i, req in enumerate(self._req):
+            if req is None:
+                continue
+            self._nout[i] += 1
+            tok = int(nxt[i])
+            if tok in req.stop_ids:
+                done.append(self._finish(i, "stop_id"))
+                continue
+            self._gen[i].append(tok)
+            if len(self._gen[i]) >= req.max_new_tokens:
+                done.append(self._finish(i, "max_tokens"))
+            elif self._pos[i] + 1 >= self.cache_len:
+                done.append(self._finish(i, "cache_full"))
+            else:
+                self._pos[i] += 1
+                self._tok[i] = tok
+        return done
+
+    def run(self, requests: Optional[Sequence[Request]] = None
+            ) -> List[GenerationResult]:
+        """Submit `requests` (if given), then step until queue and
+        slots drain. Returns `requests`' results in submission order
+        (or, with no argument, everything that finished, id order).
+        Results of OTHER requests that finished during the call —
+        e.g. queued earlier via submit() — land in `self.completed`,
+        never dropped."""
+        ids = [self.submit(r) for r in requests] if requests else None
+        while self._queue or any(r is not None for r in self._req):
+            for res in self.step():
+                self.completed[res.id] = res
+        if ids is None:
+            out = sorted(self.completed.values(), key=lambda r: r.id)
+            self.completed = {}
+            return out
+        return [self.completed.pop(i) for i in ids]

@@ -1,0 +1,89 @@
+"""Token sampling — greedy / temperature / top-k / top-p (nucleus).
+
+Everything is per-ROW arrays, not Python scalars: a continuous-batching
+step serves requests with different sampling configs in one launch, so
+temperature/top_k/top_p ride inside the jitted decode step as (B,)
+operands — changing a request's knobs never retraces
+(bigdl_tpu/serving/engine.py's zero-mid-stream-recompile contract).
+
+Conventions: temperature <= 0 → greedy (argmax); top_k <= 0 → top-k off;
+top_p >= 1 → nucleus off. Filters compose the standard way: top-k first,
+then top-p over the renormalized survivors, then categorical sampling
+via per-row Gumbel-max.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def filter_logits(logits: jax.Array, temperature: jax.Array,
+                  top_k: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Temperature-scale then mask logits (B, V) to the top-k / top-p
+    support per row; masked entries at -1e30. Exposed separately so
+    tests can assert the support set without sampling.
+
+    ONE sort total: softmax is order-preserving, so the descending
+    probabilities for the top-p prefix come from softmax of the sorted
+    logits — re-sorting probs would be a second O(V log V) pass per
+    token for nothing."""
+    v = logits.shape[-1]
+    lt = logits.astype(jnp.float32) / jnp.maximum(
+        temperature, 1e-6)[:, None]
+
+    desc = jnp.sort(lt, axis=-1)[:, ::-1]                      # (B, V)
+    # top-k: threshold at the k-th largest value per row
+    kth = jnp.take_along_axis(
+        desc, jnp.clip(top_k - 1, 0, v - 1)[:, None], axis=-1)  # (B, 1)
+    keep_k = (top_k[:, None] <= 0) | (lt >= kth)
+
+    # top-p over the top-k survivors: keep the smallest prefix of the
+    # descending-prob order whose mass reaches top_p — and ALWAYS the
+    # top-1, so a degenerate top_p <= 0 means "maximally greedy", not
+    # "all masked → uniform noise". Masked-by-k entries sort to the
+    # tail of `desc`, so zero their sorted probs before the cumsum
+    # instead of re-softmaxing. The cutoff is carried back to the
+    # unsorted row as a LOGIT threshold — desc holds exact copies of
+    # lt's values, so `lt >= thr_logit` is an exact comparison; a
+    # probability threshold would compare two independently computed
+    # softmaxes, whose ~1-ULP disagreement can empty the support.
+    desc_keep = (top_k[:, None] <= 0) | (desc >= kth)
+    sp = jnp.where(desc_keep, jax.nn.softmax(
+        jnp.where(desc_keep, desc, _NEG_INF), axis=-1), 0.0)
+    csum = jnp.cumsum(sp, axis=-1)
+    keep_sorted = ((csum - sp) < top_p[:, None]) \
+        | (jnp.arange(v)[None, :] == 0)
+    thr_logit = jnp.min(
+        jnp.where(keep_sorted & desc_keep, desc, jnp.inf), axis=-1)
+    return jnp.where(keep_k & (lt >= thr_logit[:, None]), lt, _NEG_INF)
+
+
+def sample_logits(logits: jax.Array, keys: jax.Array,
+                  temperature: jax.Array, top_k: jax.Array,
+                  top_p: jax.Array) -> jax.Array:
+    """Next-token ids (B,) int32. `keys`: per-row PRNG keys (B, 2) —
+    per-request streams, so a request samples identically whichever
+    slot or co-batch it lands in (the batcher-equivalence property).
+    Rows with temperature <= 0 take the plain argmax (untempered,
+    unfiltered — greedy ignores the knobs). When EVERY row is greedy,
+    a lax.cond skips the filter+Gumbel work entirely — greedy-only
+    decode steps pay only the argmax (~60 → ~0 ms/step at V=32k B=4
+    on CPU)."""
+    greedy = jnp.argmax(logits, axis=-1)
+
+    def sample_branch(_):
+        filt = filter_logits(logits, temperature, top_k, top_p)
+        gumbel = jax.vmap(
+            lambda k, row: -jnp.log(-jnp.log(
+                jax.random.uniform(k, row.shape, jnp.float32,
+                                   minval=1e-20, maxval=1.0))))(keys, filt)
+        sampled = jnp.argmax(filt + gumbel, axis=-1)
+        return jnp.where(temperature <= 0, greedy, sampled)
+
+    out = lax.cond(jnp.all(temperature <= 0),
+                   lambda _: greedy, sample_branch, operand=None)
+    return out.astype(jnp.int32)

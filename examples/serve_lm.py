@@ -1,0 +1,77 @@
+"""Serving the transformer LM — KV-cache decode + continuous batching.
+
+End-to-end demo of the inference serving plane (bigdl_tpu/serving/):
+a decoder-only LM is trained briefly on a synthetic next-token task,
+then served through `InferenceEngine` — ragged prompts, mixed sampling
+configs (greedy / temperature / top-k / top-p), per-request max-tokens
+and stop-ids, all batched through a fixed set of KV-cache slots. The
+engine's stats show the zero-recompile contract: one prefill compile
+per prompt bucket, ONE decode compile for all traffic.
+
+The BigDL-2.0 analog is Cluster Serving (arXiv 2204.01715) — there a
+Flink pipeline around a batch predictor; here the batching is
+continuous (finished sequences evicted and new requests spliced in
+between decode steps) because the XLA-side step is shape-static.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DataSet
+from bigdl_tpu.dataset.text import synthetic_next_token
+from bigdl_tpu.models import transformer
+from bigdl_tpu.optim import Adam, Optimizer, Trigger
+from bigdl_tpu.serving import InferenceEngine, Request
+
+VOCAB, SEQ = 64, 64
+
+
+def main():
+    # 1. train a small LM so generations aren't pure noise
+    model = transformer.build_lm(VOCAB, dim=64, num_heads=4,
+                                 num_layers=2, max_len=SEQ)
+    samples = synthetic_next_token(256, VOCAB, 32)
+    (Optimizer(model, DataSet.array(samples), nn.ChunkedSoftmaxCE(),
+               batch_size=32)
+     .set_optim_method(Adam(learningrate=3e-3))
+     .set_end_when(Trigger.max_epoch(3))
+     .optimize())
+
+    # 2. serve it: 4 cache slots, two prefill buckets
+    engine = InferenceEngine(model, slots=4, prefill_buckets=(8, 16))
+    requests = [
+        Request(prompt=[1, 2, 3], max_new_tokens=12),            # greedy
+        Request(prompt=list(range(2, 16)), max_new_tokens=12,
+                temperature=0.8, top_k=8, seed=1),
+        Request(prompt=[5, 6, 7, 8], max_new_tokens=12,
+                temperature=1.0, top_p=0.9, seed=2),
+        Request(prompt=[9, 10], max_new_tokens=24, stop_ids=(0,),
+                temperature=0.7, seed=3),
+        Request(prompt=list(range(1, 10)), max_new_tokens=12),
+        Request(prompt=[4] * 7, max_new_tokens=12, temperature=0.9,
+                seed=4),
+    ]
+    t0 = time.perf_counter()
+    results = engine.run(requests)
+    dt = time.perf_counter() - t0
+
+    total = 0
+    for r in results:
+        total += len(r.tokens)
+        print(f"req {r.id}: prompt[:6]={r.prompt[:6]} -> "
+              f"{r.tokens} ({r.finish_reason})")
+    print(f"\n{total} tokens across {len(results)} requests in "
+          f"{dt:.2f}s (includes compiles)")
+    print(f"engine stats: {engine.stats}")
+    assert engine.stats["decode_traces"] == 1
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""Predictor shape-bucketed compile cache: a dataset whose size is not
+a batch multiple must compile the forward ONCE (the ragged final batch
+pads to a bucket instead of presenting jit a novel shape)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DataSet, Sample
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.optim.evaluator import Predictor
+
+
+def _model():
+    m = nn.Sequential(nn.Linear(4, 3), nn.LogSoftMax())
+    m.build(jax.random.PRNGKey(0)).evaluate()
+    return m
+
+
+def _sample_ds(n):
+    rng = np.random.RandomState(0)
+    feats = rng.rand(n, 4).astype(np.float32)
+    samples = [Sample(feats[i], np.int32(rng.randint(3)))
+               for i in range(n)]
+    return DataSet.array(samples), feats
+
+
+def _ragged_minibatch_ds(n, batch):
+    """Datasets that yield MiniBatch objects directly skip
+    SampleToMiniBatch's padding — the final batch arrives RAGGED at
+    the Predictor (the shape that used to trigger a second compile)."""
+    rng = np.random.RandomState(1)
+    feats = rng.rand(n, 4).astype(np.float32)
+    mbs = [MiniBatch(feats[i:i + batch],
+                     rng.randint(0, 3, min(batch, n - i)).astype(np.int32))
+           for i in range(0, n, batch)]
+    assert mbs[-1].size < batch     # genuinely ragged tail
+    return DataSet.array(mbs), feats
+
+
+def test_single_compile_on_ragged_minibatches():
+    # 19 rows at batch 8 → MiniBatches of 8, 8, 3: the ragged 3-row
+    # tail pads to the 8-bucket instead of compiling a second forward
+    m = _model()
+    ds, feats = _ragged_minibatch_ds(19, 8)
+    pred = Predictor(m, batch_size=8)
+    out = pred.predict(ds)
+    assert out.shape == (19, 3)
+    assert pred.n_traces == 1, pred.n_traces
+    # padded rows are sliced off: outputs equal the direct forward
+    ref, _ = m.apply(m.variables, jnp.asarray(feats))
+    np.testing.assert_allclose(out, np.asarray(ref), atol=1e-6)
+
+
+def test_sample_dataset_still_single_compile():
+    m = _model()
+    ds, feats = _sample_ds(19)
+    pred = Predictor(m, batch_size=8)
+    out = pred.predict(ds)
+    assert out.shape == (19, 3)
+    assert pred.n_traces == 1
+    ref, _ = m.apply(m.variables, jnp.asarray(feats))
+    np.testing.assert_allclose(out, np.asarray(ref), atol=1e-6)
+
+
+def test_predict_class_consistent():
+    m = _model()
+    ds, _ = _sample_ds(13)
+    pred = Predictor(m, batch_size=8)
+    cls = pred.predict_class(ds)
+    assert cls.shape == (13,)
+    assert pred.n_traces == 1
+
+
+def test_explicit_bucket_sizes():
+    # buckets (4, 8): full batches hit 8, the 3-row ragged tail pads
+    # to 4 — two buckets used, two compiles, never a third
+    m = _model()
+    ds, feats = _ragged_minibatch_ds(19, 8)
+    pred = Predictor(m, batch_size=8, bucket_sizes=(4, 8))
+    out = pred.predict(ds)
+    assert pred.n_traces == 2
+    ref, _ = m.apply(m.variables, jnp.asarray(feats))
+    np.testing.assert_allclose(out, np.asarray(ref), atol=1e-6)
+    # a second pass reuses both executables
+    out2 = pred.predict(ds)
+    assert pred.n_traces == 2
+    np.testing.assert_allclose(out2, out, atol=0)
+
+
+def test_bucket_validation():
+    import pytest
+
+    with pytest.raises(ValueError, match="cover"):
+        Predictor(_model(), batch_size=8, bucket_sizes=(2, 4))
