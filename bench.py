@@ -902,6 +902,49 @@ def bench_lm_decode_batched(on_tpu, context=512, new_tokens=None,
         "decode_compiles": eng.stats["decode_traces"],
     }), flush=True)
 
+    # ---- degraded mode: SAME traffic shape under injected poison +
+    # overload (ISSUE 4) — the row reports GOODPUT (tokens of requests
+    # that finished 'done' per second) and how much load the
+    # reliability layer shed/evicted, with the policy knobs as
+    # provenance. Uses the same model → zero new compiles.
+    from bigdl_tpu.utils import faults
+
+    max_queue, policy, retries = 2 * slots, "shed-oldest", 1
+    eng2 = InferenceEngine(model, variables, slots=slots,
+                           max_len=max_len,
+                           prefill_buckets=(context // 2, context),
+                           max_queue=max_queue, overload_policy=policy,
+                           step_retries=retries, retry_backoff_s=0.0)
+    # 4x slots requests against a 2x-slots queue bound → half the
+    # backlog sheds; serve_nan poisons one in-flight row; serve_err is
+    # absorbed by the retry budget
+    faults.set_plan(faults.FaultPlan("serve_nan@3,serve_err@5"))
+    try:
+        t0 = time.perf_counter()
+        res2 = eng2.run(wave(200) + wave(300))
+        dt2 = time.perf_counter() - t0
+    finally:
+        faults.set_plan(None)
+    done = [r for r in res2 if r.status == "done"]
+    goodput = sum(len(r.tokens) for r in done)
+    print(json.dumps({
+        "metric": f"transformer_lm_43m_decode_batched_degraded_goodput"
+                  f"_tokens_per_sec[{platform}]",
+        "value": round(goodput / dt2, 2), "unit": "tokens/sec",
+        "vs_baseline": None,
+        "requests": len(res2), "requests_done": len(done),
+        "tokens_goodput": goodput,
+        "shed": eng2.stats["shed"], "poisoned": eng2.stats["poisoned"],
+        "retries": eng2.stats["retries"],
+        "deadline_misses": eng2.stats["deadline_misses"],
+        "injected_faults": "serve_nan@3,serve_err@5",
+        "overload_policy": policy, "max_queue": max_queue,
+        "step_retries": retries,
+        "cache_slots": slots, "cache_dtype": "fp32",
+        "prefill_compiles": eng2.stats["prefill_traces"],
+        "decode_compiles": eng2.stats["decode_traces"],
+    }), flush=True)
+
 
 def main(argv=None) -> None:
     import argparse
@@ -922,9 +965,21 @@ def main(argv=None) -> None:
                          "lmdecode_batched")
     args = ap.parse_args(argv)
 
-    import jax
+    # bounded backend probe: the axon tunnel's init can block forever
+    # (PROFILE_r07 lost the session to exactly this) — report "no
+    # backend" as a clean JSON line instead of hanging
+    from bigdl_tpu.utils.tpu_probe import default_timeout_s, probe_platform
 
-    platform = jax.devices()[0].platform
+    platform = probe_platform()
+    if platform is None:
+        print(json.dumps({
+            "error": "backend probe hung or errored",
+            "probe_timeout_s": default_timeout_s(),
+            "hint": "axon tunnel down? JAX_PLATFORMS=cpu runs the "
+                    "CPU rows; raise BIGDL_TPU_PROBE_TIMEOUT to wait "
+                    "longer"}), flush=True)
+        return
+
     on_tpu = platform == "tpu"
 
     from bigdl_tpu.models import inception, lenet, resnet, vgg

@@ -557,7 +557,17 @@ class TransformerLM(Module):
         per row — written at per-row clock `pos` (B,) int32, attended
         against the cache. Returns (logits (B, V) predicting the NEXT
         token, cache). O(S) per token; compiles once for a given cache
-        shape (the layer loop unrolls at trace time)."""
+        shape (the layer loop unrolls at trace time).
+
+        Reliability contract (serving/engine.py poison isolation):
+        every op in this step is per-ROW — embedding lookup, LN,
+        per-row cache write, masked cached_attention, gemv — so a
+        non-finite row contaminates only its own logits and cache
+        rows. The serving engine reduces the returned logits to a (B,)
+        finite flag inside its jitted wrapper (utils/anomaly
+        .rows_finite) and evicts only the poisoned request; masked
+        stale rows in a recycled slot cannot leak because
+        cached_attention nan-scrubs invisible value rows."""
         from bigdl_tpu.ops.kv_cache import cached_attention, update_cache
 
         self._serving_guard()

@@ -88,10 +88,20 @@ def cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), kf) * sm_scale
     seq = k_cache.shape[-2]
     visible = (jnp.arange(seq)[None, :] <= pos[:, None])  # (B, S)
+    # the where AFTER the matmul also launders NaN scores a non-finite
+    # masked KEY row would produce (poison hygiene, see below)
     s = jnp.where(visible[:, None, None, :], s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     probs = p / jnp.sum(p, axis=-1, keepdims=True)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs,
-                     v_cache.astype(jnp.float32))
+    # masked positions get probability exactly 0.0, but 0.0 * NaN = NaN:
+    # a non-finite VALUE row beyond the clock (a poisoned request's
+    # leftovers in a recycled slot — serving/engine.py poison
+    # isolation) would leak into every later read of that slot unless
+    # masked rows are zeroed before the weighted sum. Zeros leave
+    # healthy traffic bit-identical (0-prob rows contributed 0 either
+    # way); visible rows are untouched.
+    vf = jnp.where(visible[:, None, :, None],
+                   v_cache.astype(jnp.float32), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vf)
     return out.astype(q.dtype)

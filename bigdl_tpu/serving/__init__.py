@@ -2,14 +2,18 @@
 batching (the TPU-native analog of BigDL 2.0's Cluster Serving; see
 engine.py for the design contract)."""
 
-from bigdl_tpu.serving.bucketing import (bucket_for, default_buckets,
-                                         pad_rows, pad_tokens)
-from bigdl_tpu.serving.engine import (GenerationResult, InferenceEngine,
-                                      Request)
+from bigdl_tpu.serving.bucketing import (bucket_for, bucket_histogram,
+                                         default_buckets, pad_rows,
+                                         pad_tokens)
+from bigdl_tpu.serving.engine import (STATUSES, EngineDegraded,
+                                      GenerationResult, InferenceEngine,
+                                      OverloadError, Request, StepTimeout)
 from bigdl_tpu.serving.sampler import filter_logits, sample_logits
 
 __all__ = [
-    "InferenceEngine", "Request", "GenerationResult",
+    "InferenceEngine", "Request", "GenerationResult", "STATUSES",
+    "OverloadError", "StepTimeout", "EngineDegraded",
     "sample_logits", "filter_logits",
-    "bucket_for", "default_buckets", "pad_tokens", "pad_rows",
+    "bucket_for", "bucket_histogram", "default_buckets", "pad_tokens",
+    "pad_rows",
 ]

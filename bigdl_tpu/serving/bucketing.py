@@ -9,7 +9,7 @@ Each bucket compiles once; traffic after warmup compiles never.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Dict, Sequence, Tuple
 
 import numpy as np
 
@@ -32,6 +32,17 @@ def bucket_for(n: int, buckets: Sequence[int]) -> int:
             return b
     raise ValueError(f"length {n} exceeds largest bucket "
                      f"{max(buckets)}")
+
+
+def bucket_histogram(lengths: Sequence[int],
+                     buckets: Sequence[int]) -> Dict[int, int]:
+    """{bucket: count} over `lengths` (zero-count buckets included) —
+    the queue-composition line of the serving engine's health
+    snapshot: which prefill executables the backlog will exercise."""
+    out = {b: 0 for b in sorted(buckets)}
+    for n in lengths:
+        out[bucket_for(n, buckets)] += 1
+    return out
 
 
 def pad_tokens(tokens: Sequence[int], bucket: int,

@@ -12,10 +12,10 @@ Design
   never changes any jitted shape.
 * **One decode executable, ever.** The decode step is a single jitted
   function over all B slots; per-slot position, current token, PRNG
-  stream and sampling knobs (temperature/top-k/top-p) are (B,)
-  operands, and inactive slots simply compute garbage rows that the
-  host ignores (rows are independent: LN/matmul/attention are
-  per-row). Ragged traffic therefore triggers exactly
+  stream, sampling knobs (temperature/top-k/top-p) and the poison
+  operand are (B,) operands, and inactive slots simply compute garbage
+  rows that the host ignores (rows are independent: LN/matmul/attention
+  are per-row). Ragged traffic therefore triggers exactly
   (#prefill buckets used) + 1 compilations — the compile-count guard
   test pins this (tests/test_serving.py).
 * **Prefill buckets.** Prompts pad right to the nearest bucket
@@ -38,6 +38,46 @@ Design
   bit-independent of its slot, its co-batch, and arrival order (the
   batcher-equivalence property the tests assert).
 
+Reliability layer (the BigDL contract — arXiv 1804.05839: jobs survive
+task failures and stragglers instead of crashing — carried into the
+serving plane; every behavior below is deterministically fault-drilled
+via utils/faults serving kinds and scripts/fault_drill.py --plane
+serving):
+
+* **Request lifecycle.** Every request ends in exactly one terminal
+  status — ``done`` / ``shed`` / ``expired`` / ``poisoned`` /
+  ``failed`` (`GenerationResult.status`). Per-request deadlines
+  (`Request.deadline_s`, a TTL from submission enforced both queued and
+  decoding), max queue wait (`Request.max_queue_wait_s`), host-side
+  cancellation (`cancel()`), and bounded retry-with-backoff for
+  transient decode-step failures (`step_retries`/`retry_backoff_s`).
+  Deadlines are measured against an injectable `clock` so the expiry
+  drills are bit-deterministic.
+* **Admission control & backpressure.** `max_queue` bounds the queue;
+  on overload the `overload_policy` decides: ``reject`` (submit raises
+  OverloadError), ``shed-oldest`` (evict the longest-queued request
+  with status ``shed``), or ``shed-lowest-priority`` (evict the
+  lowest-`Request.priority` queued request — or the new request itself
+  if it is lowest). Admission into free slots is highest-priority
+  first, FIFO within a priority.
+* **Poison isolation.** The decode step returns a (B,) finite-logits
+  health operand (utils/anomaly.rows_finite — one jit-side reduction,
+  fetched alongside the token, no extra host sync). A NaN/inf row
+  evicts ONLY that request with status ``poisoned``; co-batched rows
+  are untouched (rows are independent) and their outputs stay
+  bit-identical to running alone. The poisoned slot's cache rows are
+  scrubbed to zero before reuse, and ops/kv_cache.cached_attention
+  nan-scrubs masked value rows, so a genuinely non-finite request can
+  never leak NaN into the slot's next occupant.
+* **Step watchdog.** `step_timeout_s` arms a wall-clock budget over
+  decode dispatch+fetch (the work runs on a daemon thread; a hung
+  device call — the axon-tunnel failure mode, PROFILE_r07 — becomes a
+  StepTimeout instead of a wedged host). A trip degrades the engine:
+  in-flight AND queued requests fail with status ``failed``, the
+  engine quiesces (submit raises EngineDegraded), and `health()`
+  surfaces the snapshot: slot occupancy, queue depth/buckets, p50/p95
+  decode latency, deadline misses, sheds, retries, watchdog trips.
+
 The engine is model-agnostic over anything exposing
 `init_cache(batch, max_len, dtype)` / `prefill(variables, tokens,
 cache, lengths)` / `decode_step(variables, tokens, pos, cache)` whose
@@ -49,23 +89,56 @@ from __future__ import annotations
 
 import functools
 import itertools
+import logging
+import math
+import threading
+import time
 import warnings
 from collections import deque
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from bigdl_tpu.serving.bucketing import (bucket_for, default_buckets,
-                                         pad_tokens)
+from bigdl_tpu.serving.bucketing import (bucket_for, bucket_histogram,
+                                         default_buckets, pad_tokens)
 from bigdl_tpu.serving.sampler import sample_logits
+from bigdl_tpu.utils import faults
+from bigdl_tpu.utils.anomaly import rows_finite
+
+logger = logging.getLogger("bigdl_tpu.serving")
+
+# terminal request statuses (GenerationResult.status)
+STATUSES = ("done", "shed", "expired", "poisoned", "failed")
+
+OVERLOAD_POLICIES = ("reject", "shed-oldest", "shed-lowest-priority")
+
+# which stats counter each terminal status bumps
+_STATUS_COUNTER = {"done": "requests_done", "shed": "shed",
+                   "expired": "deadline_misses", "poisoned": "poisoned",
+                   "failed": "failed"}
 
 # process-wide trace tallies for the SHARED jitted steps below; an
 # engine snapshots them at creation and reports its own deltas
 _TRACES = {"prefill": 0, "decode": 0}
+
+
+class OverloadError(RuntimeError):
+    """submit() under overload_policy='reject' with a full queue."""
+
+
+class StepTimeout(RuntimeError):
+    """Decode dispatch+fetch exceeded the watchdog budget (the hung
+    remote-device model — the axon tunnel blocking indefinitely)."""
+
+
+class EngineDegraded(RuntimeError):
+    """The engine quiesced after a watchdog trip or exhausted step
+    retries; build a fresh engine (executables are shared, so the
+    replacement pays no recompile)."""
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3,))
@@ -86,22 +159,36 @@ def _prefill_step(model, cache_dtype, params, cache, tokens, slot):
 
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
 def _decode_step(model, params, cache, tok, pos, seed, nout, temp,
-                 topk, topp):
-    """One decode step over all slots + per-row sampling. Shared across
-    engines of the same model (static arg) — ONE executable ever."""
+                 topk, topp, poison):
+    """One decode step over all slots + per-row sampling + per-row
+    finite-logits health. Shared across engines of the same model
+    (static arg) — ONE executable ever. `poison` (B,) bool is the
+    serve_nan injection operand: a True row's logits are forced to NaN
+    INSIDE the jitted step, so the drill exercises the same health
+    reduction and eviction path a genuinely non-finite request would —
+    and, being a (B,) operand, arming it never retraces."""
     _TRACES["decode"] += 1                # runs at trace time only
     logits, cache = model.decode_step({"params": params}, tok, pos, cache)
+    logits = jnp.where(poison[:, None], jnp.float32(jnp.nan), logits)
+    finite = rows_finite(logits)
     keys = jax.vmap(lambda s, t: jax.random.fold_in(
         jax.random.PRNGKey(s), t))(seed, nout)
     nxt = sample_logits(logits, keys, temp, topk, topp)
-    return nxt, cache
+    return nxt, finite, cache
 
 
 @dataclass
 class Request:
     """One generation request. temperature <= 0 → greedy; top_k <= 0 /
     top_p >= 1 → that filter off. `stop_ids`: generation ends when one
-    is sampled (the stop token is not emitted)."""
+    is sampled (the stop token is not emitted).
+
+    Reliability knobs (all host-side — none changes a jitted shape):
+    `priority` — higher admits first and survives
+    shed-lowest-priority overload; `deadline_s` — TTL in clock seconds
+    from submission, enforced while queued AND while decoding (expiry
+    → status 'expired', partial tokens kept); `max_queue_wait_s` —
+    tighter bound on time spent queued only."""
     prompt: Sequence[int]
     max_new_tokens: int = 32
     temperature: float = 0.0
@@ -110,14 +197,25 @@ class Request:
     stop_ids: Sequence[int] = ()
     seed: int = 0
     id: Optional[int] = None
+    priority: int = 0
+    deadline_s: Optional[float] = None
+    max_queue_wait_s: Optional[float] = None
 
 
 @dataclass
 class GenerationResult:
+    """`status` is the terminal lifecycle state (one of STATUSES):
+    'done' (finish_reason: "stop_id" | "max_tokens" | "cache_full"),
+    'shed' (overload victim or cancelled — finish_reason "shed" /
+    "cancelled"), 'expired' (deadline or queue-wait TTL), 'poisoned'
+    (non-finite logits row), 'failed' (engine degraded mid-request).
+    Non-done results keep whatever tokens were generated before the
+    terminal event."""
     id: int
     prompt: List[int]
     tokens: List[int]
-    finish_reason: str          # "stop_id" | "max_tokens" | "cache_full"
+    finish_reason: str
+    status: str = "done"
 
 
 class InferenceEngine:
@@ -129,12 +227,25 @@ class InferenceEngine:
 
     `stats` self-reports the zero-recompile contract:
     prefill_traces == #distinct buckets used, decode_traces == 1.
-    """
+    `health()` is the operational snapshot (state, occupancy, queue,
+    latency percentiles, reliability counters).
+
+    Reliability knobs: `max_queue` + `overload_policy` (admission
+    control), `step_timeout_s` (watchdog over dispatch+fetch),
+    `step_retries`/`retry_backoff_s` (transient step failures),
+    `clock` (monotonic-seconds source for deadlines — injectable so
+    expiry drills are bit-deterministic)."""
 
     def __init__(self, model, variables=None, slots: int = 4,
                  max_len: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
-                 cache_dtype=jnp.float32):
+                 cache_dtype=jnp.float32,
+                 max_queue: Optional[int] = None,
+                 overload_policy: str = "reject",
+                 step_timeout_s: Optional[float] = None,
+                 step_retries: int = 0,
+                 retry_backoff_s: float = 0.05,
+                 clock: Callable[[], float] = time.monotonic):
         self.model = model
         self.variables = variables if variables is not None \
             else model.variables
@@ -154,8 +265,24 @@ class InferenceEngine:
         if max(self.buckets) > self.cache_len:
             raise ValueError(f"bucket {max(self.buckets)} exceeds cache "
                              f"length {self.cache_len}")
+        if overload_policy not in OVERLOAD_POLICIES:
+            raise ValueError(f"overload_policy {overload_policy!r}: "
+                             f"expected one of {OVERLOAD_POLICIES}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError("max_queue must be >= 1 (or None)")
+        if step_retries < 0:
+            raise ValueError("step_retries must be >= 0")
+        self.max_queue = max_queue
+        self.overload_policy = overload_policy
+        self.step_timeout_s = step_timeout_s
+        self.step_retries = step_retries
+        self.retry_backoff_s = retry_backoff_s
+        self._clock = clock
         self._stats: Dict[str, int] = {
             "prefill_calls": 0, "decode_steps": 0, "requests_done": 0,
+            "shed": 0, "rejected": 0, "deadline_misses": 0,
+            "poisoned": 0, "failed": 0, "retries": 0,
+            "watchdog_trips": 0, "cancelled": 0,
         }
         self._trace0 = dict(_TRACES)
         # finished results not yet handed back by a run(requests=...)
@@ -172,6 +299,20 @@ class InferenceEngine:
         self._temp = np.zeros(slots, np.float32)
         self._topk = np.zeros(slots, np.int32)
         self._topp = np.ones(slots, np.float32)
+        self._meta: Dict[int, Dict[str, float]] = {}  # id → submit time
+        self._lat: deque = deque(maxlen=256)     # recent step seconds
+        self._degraded: Optional[str] = None
+        if step_timeout_s is not None:
+            # arming the watchdog opts into a warmup decode at
+            # construction: the FIRST decode call traces+compiles
+            # (minutes through the remote tunnel), which would trip
+            # any sane steady-state budget and permanently degrade a
+            # healthy engine. The warmup runs unguarded — bounding
+            # backend/compile init is utils/tpu_probe's job. Inactive
+            # slots compute garbage the host ignores, and every slot
+            # is prefilled (position 0 rewritten) before it decodes.
+            self._dispatch_and_fetch(np.zeros(slots, bool), 0.0,
+                                     watchdog=False)
 
     @property
     def stats(self) -> Dict[str, int]:
@@ -183,34 +324,174 @@ class InferenceEngine:
         d["decode_traces"] = _TRACES["decode"] - self._trace0["decode"]
         return d
 
+    @property
+    def degraded(self) -> Optional[str]:
+        """None while healthy, else the degradation reason."""
+        return self._degraded
+
+    def health(self) -> Dict[str, object]:
+        """Operational snapshot: engine state, slot occupancy, queue
+        depth + per-bucket composition, p50/p95 decode-step latency
+        (over the last 256 steps), and every reliability counter."""
+        lat = sorted(self._lat)
+
+        def pct(q):
+            if not lat:
+                return None
+            return round(lat[min(len(lat) - 1, int(q * len(lat)))]
+                         * 1e3, 3)
+
+        s = self._stats
+        return {
+            "state": "degraded" if self._degraded else "ok",
+            "degraded_reason": self._degraded,
+            "slots": self.slots,
+            "slots_active": sum(r is not None for r in self._req),
+            "queue_depth": len(self._queue),
+            "queue_buckets": bucket_histogram(
+                [len(r.prompt) for r in self._queue], self.buckets),
+            "decode_p50_ms": pct(0.50),
+            "decode_p95_ms": pct(0.95),
+            "deadline_misses": s["deadline_misses"], "shed": s["shed"],
+            "rejected": s["rejected"], "poisoned": s["poisoned"],
+            "retries": s["retries"],
+            "watchdog_trips": s["watchdog_trips"],
+            "failed": s["failed"], "cancelled": s["cancelled"],
+            "requests_done": s["requests_done"],
+            "decode_steps": s["decode_steps"],
+        }
+
     # --------------------------------------------------------------- host
     def submit(self, request: Request) -> int:
         n = len(request.prompt)
+        if self._degraded:
+            raise EngineDegraded(
+                f"engine degraded ({self._degraded}); build a fresh "
+                "engine — same-model executables are shared, so the "
+                "replacement pays no recompile")
         if n == 0:
             raise ValueError("empty prompt")
         if request.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the engine "
                              "always samples at least one token)")
         bucket_for(n, self.buckets)      # raises if no bucket fits
-        if request.id is None:
-            request.id = next(self._ids)
+        # duplicate-id guard scans the queue, OCCUPIED SLOTS, and
+        # unclaimed results — a resubmitted in-flight id must never be
+        # accepted (it would collide in `completed`)
         in_flight = {r.id for r in self._queue} \
             | {r.id for r in self._req if r is not None} \
             | set(self.completed)
-        if request.id in in_flight:
+        if request.id is None:
+            rid = next(self._ids)
+            while rid in in_flight:      # user-chosen ids may have
+                rid = next(self._ids)    # claimed counter values
+            request.id = rid
+        elif request.id in in_flight:
             raise ValueError(f"request id {request.id} already in flight "
                              "or completed-unclaimed")
+        # expire stale queued requests BEFORE the overload check: a
+        # queue full of already-dead TTLs must not reject (or shed a
+        # victim from) fresh traffic — and the dead ones must report
+        # 'expired', not 'shed'
+        self._expire_queued(self._clock())
+        if self.max_queue is not None \
+                and len(self._queue) >= self.max_queue:
+            self._overload(request)
+            if request.id in self.completed:     # new request was shed
+                return request.id
+        self._meta[request.id] = {"t": self._clock()}
         self._queue.append(request)
         return request.id
+
+    def _overload(self, request: Request) -> None:
+        """Queue at max_queue: apply the overload policy. Either raises
+        (reject), sheds a queued victim (making room), or sheds
+        `request` itself (shed-lowest-priority when it IS the lowest —
+        its result lands in `completed` and submit returns its id)."""
+        if self.overload_policy == "reject":
+            self._stats["rejected"] += 1
+            raise OverloadError(
+                f"queue full ({self.max_queue}); request {request.id} "
+                "rejected (overload_policy='reject')")
+        if self.overload_policy == "shed-lowest-priority":
+            victim = min(self._queue, key=lambda r: r.priority)
+            if request.priority <= victim.priority:
+                # the new arrival is (joint-)lowest — shed it instead
+                self._terminal(request, "shed", "shed")
+                return
+            self._queue.remove(victim)
+        else:                                     # shed-oldest
+            victim = self._queue.popleft()
+        self._terminal(victim, "shed", "shed")
+
+    def cancel(self, request_id: int) -> GenerationResult:
+        """Cancel a queued or in-flight request (host-side, between
+        steps). The result (status 'shed', finish_reason 'cancelled',
+        partial tokens if it was decoding) lands in `completed` and is
+        returned. KeyError if the id is not queued or in flight."""
+        for r in self._queue:
+            if r.id == request_id:
+                self._queue.remove(r)
+                self._stats["cancelled"] += 1
+                return self._terminal(r, "cancelled", "shed")
+        for i, r in enumerate(self._req):
+            if r is not None and r.id == request_id:
+                self._stats["cancelled"] += 1
+                res = self._finish(i, "cancelled", "shed")
+                self.completed[res.id] = res
+                return res
+        raise KeyError(f"request {request_id} is not queued or in flight")
 
     def _free_slots(self) -> List[int]:
         return [i for i, r in enumerate(self._req) if r is None]
 
+    def _deadline_at(self, req: Request) -> float:
+        if req.deadline_s is None or req.id not in self._meta:
+            return math.inf
+        return self._meta[req.id]["t"] + req.deadline_s
+
+    def _terminal(self, req: Request, reason: str, status: str
+                  ) -> GenerationResult:
+        """Terminal event for a request that never reached (or is no
+        longer in) a slot — result goes straight to `completed`."""
+        self._meta.pop(req.id, None)
+        self._stats[_STATUS_COUNTER[status]] += 1
+        res = GenerationResult(req.id, list(req.prompt), [], reason,
+                               status)
+        self.completed[req.id] = res
+        return res
+
+    def _expire_queued(self, now: float) -> None:
+        """Drop queued requests whose deadline or max-queue-wait TTL
+        passed — status 'expired', zero tokens."""
+        keep: deque = deque()
+        for r in self._queue:
+            t0 = self._meta[r.id]["t"]
+            dl = self._deadline_at(r)
+            qw = t0 + r.max_queue_wait_s \
+                if r.max_queue_wait_s is not None else math.inf
+            if now >= min(dl, qw):
+                self._terminal(r, "expired", "expired")
+            else:
+                keep.append(r)
+        self._queue = keep
+
+    def _pop_next(self) -> Request:
+        """Highest priority first; FIFO within a priority."""
+        best_i, best_p = 0, None
+        for i, r in enumerate(self._queue):
+            if best_p is None or r.priority > best_p:
+                best_i, best_p = i, r.priority
+        req = self._queue[best_i]
+        del self._queue[best_i]
+        return req
+
     def _admit(self):
+        self._expire_queued(self._clock())
         for slot in self._free_slots():
             if not self._queue:
                 return
-            req = self._queue.popleft()
+            req = self._pop_next()
             prompt = list(req.prompt)
             b = bucket_for(len(prompt), self.buckets)
             toks = pad_tokens(prompt, b)[None, :]          # (1, bucket)
@@ -233,39 +514,163 @@ class InferenceEngine:
             self._topk[slot] = req.top_k
             self._topp[slot] = req.top_p
 
-    def _finish(self, slot: int, reason: str) -> GenerationResult:
+    def _finish(self, slot: int, reason: str,
+                status: str = "done") -> GenerationResult:
         req = self._req[slot]
         res = GenerationResult(req.id, list(req.prompt),
-                               self._gen[slot], reason)
+                               self._gen[slot], reason, status)
         self._req[slot] = None
         self._gen[slot] = []
         self._temp[slot] = 0.0
-        self._stats["requests_done"] += 1
+        self._meta.pop(req.id, None)
+        self._stats[_STATUS_COUNTER[status]] += 1
         return res
+
+    def _scrub_slot(self, slot: int) -> None:
+        """Zero a poisoned slot's cache rows before reuse. A genuinely
+        non-finite request wrote NaN k/v at its positions; the next
+        occupant overwrites every position it can see, and
+        cached_attention nan-scrubs masked value rows — this scrub is
+        the belt to that suspenders, keeping the invariant local:
+        nothing a poisoned request wrote survives its eviction."""
+        self.cache = jax.tree_util.tree_map(
+            lambda leaf: leaf.at[slot].set(
+                jnp.zeros((), leaf.dtype)), self.cache)
+
+    def _cache_consumed(self) -> bool:
+        """True if any cache leaf's buffer was donated/deleted by a
+        failed dispatch — such a step is NOT retryable (the input no
+        longer exists); only failures raised before execution
+        consumed the buffers are."""
+        return any(getattr(leaf, "is_deleted", lambda: False)()
+                   for leaf in jax.tree_util.tree_leaves(self.cache))
+
+    def _degrade(self, reason: str) -> List[GenerationResult]:
+        """Quiesce: fail every in-flight and queued request, refuse new
+        submissions. Returns the failed in-flight/queued results (they
+        are also recorded in `completed` by run(); queued failures go
+        straight to `completed`)."""
+        self._degraded = reason
+        logger.error("serving engine degraded: %s", reason)
+        out = [self._finish(i, "failed", "failed")
+               for i, r in enumerate(self._req) if r is not None]
+        for r in list(self._queue):
+            out.append(self._terminal(r, "failed", "failed"))
+        self._queue.clear()
+        return out
+
+    def _dispatch_and_fetch(self, poison: np.ndarray, slow_s: float,
+                            watchdog: bool = True
+                            ) -> Tuple[np.ndarray, np.ndarray]:
+        """One decode dispatch + device→host fetch, optionally under
+        the watchdog budget. The fetch runs INSIDE the budget: the
+        observed failure mode is the device call blocking, not
+        erroring (PROFILE_r07), and only a wall-clock bound converts
+        that hang into a typed StepTimeout. A daemon thread suffices
+        here because steady-state PJRT dispatch/fetch releases the
+        GIL while it waits; backend INIT does not — that hang is
+        guarded by the subprocess probe in utils/tpu_probe instead."""
+        def work():
+            if slow_s:
+                time.sleep(slow_s)    # injected straggler/hang model
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore", message=".*[Dd]onat", category=UserWarning)
+                nxt, finite, cache = _decode_step(
+                    self.model, self._params, self.cache,
+                    jnp.asarray(self._tok), jnp.asarray(self._pos),
+                    jnp.asarray(self._seed), jnp.asarray(self._nout),
+                    jnp.asarray(self._temp), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp), jnp.asarray(poison))
+            return np.asarray(nxt), np.asarray(finite), cache
+
+        if self.step_timeout_s is None or not watchdog:
+            nxt, finite, cache = work()
+        else:
+            box: Dict[str, object] = {}
+
+            def boxed():
+                try:
+                    box["r"] = work()
+                except BaseException as e:      # noqa: BLE001
+                    box["e"] = e
+
+            th = threading.Thread(target=boxed, daemon=True,
+                                  name="bigdl-serving-step")
+            th.start()
+            th.join(self.step_timeout_s)
+            if th.is_alive():
+                raise StepTimeout(
+                    f"decode dispatch+fetch exceeded "
+                    f"{self.step_timeout_s} s watchdog budget")
+            if "e" in box:
+                raise box["e"]                  # type: ignore[misc]
+            nxt, finite, cache = box["r"]       # type: ignore[misc]
+        self.cache = cache
+        return nxt, finite
 
     def step(self) -> List[GenerationResult]:
         """Admit queued requests into free slots, run ONE decode step
-        over all slots, evict finished sequences. Returns the requests
-        that finished this step."""
+        over all slots, evict finished/poisoned/expired sequences.
+        Returns the requests that reached a terminal state this step.
+        A watchdog trip or exhausted retry budget degrades the engine
+        and returns every in-flight/queued request as 'failed'."""
+        if self._degraded:
+            return []
         self._admit()
         if all(r is None for r in self._req):
             return []
-        with warnings.catch_warnings():
-            warnings.filterwarnings(
-                "ignore", message=".*[Dd]onat", category=UserWarning)
-            nxt, self.cache = _decode_step(
-                self.model, self._params, self.cache,
-                jnp.asarray(self._tok), jnp.asarray(self._pos),
-                jnp.asarray(self._seed), jnp.asarray(self._nout),
-                jnp.asarray(self._temp), jnp.asarray(self._topk),
-                jnp.asarray(self._topp))
+        plan = faults.get_plan()
+        stepno = self._stats["decode_steps"]
+        poison = np.zeros(self.slots, bool)
+        if plan.fires("serve_nan", stepno):
+            active = [i for i, r in enumerate(self._req) if r is not None]
+            poison[active[0]] = True    # lowest active slot: determinate
+        for attempt in range(self.step_retries + 1):
+            try:
+                plan.maybe_raise("serve_err", stepno)
+                slow_s = 0.0
+                if plan.fires("serve_slow", stepno):
+                    slow_s = (self.step_timeout_s or 0.05) * 5
+                t0 = time.perf_counter()
+                nxt, finite = self._dispatch_and_fetch(poison, slow_s)
+                self._lat.append(time.perf_counter() - t0)
+                break
+            except StepTimeout as e:
+                self._stats["watchdog_trips"] += 1
+                return self._degrade(
+                    f"watchdog trip at decode step {stepno}: {e}")
+            except Exception as e:              # noqa: BLE001
+                if self._cache_consumed():
+                    # the failed dispatch already donated the cache
+                    # buffers (donate_argnums on TPU; no-op on CPU):
+                    # re-dispatching the deleted cache can only fail
+                    # with a misleading buffer error, so don't burn
+                    # the retry budget — degrade with the real cause
+                    return self._degrade(
+                        f"decode step {stepno} failed after cache "
+                        f"donation (buffers consumed, not "
+                        f"retryable): {e}")
+                if attempt >= self.step_retries:
+                    return self._degrade(
+                        f"decode step {stepno} failed after "
+                        f"{attempt + 1} attempt(s): {e}")
+                self._stats["retries"] += 1
+                logger.warning("decode step %d attempt %d failed (%s); "
+                               "retrying", stepno, attempt + 1, e)
+                if self.retry_backoff_s:
+                    time.sleep(self.retry_backoff_s * (2 ** attempt))
         self._stats["decode_steps"] += 1
-        nxt = np.asarray(nxt)
+        now = self._clock()
         done = []
         for i, req in enumerate(self._req):
             if req is None:
                 continue
             self._nout[i] += 1
+            if not bool(finite[i]):
+                self._scrub_slot(i)
+                done.append(self._finish(i, "poisoned", "poisoned"))
+                continue
             tok = int(nxt[i])
             if tok in req.stop_ids:
                 done.append(self._finish(i, "stop_id"))
@@ -273,6 +678,8 @@ class InferenceEngine:
             self._gen[i].append(tok)
             if len(self._gen[i]) >= req.max_new_tokens:
                 done.append(self._finish(i, "max_tokens"))
+            elif now >= self._deadline_at(req):
+                done.append(self._finish(i, "expired", "expired"))
             elif self._pos[i] + 1 >= self.cache_len:
                 done.append(self._finish(i, "cache_full"))
             else:
@@ -287,7 +694,9 @@ class InferenceEngine:
         (or, with no argument, everything that finished, id order).
         Results of OTHER requests that finished during the call —
         e.g. queued earlier via submit() — land in `self.completed`,
-        never dropped."""
+        never dropped. Shed/expired/poisoned/failed requests return
+        with their terminal status (never a KeyError); a 'reject'
+        overload raises OverloadError out of the submission phase."""
         ids = [self.submit(r) for r in requests] if requests else None
         while self._queue or any(r is not None for r in self._req):
             for res in self.step():

@@ -10,6 +10,16 @@ Conventions: temperature <= 0 → greedy (argmax); top_k <= 0 → top-k off;
 top_p >= 1 → nucleus off. Filters compose the standard way: top-k first,
 then top-p over the renormalized survivors, then categorical sampling
 via per-row Gumbel-max.
+
+Poison plumb-through (the serving reliability contract,
+serving/engine.py): every op here is strictly per-ROW, so a NaN/inf
+logits row — real or injected via the engine's (B,) poison operand —
+yields a garbage-but-defined token for THAT row only (argmax over
+all-NaN is index 0; NaN comparisons are False throughout the filter)
+and cannot perturb any co-batched row. The engine discards the token:
+the per-row finite flag (utils/anomaly.rows_finite) is computed on the
+logits BEFORE sampling and rides back beside the tokens, turning the
+row into a 'poisoned' eviction with no extra host sync.
 """
 
 from __future__ import annotations

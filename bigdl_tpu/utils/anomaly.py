@@ -88,6 +88,18 @@ def select_update(ok, new, old):
         lambda a, b: jnp.where(ok, a, b), new, old)
 
 
+def rows_finite(x):
+    """Jit-side per-ROW health predicate: (B, ...) → (B,) bool, True
+    iff every element of the row is finite. The serving plane's poison
+    guard (bigdl_tpu/serving/engine.py): the decode step returns this
+    reduction over the logits as a (B,) operand fetched alongside the
+    sampled tokens, so a NaN/inf row evicts only its own request — the
+    per-request analog of `health_ok`'s per-step predicate."""
+    import jax.numpy as jnp
+
+    return jnp.all(jnp.isfinite(x), axis=tuple(range(1, x.ndim)))
+
+
 def global_norm(tree):
     """sqrt(sum of squares) over a pytree or flat vector (jit-side)."""
     import jax

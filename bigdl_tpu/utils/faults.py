@@ -35,6 +35,22 @@ Fault kinds and where they are consulted:
                   the published model.npz — load() must fall back to the
                   newest valid checkpoint
 
+Serving kinds — consulted inside the serving engine's step loop
+(bigdl_tpu/serving/engine.py), keyed by the engine's DECODE step
+number (engine.stats["decode_steps"] at consult time):
+
+    serve_nan     poison one row's logits (the lowest occupied slot)
+                  to NaN INSIDE the jitted decode step via the (B,)
+                  poison operand — exercises the finite-logits guard
+                  and per-request 'poisoned' eviction end-to-end
+    serve_err     raise before dispatching the decode step — the
+                  transient step failure the retry-with-backoff
+                  budget absorbs (consulted per ATTEMPT: xN makes the
+                  failure persist across retries)
+    serve_slow    sleep inside the dispatch+fetch region — the hung
+                  device call / straggler model the step watchdog
+                  (step_timeout_s) must convert into a StepTimeout
+
 The plan is process-global (`get_plan()`/`set_plan()`); `get_plan()`
 lazily builds one from `BIGDL_FAULTS` so subprocess drills (multihost
 legs) inherit injection through the environment.
@@ -51,7 +67,8 @@ logger = logging.getLogger("bigdl_tpu.faults")
 
 ENV_VAR = "BIGDL_FAULTS"
 
-KINDS = ("step", "nan", "data", "ckpt_torn", "ckpt_corrupt")
+KINDS = ("step", "nan", "data", "ckpt_torn", "ckpt_corrupt",
+         "serve_nan", "serve_err", "serve_slow")
 
 
 class FaultInjected(RuntimeError):

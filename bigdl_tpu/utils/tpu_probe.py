@@ -1,0 +1,111 @@
+"""Bounded-timeout backend probe — never hang on the axon tunnel.
+
+The image boots a remote-TPU PJRT plugin ("axon") whose initialization
+can block INDEFINITELY when the tunnel is down (observed twice:
+PROFILE_r06 failed fast with "No ba16c7433 device found"; PROFILE_r07
+blocked past 240 s with no error). Any entry point whose first backend
+touch is an unguarded `jax.devices()` inherits that hang — bench.py
+and scripts/validate_tpu.py both lost whole sessions to it.
+
+Why a SUBPROCESS and not a watchdog thread: the hung init holds the
+GIL (measured 2026-08-03 — libtpu's instance-metadata retry loop, 30
+curl attempts per variable, runs inside a C call that never releases
+it), so every other thread in the process freezes with it; a join
+timeout cannot fire. A child process is killable from outside
+regardless. The child pays one jax import (~seconds); on success the
+parent's own backend init follows the same proven-healthy path. This
+differs from the serving engine's step watchdog
+(bigdl_tpu/serving/engine.py), which guards steady-state
+dispatch+fetch — those PJRT calls DO release the GIL, so an
+in-process daemon thread suffices there.
+
+The child mirrors tests/conftest.py's CPU pinning when
+JAX_PLATFORMS=cpu (pin the platform AND drop the axon factory before
+first backend use), so a CPU-pinned probe never touches the tunnel.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+from typing import Callable, Optional
+
+logger = logging.getLogger("bigdl_tpu.tpu_probe")
+
+ENV_TIMEOUT = "BIGDL_TPU_PROBE_TIMEOUT"
+
+# intentional inline copy of utils/engine.ensure_cpu_platform: the
+# child must not depend on bigdl_tpu being importable from its cwd
+_CHILD_CODE = """\
+import os
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge
+        xla_bridge._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+import jax
+print(jax.devices()[0].platform, flush=True)
+"""
+
+
+def default_timeout_s() -> float:
+    """Seconds to wait for backend init (env BIGDL_TPU_PROBE_TIMEOUT,
+    default 120 — generous for a healthy tunnel, far short of the
+    580 s command budget the hang would otherwise consume)."""
+    return float(os.environ.get(ENV_TIMEOUT, "120"))
+
+
+def probe_platform(timeout_s: Optional[float] = None,
+                   devices_fn: Optional[Callable[[], object]] = None
+                   ) -> Optional[str]:
+    """The backend platform string ("tpu"/"cpu"/...), or None if
+    backend init did not complete within `timeout_s` (hang) or raised
+    (no device reachable). `devices_fn` substitutes the backend touch
+    for tests — it runs on a daemon thread in-process and must return
+    the platform string directly."""
+    if timeout_s is None:
+        timeout_s = default_timeout_s()
+
+    if devices_fn is not None:              # test hook: thread-based
+        box: dict = {}
+
+        def work():
+            try:
+                box["platform"] = devices_fn()
+            except Exception as e:          # noqa: BLE001
+                box["error"] = e
+
+        th = threading.Thread(target=work, daemon=True, name="tpu-probe")
+        th.start()
+        th.join(timeout_s)
+        if th.is_alive():
+            logger.warning("backend probe still blocked after %.0f s",
+                           timeout_s)
+            return None
+        if "error" in box:
+            logger.warning("backend probe failed: %s", box["error"])
+            return None
+        return box["platform"]
+
+    try:
+        r = subprocess.run([sys.executable, "-c", _CHILD_CODE],
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        logger.warning("backend probe subprocess still blocked after "
+                       "%.0f s (axon tunnel hang?) — reporting no "
+                       "backend", timeout_s)
+        return None
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-3:]
+        logger.warning("backend probe failed (rc=%d): %s",
+                       r.returncode, " | ".join(tail))
+        return None
+    lines = r.stdout.strip().splitlines()
+    return lines[-1].strip() if lines else None

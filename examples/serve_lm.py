@@ -12,6 +12,13 @@ The BigDL-2.0 analog is Cluster Serving (arXiv 2204.01715) — there a
 Flink pipeline around a batch predictor; here the batching is
 continuous (finished sequences evicted and new requests spliced in
 between decode steps) because the XLA-side step is shape-static.
+
+The reliability layer rides along: the engine below runs with a
+bounded queue (shed-oldest overload policy), per-request priorities
+and deadlines, and a retry budget — and prints `engine.health()` (the
+operational snapshot: occupancy, queue composition, p50/p95 decode
+latency, reliability counters). Deterministic failure injection for
+every path lives in `scripts/fault_drill.py --plane serving`.
 """
 
 import os
@@ -43,8 +50,11 @@ def main():
      .set_end_when(Trigger.max_epoch(3))
      .optimize())
 
-    # 2. serve it: 4 cache slots, two prefill buckets
-    engine = InferenceEngine(model, slots=4, prefill_buckets=(8, 16))
+    # 2. serve it: 4 cache slots, two prefill buckets, bounded queue
+    # with shed-oldest overload policy and a 1-retry step budget
+    engine = InferenceEngine(model, slots=4, prefill_buckets=(8, 16),
+                             max_queue=8, overload_policy="shed-oldest",
+                             step_retries=1)
     requests = [
         Request(prompt=[1, 2, 3], max_new_tokens=12),            # greedy
         Request(prompt=list(range(2, 16)), max_new_tokens=12,
@@ -52,8 +62,9 @@ def main():
         Request(prompt=[5, 6, 7, 8], max_new_tokens=12,
                 temperature=1.0, top_p=0.9, seed=2),
         Request(prompt=[9, 10], max_new_tokens=24, stop_ids=(0,),
-                temperature=0.7, seed=3),
-        Request(prompt=list(range(1, 10)), max_new_tokens=12),
+                temperature=0.7, seed=3, priority=5),  # jumps the queue
+        Request(prompt=list(range(1, 10)), max_new_tokens=12,
+                deadline_s=300.0),                     # generous TTL
         Request(prompt=[4] * 7, max_new_tokens=12, temperature=0.9,
                 seed=4),
     ]
@@ -65,11 +76,14 @@ def main():
     for r in results:
         total += len(r.tokens)
         print(f"req {r.id}: prompt[:6]={r.prompt[:6]} -> "
-              f"{r.tokens} ({r.finish_reason})")
+              f"{r.tokens} ({r.status}/{r.finish_reason})")
     print(f"\n{total} tokens across {len(results)} requests in "
           f"{dt:.2f}s (includes compiles)")
     print(f"engine stats: {engine.stats}")
+    print(f"engine health: {engine.health()}")
     assert engine.stats["decode_traces"] == 1
+    assert all(r.status == "done" for r in results)
+    assert engine.health()["state"] == "ok"
     return results
 
 

@@ -1,0 +1,107 @@
+"""Tier-1 runtime guard (ISSUE 4 satellite): fail loudly BEFORE the
+suite outgrows its timeout, not when CI starts flaking.
+
+The tier-1 contract (ROADMAP.md) runs the non-slow suite under a hard
+870 s timeout, and PR 3 measured the suite at that edge. This script
+reads the `--durations` dump from the last pytest run and fails if the
+projected runtime exceeds the budget (default 800 s — headroom under
+the 870 s kill), listing the worst offenders so the fix is targeted.
+
+Produce the dump by appending `--durations=0 --durations-min=0.05` to
+any tier-1 invocation and teeing to a log, e.g.:
+
+    JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+        --durations=0 --durations-min=0.05 2>&1 | tee /tmp/_t1.log
+    python scripts/check_tier1_budget.py --log /tmp/_t1.log
+
+Exit codes: 0 within budget, 1 over budget, 2 no durations found in
+the log (wrong file, or the run omitted --durations).
+
+Projection note: the durations dump counts per-test setup/call/teardown
+only; interpreter start, collection and module imports ride on top, so
+`--overhead-s` (default 40) is added to the sum. The projection is
+conservative in the other direction too — durations below
+--durations-min are hidden by pytest and uncounted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from typing import List, Tuple
+
+# "  12.34s call     tests/test_x.py::TestY::test_z"
+_DURATION_RE = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+)\s*$")
+
+
+def parse_durations(text: str) -> List[Tuple[float, str, str]]:
+    """[(seconds, phase, test id), ...] from a pytest --durations dump
+    (any other log lines are ignored)."""
+    out = []
+    for line in text.splitlines():
+        m = _DURATION_RE.match(line)
+        if m:
+            out.append((float(m.group(1)), m.group(2), m.group(3)))
+    return out
+
+
+def projected_runtime_s(entries: List[Tuple[float, str, str]],
+                        overhead_s: float = 40.0) -> float:
+    """Sum of all recorded phases plus fixed start/collection
+    overhead."""
+    return sum(e[0] for e in entries) + overhead_s
+
+
+def slowest_tests(entries: List[Tuple[float, str, str]],
+                  top: int = 10) -> List[Tuple[float, str]]:
+    """Top test ids by total time across phases."""
+    by_test: dict = {}
+    for secs, _, test in entries:
+        by_test[test] = by_test.get(test, 0.0) + secs
+    return sorted(((t, n) for n, t in by_test.items()),
+                  reverse=True)[:top]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--log", default="/tmp/_t1.log",
+                    help="pytest output containing a --durations dump")
+    ap.add_argument("--budget", type=float, default=800.0,
+                    help="max projected seconds for the non-slow suite")
+    ap.add_argument("--overhead-s", type=float, default=40.0,
+                    help="fixed start/collection overhead added to the "
+                         "durations sum")
+    ap.add_argument("--top", type=int, default=10,
+                    help="how many slowest tests to list")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.log) as f:
+            text = f.read()
+    except OSError as e:
+        print(f"tier1-budget: cannot read {args.log}: {e}")
+        return 2
+    entries = parse_durations(text)
+    if not entries:
+        print(f"tier1-budget: no --durations entries in {args.log} — "
+              "rerun pytest with --durations=0 --durations-min=0.05")
+        return 2
+
+    projected = projected_runtime_s(entries, args.overhead_s)
+    verdict = "OVER BUDGET" if projected > args.budget else "ok"
+    print(f"tier1-budget: projected {projected:.0f}s "
+          f"(= {projected - args.overhead_s:.0f}s measured across "
+          f"{len(entries)} phases + {args.overhead_s:.0f}s overhead) "
+          f"vs budget {args.budget:.0f}s — {verdict}")
+    if projected > args.budget:
+        print(f"slowest {args.top} tests:")
+        for secs, name in slowest_tests(entries, args.top):
+            print(f"  {secs:8.2f}s  {name}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

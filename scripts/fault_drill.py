@@ -1,12 +1,13 @@
 """Fault drill — deterministic failure injection against the training
-loop's recovery contract (ISSUE 1 tentpole; reference anchor: the
-reference inherits its guarantees from Spark task retry + lineage,
-arXiv 1804.05839 §4, and never tests them directly — here every
-recovery path is exercised on demand, reproducibly, by step number).
+loop's recovery contract AND the serving plane's reliability layer
+(ISSUE 1 + ISSUE 4 tentpoles; reference anchor: the reference inherits
+its guarantees from Spark task retry + lineage, arXiv 1804.05839 §4,
+and never tests them directly — here every recovery path is exercised
+on demand, reproducibly, by step number).
 
-Six legs, each a tiny MLP classification run on CPU (the virtual
-8-device mesh for the distributed legs — the same shard_map code a pod
-runs):
+Training plane (--plane training): each leg a tiny MLP classification
+run on CPU (the virtual 8-device mesh for the distributed legs — the
+same shard_map code a pod runs):
 
     nan_skip        guard policy 'skip_step', injected NaN batch at
                     step 4: the update is discarded ON DEVICE — weights
@@ -29,15 +30,41 @@ runs):
                     rot): load() detects the checksum/zip damage and
                     falls back to the newest VALID checkpoint
 
-Every leg compares parameters BIT-FOR-BIT against an uninterrupted
-reference run (same init, same deterministic batch stream, same rng
-folding), so "recovered" means "indistinguishable from never having
-failed" — not merely "didn't crash".
+Serving plane (--plane serving): each leg drives the continuous-
+batching InferenceEngine (bigdl_tpu/serving/engine.py) over a tiny LM
+with utils/faults serving kinds injected by DECODE step number:
+
+    serve_poison    serve_nan poisons one co-batched row's logits
+                    inside the jitted step: that request evicts with
+                    status 'poisoned'; its co-batch AND the slot's
+                    next occupant stay bit-identical to running alone
+    serve_overload  bounded queue under all three overload policies:
+                    reject raises, shed-oldest / shed-lowest-priority
+                    shed the right victim with status 'shed'
+    serve_deadline  deterministic (injected-clock) TTL expiry, both
+                    while queued (0 tokens) and while decoding
+                    (partial tokens kept), status 'expired'
+    serve_retry     serve_err transient step failure absorbed by the
+                    retry budget — output bit-identical to a clean
+                    run; a PERSISTENT failure (xN) exhausts the
+                    budget and degrades the engine
+    serve_watchdog  serve_slow hangs the dispatch+fetch past
+                    step_timeout_s: the watchdog trips, in-flight
+                    requests fail with status 'failed', the engine
+                    quiesces and health() reports the trip
+
+Every training leg compares parameters BIT-FOR-BIT against an
+uninterrupted reference run (same init, same deterministic batch
+stream, same rng folding); every serving leg compares generated
+TOKENS bit-for-bit against a clean or run-alone reference — so
+"recovered"/"isolated" means "indistinguishable from never having
+failed", not merely "didn't crash".
 
 Usage:
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-        python scripts/fault_drill.py            # all legs
-    ... fault_drill.py --legs nan_skip,ckpt_fallback
+        python scripts/fault_drill.py            # all legs, both planes
+    ... fault_drill.py --plane serving           # serving legs only
+    ... fault_drill.py --legs nan_skip,serve_poison
 
 CI: tests/test_fault_drill.py runs these legs on every tier-1 pass.
 """
@@ -216,7 +243,230 @@ def drill_ckpt_fallback(workdir):
             "bit_identical": bool(np.array_equal(ref, got))}
 
 
-LEGS = {
+# ---------------------------------------------------------- serving legs
+
+# one tiny LM shared by every serving leg: engines over the same model
+# object share jitted executables (engine._prefill_step/_decode_step
+# are static-arg'd on the model), so the whole plane compiles once
+_SERVE_LM = None
+
+
+def _serve_lm():
+    global _SERVE_LM
+    if _SERVE_LM is None:
+        import jax
+
+        from bigdl_tpu.models.transformer import build_lm
+
+        _SERVE_LM = build_lm(vocab_size=50, dim=32, num_heads=2,
+                             num_layers=2, max_len=64)
+        _SERVE_LM.build(jax.random.PRNGKey(0))
+    return _SERVE_LM
+
+
+def _engine(**kw):
+    from bigdl_tpu.serving import InferenceEngine
+
+    kw.setdefault("slots", 2)
+    kw.setdefault("prefill_buckets", (8,))
+    return InferenceEngine(_serve_lm(), **kw)
+
+
+def _req(**kw):
+    from bigdl_tpu.serving import Request
+
+    kw.setdefault("max_new_tokens", 5)
+    return Request(**kw)
+
+
+def _plan(spec):
+    from bigdl_tpu.utils import faults as fm
+
+    fm.set_plan(fm.FaultPlan(spec))
+    return fm
+
+
+def drill_serve_poison(workdir):
+    """serve_nan at decode step 2 poisons slot 0 (request A) inside the
+    jitted step: A evicts with status 'poisoned' after its 2 clean
+    tokens; co-batched B's tokens are BIT-IDENTICAL to running B alone,
+    and a follow-up request through A's recycled slot is bit-identical
+    too (slot scrub + masked-row nan hygiene in cached_attention)."""
+    A = dict(prompt=[1, 2, 3], max_new_tokens=6, temperature=0.8, seed=5)
+    B = dict(prompt=[4, 5, 6, 7], max_new_tokens=6, temperature=0.9,
+             seed=9)
+    alone_b = _engine().run([_req(**B)])[0]
+    alone_a2 = _engine().run([_req(**A)])[0]     # reuse-probe reference
+
+    fm = _plan("serve_nan@2")
+    try:
+        eng = _engine()
+        got_a, got_b = eng.run([_req(**A), _req(**B)])
+        # slot 0 (A's) was poisoned and scrubbed — reuse it
+        reuse = eng.run([_req(**A)])[0]
+        fired = fm.get_plan().fired
+    finally:
+        fm.set_plan(None)
+    ok = (got_a.status == "poisoned" and len(got_a.tokens) == 2
+          and got_b.status == "done" and got_b.tokens == alone_b.tokens
+          and reuse.tokens == alone_a2.tokens
+          and eng.stats["poisoned"] == 1
+          and ("serve_nan", 2) in fired)
+    return {"ok": bool(ok), "poisoned_status": got_a.status,
+            "poisoned_tokens_kept": len(got_a.tokens),
+            "cobatch_bit_identical": got_b.tokens == alone_b.tokens,
+            "slot_reuse_bit_identical": reuse.tokens == alone_a2.tokens,
+            "fired": fired}
+
+
+def drill_serve_overload(workdir):
+    """Bounded queue, all three policies: reject raises OverloadError;
+    shed-oldest evicts the longest-queued request; shed-lowest-priority
+    evicts the lowest priority (or the newcomer when IT is lowest)."""
+    from bigdl_tpu.serving import OverloadError
+
+    # reject
+    e1 = _engine(max_queue=1, overload_policy="reject")
+    e1.submit(_req(prompt=[1, 2]))
+    rejected = False
+    try:
+        e1.submit(_req(prompt=[3, 4]))
+    except OverloadError:
+        rejected = True
+    # shed-oldest
+    e2 = _engine(max_queue=2, overload_policy="shed-oldest")
+    old = e2.submit(_req(prompt=[1, 2], seed=1))
+    e2.submit(_req(prompt=[3, 4], seed=2))
+    e2.submit(_req(prompt=[5, 6], seed=3))       # sheds `old`
+    shed_oldest = (old in e2.completed
+                   and e2.completed[old].status == "shed")
+    done2 = e2.run()
+    # shed-lowest-priority: queued low-priority victim...
+    e3 = _engine(max_queue=2, overload_policy="shed-lowest-priority")
+    low = e3.submit(_req(prompt=[1, 2], priority=1))
+    e3.submit(_req(prompt=[3, 4], priority=5))
+    e3.submit(_req(prompt=[5, 6], priority=3))   # sheds `low`
+    shed_low = (low in e3.completed
+                and e3.completed[low].status == "shed")
+    # ...and the newcomer itself when IT is the lowest
+    new = e3.submit(_req(prompt=[7, 8], priority=0))
+    shed_new = (new in e3.completed
+                and e3.completed[new].status == "shed")
+    e3.run()
+    ok = (rejected and e1.stats["rejected"] == 1
+          and shed_oldest and e2.stats["shed"] == 1
+          and all(r.status == "done" for r in done2
+                  if r.status != "shed")
+          and shed_low and shed_new and e3.stats["shed"] == 2)
+    return {"ok": bool(ok), "rejected": rejected,
+            "shed_oldest": shed_oldest, "shed_lowest": shed_low,
+            "shed_new_lowest": shed_new}
+
+
+def drill_serve_deadline(workdir):
+    """Injected-clock TTL expiry — bit-deterministic on CPU: a queued
+    request expires with 0 tokens while both slots are busy; a decoding
+    request expires mid-generation keeping its partial tokens."""
+    clk = {"t": 0.0}
+    # expiry while QUEUED: both slots busy with 8-token requests, the
+    # queued request's 3 s TTL passes at 1 s/step
+    eng = _engine(clock=lambda: clk["t"])
+    eng.submit(_req(prompt=[1, 2], max_new_tokens=8, seed=1))
+    eng.submit(_req(prompt=[3, 4], max_new_tokens=8, seed=2))
+    qid = eng.submit(_req(prompt=[5, 6], deadline_s=3.0))
+    while eng._queue or any(r is not None for r in eng._req):
+        for res in eng.step():
+            eng.completed[res.id] = res
+        clk["t"] += 1.0
+    queued_exp = eng.completed[qid]
+    # expiry while DECODING: deadline 2 s passes after the 3rd token
+    clk["t"] = 0.0
+    eng2 = _engine(clock=lambda: clk["t"])
+    did = eng2.submit(_req(prompt=[1, 2, 3], max_new_tokens=8,
+                           deadline_s=2.0))
+    while eng2._queue or any(r is not None for r in eng2._req):
+        for res in eng2.step():
+            eng2.completed[res.id] = res
+        clk["t"] += 1.0
+    dec_exp = eng2.completed[did]
+    ok = (queued_exp.status == "expired" and queued_exp.tokens == []
+          and dec_exp.status == "expired" and len(dec_exp.tokens) == 3
+          and eng.stats["deadline_misses"] == 1
+          and eng2.stats["deadline_misses"] == 1)
+    return {"ok": bool(ok), "queued_status": queued_exp.status,
+            "queued_tokens": len(queued_exp.tokens),
+            "decoding_status": dec_exp.status,
+            "decoding_tokens_kept": len(dec_exp.tokens)}
+
+
+def drill_serve_retry(workdir):
+    """serve_err at decode step 1: one retry redispatches and the run
+    finishes BIT-IDENTICAL to an uninjected run (the transient model);
+    a persistent serve_err@1x3 exhausts a 1-retry budget and degrades
+    the engine with every in-flight request 'failed'."""
+    A = dict(prompt=[1, 2, 3], max_new_tokens=5, temperature=0.7, seed=3)
+    ref = _engine().run([_req(**A)])[0]
+    fm = _plan("serve_err@1")
+    try:
+        eng = _engine(step_retries=1, retry_backoff_s=0.0)
+        got = eng.run([_req(**A)])[0]
+        fired = fm.get_plan().fired
+    finally:
+        fm.set_plan(None)
+    transient_ok = (got.status == "done" and got.tokens == ref.tokens
+                    and eng.stats["retries"] == 1
+                    and ("serve_err", 1) in fired)
+    fm = _plan("serve_err@1x3")
+    try:
+        eng2 = _engine(step_retries=1, retry_backoff_s=0.0)
+        got2 = eng2.run([_req(**A)])[0]
+    finally:
+        fm.set_plan(None)
+    persistent_ok = (got2.status == "failed" and len(got2.tokens) == 1
+                     and eng2.degraded is not None
+                     and eng2.stats["retries"] == 1)
+    return {"ok": bool(transient_ok and persistent_ok),
+            "transient_bit_identical": got.tokens == ref.tokens,
+            "retries": eng.stats["retries"],
+            "persistent_status": got2.status,
+            "persistent_degraded": eng2.degraded is not None}
+
+
+def drill_serve_watchdog(workdir):
+    """serve_slow at decode step 1 under a 50 ms watchdog: the hung
+    dispatch+fetch becomes a StepTimeout, in-flight requests fail with
+    status 'failed' (keeping the deterministic token from step 0), the
+    engine quiesces (submit raises EngineDegraded) and health()
+    records the trip."""
+    from bigdl_tpu.serving import EngineDegraded
+
+    A = dict(prompt=[1, 2, 3], max_new_tokens=5, seed=1)
+    B = dict(prompt=[4, 5, 6, 7], max_new_tokens=5, seed=2)
+    ref = _engine().run([_req(**A)])[0]          # clean tokens oracle
+    fm = _plan("serve_slow@1")
+    try:
+        eng = _engine(step_timeout_s=0.05)
+        got = eng.run([_req(**A), _req(**B)])
+    finally:
+        fm.set_plan(None)
+    h = eng.health()
+    quiesced = False
+    try:
+        eng.submit(_req(prompt=[1]))
+    except EngineDegraded:
+        quiesced = True
+    ok = (all(r.status == "failed" for r in got)
+          and got[0].tokens == ref.tokens[:1]    # step-0 token kept
+          and h["state"] == "degraded" and h["watchdog_trips"] == 1
+          and quiesced)
+    return {"ok": bool(ok),
+            "statuses": [r.status for r in got],
+            "tokens_before_trip": [len(r.tokens) for r in got],
+            "watchdog_trips": h["watchdog_trips"], "state": h["state"],
+            "quiesced": quiesced}
+
+
+TRAINING_LEGS = {
     "nan_skip": drill_nan_skip,
     "nan_skip_mesh": lambda wd: drill_nan_skip(wd, mesh=True),
     "rollback": drill_rollback,
@@ -226,14 +476,31 @@ LEGS = {
     "ckpt_fallback": drill_ckpt_fallback,
 }
 
+SERVING_LEGS = {
+    "serve_poison": drill_serve_poison,
+    "serve_overload": drill_serve_overload,
+    "serve_deadline": drill_serve_deadline,
+    "serve_retry": drill_serve_retry,
+    "serve_watchdog": drill_serve_watchdog,
+}
+
+LEGS = {**TRAINING_LEGS, **SERVING_LEGS}
+
+PLANES = {"training": TRAINING_LEGS, "serving": SERVING_LEGS,
+          "all": LEGS}
+
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--legs", default=",".join(LEGS),
-                    help="comma subset of legs to run")
+    ap.add_argument("--plane", default="all", choices=sorted(PLANES),
+                    help="which drill plane to run (default: all)")
+    ap.add_argument("--legs", default=None,
+                    help="comma subset of legs (overrides --plane)")
     args = ap.parse_args()
+    legs = args.legs.split(",") if args.legs \
+        else list(PLANES[args.plane])
     results, ok = {}, True
-    for name in args.legs.split(","):
+    for name in legs:
         with tempfile.TemporaryDirectory(prefix=f"fault_{name}_") as wd:
             r = LEGS[name](wd)
         results[name] = r

@@ -14,6 +14,18 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def main():
+    # bounded probe BEFORE any unguarded backend touch: the axon
+    # tunnel's init can block forever (PROFILE_r07) — report and exit
+    # instead of eating the whole session
+    from bigdl_tpu.utils.tpu_probe import default_timeout_s, probe_platform
+
+    platform = probe_platform()
+    if platform is None:
+        print(f"no TPU: backend probe hung or errored within "
+              f"{default_timeout_s():.0f} s (axon tunnel down?) — "
+              "nothing to validate, exiting cleanly")
+        return 1
+
     import jax
     import jax.numpy as jnp
 

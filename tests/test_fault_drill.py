@@ -15,13 +15,22 @@ import pytest
 from bigdl_tpu.utils import anomaly, faults
 
 
+_DRILL = None
+
+
 def _load_drill():
-    path = os.path.join(os.path.dirname(__file__), "..", "scripts",
-                        "fault_drill.py")
-    spec = importlib.util.spec_from_file_location("fault_drill", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+    # cached: serving legs share one tiny LM whose jitted steps must
+    # compile once per process, not once per test (module reload would
+    # rebuild the model object and retrace everything)
+    global _DRILL
+    if _DRILL is None:
+        path = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                            "fault_drill.py")
+        spec = importlib.util.spec_from_file_location("fault_drill", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _DRILL = mod
+    return _DRILL
 
 
 @pytest.fixture(autouse=True)
@@ -40,6 +49,18 @@ def _clean_plan():
 def test_drill_leg(tmp_path, leg):
     fd = _load_drill()
     result = fd.LEGS[leg](str(tmp_path))
+    assert result["ok"], result
+
+
+@pytest.mark.parametrize("leg", ["serve_poison", "serve_overload",
+                                 "serve_deadline", "serve_retry",
+                                 "serve_watchdog"])
+def test_serving_drill_leg(tmp_path, leg):
+    """ISSUE 4: the serving-plane reliability drills (poisoned
+    co-batch, overload shed, deadline expiry, retry-then-succeed,
+    watchdog trip) run bit-deterministically on every tier-1 pass."""
+    fd = _load_drill()
+    result = fd.SERVING_LEGS[leg](str(tmp_path))
     assert result["ok"], result
 
 

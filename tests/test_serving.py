@@ -311,35 +311,75 @@ class TestEngine:
 
     def test_compile_count_guard(self):
         """Ragged simulated traffic — varying lengths, mid-stream
-        arrivals, slot eviction/reuse — compiles exactly
-        (#buckets used) prefills + 1 decode, and a second traffic wave
-        compiles NOTHING."""
+        arrivals, slot eviction/reuse, AND the reliability knobs
+        (priorities, deadlines, bounded queue, a poison injection) —
+        compiles exactly (#buckets used) prefills + 1 decode, and a
+        second traffic wave compiles NOTHING. The reliability layer is
+        host-side bookkeeping plus (B,) operands by construction, so
+        arming any of it must never retrace."""
+        from bigdl_tpu.utils import faults
+
         m = _tiny_lm()
-        eng = InferenceEngine(m, slots=2, prefill_buckets=(8, 16))
+        eng = InferenceEngine(m, slots=2, prefill_buckets=(8, 16),
+                              max_queue=8,
+                              overload_policy="shed-oldest")
         rng = np.random.RandomState(0)
         for n in (3, 10, 6):
             eng.submit(Request(prompt=list(rng.randint(1, 50, n)),
-                               max_new_tokens=int(rng.randint(2, 7))))
+                               max_new_tokens=int(rng.randint(2, 7)),
+                               priority=int(n), deadline_s=3600.0))
         for _ in range(4):                      # partial drain
             eng.step()
         for n in (12, 2, 8):                    # mid-stream arrivals
             eng.submit(Request(prompt=list(rng.randint(1, 50, n)),
                                max_new_tokens=int(rng.randint(2, 7)),
-                               temperature=0.8, seed=int(n)))
+                               temperature=0.8, seed=int(n),
+                               max_queue_wait_s=3600.0))
         eng.run()
         assert eng.stats["requests_done"] == 6
         # lengths 3,6,2 → bucket 8; 10,12,8 → bucket 8 or 16: exactly
         # the two buckets were used
         assert eng.stats["prefill_traces"] == 2
         assert eng.stats["decode_traces"] == 1
-        # second wave: every shape already compiled
-        for n in (5, 11, 7, 16):
-            eng.submit(Request(prompt=list(rng.randint(1, 50, n)),
-                               max_new_tokens=3))
-        eng.run()
+        # second wave: every shape already compiled — including a
+        # serve_nan poison injection (the poison operand is (B,))
+        faults.set_plan(faults.FaultPlan(
+            f"serve_nan@{eng.stats['decode_steps'] + 1}"))
+        try:
+            for n in (5, 11, 7, 16):
+                eng.submit(Request(prompt=list(rng.randint(1, 50, n)),
+                                   max_new_tokens=3))
+            eng.run()
+        finally:
+            faults.set_plan(None)
         assert eng.stats["prefill_traces"] == 2
         assert eng.stats["decode_traces"] == 1
-        assert eng.stats["requests_done"] == 10
+        assert eng.stats["poisoned"] == 1
+        assert eng.stats["requests_done"] == 9   # 10th evicted poisoned
+
+    def test_poisoned_cobatch_isolation(self):
+        """Batcher equivalence under poison: a serve_nan-injected row
+        evicts ONLY its own request (status 'poisoned'); the co-batched
+        request's tokens stay bit-identical to running it alone."""
+        from bigdl_tpu.utils import faults
+
+        m = _shared_lm()
+        vic = dict(prompt=[1, 2, 3], max_new_tokens=6, temperature=0.8,
+                   seed=5)
+        oth = dict(prompt=[4, 5, 6], max_new_tokens=6, temperature=0.9,
+                   seed=9)
+        alone = InferenceEngine(m, slots=2, prefill_buckets=(8,)).run(
+            [Request(**oth)])[0]
+        faults.set_plan(faults.FaultPlan("serve_nan@1"))
+        try:
+            eng = InferenceEngine(m, slots=2, prefill_buckets=(8,))
+            got_v, got_o = eng.run([Request(**vic), Request(**oth)])
+        finally:
+            faults.set_plan(None)
+        assert got_v.status == "poisoned" and len(got_v.tokens) == 1
+        assert got_o.status == "done"
+        assert got_o.tokens == alone.tokens
+        assert eng.stats["poisoned"] == 1
 
     def test_submit_rejects_oversize(self):
         m = _shared_lm()
@@ -353,6 +393,29 @@ class TestEngine:
         eng.submit(Request(prompt=[1], id=7))
         with pytest.raises(ValueError, match="in flight"):
             eng.submit(Request(prompt=[2], id=7))
+
+    def test_submit_rejects_duplicate_id_in_occupied_slot(self):
+        """The duplicate-id guard must scan OCCUPIED SLOTS too, not
+        just the queue — a resubmitted id of a request that already
+        left the queue for a slot is still in flight."""
+        m = _shared_lm()
+        eng = InferenceEngine(m, slots=2, prefill_buckets=(8,))
+        eng.submit(Request(prompt=[1, 2, 3], max_new_tokens=4, id=42))
+        eng.step()                    # admits 42 into a slot
+        assert [r.id for r in eng._req if r is not None] == [42]
+        with pytest.raises(ValueError, match="in flight"):
+            eng.submit(Request(prompt=[4, 5], id=42))
+        eng.run()
+
+    def test_auto_ids_skip_user_claimed_values(self):
+        """Auto-assignment must skip over ids the user already claimed
+        explicitly — never error on (or duplicate) its own counter."""
+        m = _shared_lm()
+        eng = InferenceEngine(m, slots=2, prefill_buckets=(8,))
+        eng.submit(Request(prompt=[1, 2], max_new_tokens=2, id=0))
+        auto = eng.submit(Request(prompt=[3, 4], max_new_tokens=2))
+        assert auto != 0
+        eng.run()
 
     def test_presubmitted_results_not_dropped(self):
         """A request queued via submit() before run(other_requests)

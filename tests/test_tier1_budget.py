@@ -1,0 +1,49 @@
+"""scripts/check_tier1_budget.py — pure text parsing + threshold
+logic, so this runs in milliseconds (the actual budget check against a
+real run is a standalone invocation; see CLAUDE.md)."""
+
+import importlib.util
+import os
+
+_SYNTHETIC = """\
+============================= slowest durations ==============================
+120.50s call     tests/test_models.py::test_resnet
+  0.30s setup    tests/test_models.py::test_resnet
+ 45.25s call     tests/test_serving.py::TestEngine::test_matches_run_alone
+  0.05s teardown tests/test_serving.py::TestEngine::test_matches_run_alone
+not a duration line
+12 passed in 166.2s
+"""
+
+
+def _load():
+    path = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                        "check_tier1_budget.py")
+    spec = importlib.util.spec_from_file_location("check_tier1_budget",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+def test_parse_and_projection():
+    m = _load()
+    entries = m.parse_durations(_SYNTHETIC)
+    assert len(entries) == 4
+    assert entries[0] == (120.5, "call", "tests/test_models.py::test_resnet")
+    assert m.projected_runtime_s(entries, overhead_s=40.0) == \
+        40.0 + 120.5 + 0.3 + 45.25 + 0.05
+    top = m.slowest_tests(entries, top=1)
+    assert top == [(120.8, "tests/test_models.py::test_resnet")]
+
+
+def test_main_verdicts(tmp_path, capsys):
+    m = _load()
+    log = tmp_path / "t1.log"
+    log.write_text(_SYNTHETIC)
+    assert m.main(["--log", str(log), "--budget", "500"]) == 0
+    assert m.main(["--log", str(log), "--budget", "100"]) == 1
+    out = capsys.readouterr().out
+    assert "OVER BUDGET" in out and "test_resnet" in out
+    log.write_text("no durations here\n")
+    assert m.main(["--log", str(log)]) == 2
+    assert m.main(["--log", str(tmp_path / "missing.log")]) == 2
