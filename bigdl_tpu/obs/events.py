@@ -1,0 +1,163 @@
+"""Structured JSONL event log — one schema-versioned record per
+step / request / anomaly / checkpoint / fault-injection / degradation.
+
+Replaces the ad-hoc prints that previously carried this information
+(fault_drill stdout JSON, logger lines): a drill or a bench can now
+assert on (and a later session can reconstruct) what a run DID from
+machine-readable records instead of scraping text.
+
+Record shape (every record)::
+
+    {"schema": 1, "ts": <clock seconds>, "seq": <monotonic int>,
+     "kind": "<event kind>", ...kind-specific fields}
+
+Kinds in use across the codebase (the schema is open — new kinds are
+fine; these are the wired ones):
+
+    train_step          per optimizer step: step, epoch, loss, lr,
+                        throughput, and (guard armed) gnorm/guard
+    anomaly             guard observation: step, action, gnorm
+    checkpoint_save / checkpoint_load / checkpoint_corrupt_skipped
+    fault_injected      every utils/faults shot that fires: fault, step
+    request_submit / request_terminal   serving lifecycle endpoints
+    engine_degraded     watchdog trip / retry exhaustion
+    metrics_snapshot    a full registry snapshot embedded as an event
+                        (obs.log_metrics_snapshot) — gives a JSONL file
+                        self-contained percentiles for obs_report
+
+The log is ring-buffered in memory (default 4096 records) with an
+optional JSONL file sink; both the clock and the buffer are injectable
+so fault drills assert on bit-reproducible records.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from typing import Dict, IO, Iterable, List, Optional
+
+__all__ = ["SCHEMA_VERSION", "EventLog", "get_event_log",
+           "set_event_log", "read_jsonl"]
+
+SCHEMA_VERSION = 1
+
+
+class EventLog:
+    """In-memory ring buffer of event dicts + optional JSONL sink.
+
+    `clock` is injectable (drills pass a fake); `path` opens an append
+    sink whose lines are flushed per record (events must survive the
+    crash legs — a torn final line is tolerated by `read_jsonl`)."""
+
+    def __init__(self, capacity: int = 4096,
+                 path: Optional[str] = None, clock=None):
+        import time as _time
+
+        self._clock = clock or _time.time
+        self._ring: deque = deque(maxlen=capacity)
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._sink: Optional[IO[str]] = None
+        self.path = path
+        if path:
+            self._sink = open(path, "a")
+
+    # ------------------------------------------------------------- emit
+    def emit(self, kind: str, **fields) -> dict:
+        with self._lock:
+            rec = {"schema": SCHEMA_VERSION, "ts": self._clock(),
+                   "seq": self._seq, "kind": kind, **fields}
+            self._seq += 1
+            self._ring.append(rec)
+            if self._sink is not None:
+                self._sink.write(json.dumps(rec, sort_keys=True,
+                                            default=_jsonable) + "\n")
+                self._sink.flush()
+        return rec
+
+    # ------------------------------------------------------------ query
+    def events(self, kind: Optional[str] = None,
+               **match) -> List[dict]:
+        """Records (oldest first), optionally filtered by kind and by
+        exact field values (`events("request_terminal",
+        status="poisoned")`)."""
+        out = []
+        for rec in self._ring:
+            if kind is not None and rec["kind"] != kind:
+                continue
+            if any(rec.get(k) != v for k, v in match.items()):
+                continue
+            out.append(rec)
+        return out
+
+    def counts_by_kind(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for rec in self._ring:
+            out[rec["kind"]] = out.get(rec["kind"], 0) + 1
+        return dict(sorted(out.items()))
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._seq = 0
+
+    def close(self) -> None:
+        if self._sink is not None:
+            self._sink.close()
+            self._sink = None
+
+
+def _jsonable(o):
+    """Sink fallback for numpy scalars etc. — never let a telemetry
+    write throw out of a training/serving loop."""
+    try:
+        return o.item()
+    except AttributeError:
+        return repr(o)
+
+
+def read_jsonl(path: str) -> List[dict]:
+    """Parse a JSONL event file; a torn final line (crash mid-write)
+    is dropped, not an error."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail
+    return out
+
+
+# BIGDL_OBS_EVENTS=<path> attaches a JSONL file sink to the default
+# log at import — `BIGDL_OBS_EVENTS=/tmp/run.jsonl python train.py`
+# then `python scripts/obs_report.py /tmp/run.jsonl`
+import os as _os
+
+_log = EventLog(path=_os.environ.get("BIGDL_OBS_EVENTS") or None)
+
+
+def get_event_log() -> EventLog:
+    return _log
+
+
+def set_event_log(log: Optional[EventLog]) -> EventLog:
+    """Install an event log (None → fresh default); returns the active
+    one. (Explicit None check: an EMPTY EventLog is falsy via
+    __len__.) A fresh default re-attaches the BIGDL_OBS_EVENTS file
+    sink if the env var is set — resets must not silently drop the
+    operator's JSONL sink (append mode, so prior records survive)."""
+    global _log
+    if log is None:
+        log = EventLog(path=_os.environ.get("BIGDL_OBS_EVENTS") or None)
+    if log is not _log:
+        _log.close()   # don't leak the replaced log's file handle;
+        _log = log     # its in-memory ring stays readable
+    return _log

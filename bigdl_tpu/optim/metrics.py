@@ -4,6 +4,16 @@ Reference parity: optim/Metrics.scala (`set`, `add`, `summary`) — there a
 set of distributed accumulators aggregated to the driver and printed each
 iteration; here simple host-side aggregates (multi-host reduction happens
 naturally because every host computes identical global values under SPMD).
+
+ISSUE 5: every `add`/`set` also mirrors into the unified telemetry
+registry (`bigdl_tpu.obs`) — phase stopwatches become label-series of
+the `training_phase_seconds` histogram, scalar sets become gauges — so
+`optim.Metrics` is a thin front-end over the one process-wide metrics
+plane rather than a private dict. The local dict stays for the
+per-iteration `summary()` log line (running means, cheap). `Timer`
+additionally records a host span into the active tracer, so the
+training phases (data_fetch / dispatch / ...) appear on the
+Chrome-trace timeline next to the serving spans.
 """
 
 from __future__ import annotations
@@ -11,17 +21,30 @@ from __future__ import annotations
 import time
 from typing import Dict, Tuple
 
+from bigdl_tpu import obs
+
 
 class Metrics:
     def __init__(self):
         self._data: Dict[str, Tuple[float, int]] = {}
+        self._hist = obs.get_registry().histogram(
+            "training_phase_seconds",
+            "per-step phase stopwatches (optim.Metrics timers)",
+            labelnames=("phase",))
+        self._gauges = obs.get_registry().gauge(
+            "training_metric", "optim.Metrics scalar sets",
+            labelnames=("name",))
 
     def set(self, name: str, value: float) -> None:
         self._data[name] = (float(value), 1)
+        if obs.enabled():
+            self._gauges.labels(name=name).set(float(value))
 
     def add(self, name: str, value: float) -> None:
         total, n = self._data.get(name, (0.0, 0))
         self._data[name] = (total + float(value), n + 1)
+        if obs.enabled():
+            self._hist.labels(phase=name).observe(float(value))
 
     def get(self, name: str) -> float:
         total, n = self._data.get(name, (0.0, 0))
@@ -36,16 +59,21 @@ class Metrics:
 
 
 class Timer:
-    """Context-manager stopwatch feeding a Metrics entry."""
+    """Context-manager stopwatch feeding a Metrics entry (and, when the
+    span tracer is enabled, a host span of the same name)."""
 
     def __init__(self, metrics: Metrics, name: str):
         self.metrics = metrics
         self.name = name
 
     def __enter__(self):
+        self._span = obs.get_tracer().span(self.name.removesuffix("_s"),
+                                           cat="train")
+        self._span.__enter__()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
         self.metrics.add(self.name, time.perf_counter() - self._t0)
+        self._span.__exit__(None, None, None)
         return False

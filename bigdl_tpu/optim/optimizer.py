@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu import obs
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.dataset.sample import MiniBatch, Sample
 from bigdl_tpu.dataset.transformer import SampleToMiniBatch
@@ -266,6 +267,14 @@ class LocalOptimizer:
     def __init__(self, opt: Optimizer):
         self.o = opt
         self.metrics = Metrics()
+        # ONE emission path for step telemetry: registry + event log +
+        # TrainSummary sink + log line (obs/training.py; ISSUE 5 — the
+        # summary scalars and the log line used to be written by two
+        # separate blocks here and in DistriOptimizer)
+        from bigdl_tpu.obs.training import StepTelemetry
+
+        self.telemetry = StepTelemetry(summary=opt.train_summary,
+                                       log_every=opt.log_every)
 
     # --------------------------------------------------------- step builders
     def _make_step(self) -> Callable:
@@ -571,11 +580,13 @@ class LocalOptimizer:
                      ok_d, gnorm_d) = self._step(
                         *step_args,
                         jnp.asarray(guard.threshold(), jnp.float32))
+            ok_host, gnorm_host = True, None
             if guard is not None:
                 # scalar fetch syncs the step — the documented cost of
                 # arming the guard (utils/anomaly.py); an anomalous
                 # update was already discarded on device either way
-                action = guard.observe(bool(ok_d), float(gnorm_d),
+                ok_host, gnorm_host = bool(ok_d), float(gnorm_d)
+                action = guard.observe(ok_host, gnorm_host,
                                        train_state["neval"])
                 if action == "rollback":
                     self._require_rollback_checkpoint()
@@ -596,8 +607,8 @@ class LocalOptimizer:
             # steps/micro-batches were discarded on device
             if o.grad_accum == 1:
                 train_state["nupdates"] += 1 if guard is None \
-                    else int(bool(ok_d))
-            elif guard is None or bool(ok_d):
+                    else int(ok_host)
+            elif guard is None or ok_host:
                 micro_seen[0] += 1
                 if micro_seen[0] == o.grad_accum:
                     train_state["nupdates"] += 1
@@ -625,7 +636,8 @@ class LocalOptimizer:
                 if pt is not None and pt(train_state):
                     hists = [(name, np.asarray(leaf)) for name, leaf
                              in o.model.parameters(variables)]
-            pending = (dict(train_state), loss, lr, throughput, hists)
+            pending = (dict(train_state), loss, lr, throughput, real,
+                       hists, gnorm_host, ok_host)
 
             # ---- epoch rollover (the reference counts records vs dataset size)
             if train_state["records"] >= dataset_size:
@@ -662,11 +674,12 @@ class LocalOptimizer:
                     if mn:  # mid-cycle: persist the partial accumulator
                         accum_state = {"g_acc": jax.device_get(acc),
                                        "micro_n": mn}
-                path = o.checkpoint.save(train_state["neval"], variables, slots,
-                                         {k: train_state[k] for k in
-                                          ("epoch", "neval", "nupdates",
-                                           "records")},
-                                         accum_state=accum_state)
+                with Timer(self.metrics, "checkpoint_s"):
+                    path = o.checkpoint.save(
+                        train_state["neval"], variables, slots,
+                        {k: train_state[k] for k in
+                         ("epoch", "neval", "nupdates", "records")},
+                        accum_state=accum_state)
                 logger.info("checkpoint -> %s", path)
 
         # end trigger may fire mid-accumulation-cycle: flush the partial
@@ -690,22 +703,30 @@ class LocalOptimizer:
         return o.model
 
     def _emit(self, pending) -> None:
-        """Write log line + TB scalars for an already-dispatched step;
+        """Telemetry for an already-dispatched step — registry + event
+        + TrainSummary sink + log line, all through StepTelemetry;
         called one step late so the loss fetch overlaps device compute.
-        Histogram data arrives pre-materialized (see run()): the live
-        param buffers are donated to the next step before _emit runs."""
+        The float(loss) here IS the fence for step N (timed as the
+        `fence_s` phase). Histogram data arrives pre-materialized (see
+        run()): the live param buffers are donated to the next step
+        before _emit runs."""
+        state, loss, lr, throughput, real, hists, gnorm, ok = pending
         o = self.o
-        state, loss, lr, throughput, hists = pending
-        epoch, neval = state["epoch"], state["neval"]
-        if o.train_summary is not None:
-            s = o.train_summary
-            s.add_scalar("Loss", float(loss), neval)
-            s.add_scalar("Throughput", throughput, neval)
-            s.add_scalar("LearningRate", lr, neval)
-            for name, data in (hists or ()):
-                s.add_histogram(name, data, neval)
-        if neval % o.log_every == 0:
-            logger.info(
-                "epoch %d iter %d loss %.6f lr %.5g %.1f rec/s [%s]",
-                epoch, neval, float(loss), lr, throughput,
-                self.metrics.summary())
+        # the loss fetch piggybacks on the sinks that always needed it
+        # (summary scalars, the log line); telemetry alone NEVER adds
+        # a device→host sync — on a non-fence step the event simply
+        # omits the loss field (StepTelemetry contract)
+        fence = (o.train_summary is not None
+                 or state["neval"] % o.log_every == 0)
+        if not (fence or obs.enabled()):
+            return
+        if fence:
+            with Timer(self.metrics, "fence_s"):
+                loss = float(loss)
+        else:
+            loss = None
+        self.telemetry.emit_step(
+            epoch=state["epoch"], step=state["neval"], loss=loss,
+            lr=lr, throughput=throughput, records=real,
+            update_applied=ok, gnorm=gnorm, hists=hists,
+            metrics_summary=self.metrics.summary())

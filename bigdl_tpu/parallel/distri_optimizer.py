@@ -348,11 +348,13 @@ class DistriOptimizer(LocalOptimizer):
                     continue
                 raise
 
+            ok_host, gnorm_host = True, None
             if guard is not None:
                 # scalar fetch syncs the step (the documented guard
                 # cost); the anomalous update is already discarded on
                 # device — the host only applies policy
-                action = guard.observe(bool(ok_d), float(gnorm_d),
+                ok_host, gnorm_host = bool(ok_d), float(gnorm_d)
+                action = guard.observe(ok_host, gnorm_host,
                                        train_state["neval"])
                 if action == "rollback":
                     self._require_rollback_checkpoint()
@@ -370,7 +372,7 @@ class DistriOptimizer(LocalOptimizer):
                 # next batch; the applied-update clock only advances on
                 # healthy steps (accum>1 advances at apply_fn above)
                 train_state["nupdates"] += 1 if guard is None \
-                    else int(bool(ok_d))
+                    else int(ok_host)
             train_state["records"] += real
             train_state["loss"] = loss
             now = time.perf_counter()
@@ -378,17 +380,30 @@ class DistriOptimizer(LocalOptimizer):
             self.metrics.add("iter_s", iter_wall)
             throughput = real / max(iter_wall, 1e-9)
 
-            if o.train_summary is not None:
-                s = o.train_summary
-                s.add_scalar("Loss", float(loss), train_state["neval"])
-                s.add_scalar("Throughput", throughput, train_state["neval"])
-                s.add_scalar("LearningRate", lr, train_state["neval"])
+            # one emission path (obs/training.StepTelemetry): registry
+            # + event log + TrainSummary sink + log line. The
+            # float(loss) fence only runs on steps that always fetched
+            # it (summary sink armed, or a log_every step) — telemetry
+            # alone never adds a device→host sync; off-fence events
+            # omit the loss field (piggyback contract), and with
+            # everything off the step skips emission entirely so the
+            # host can run ahead of the device
+            from bigdl_tpu import obs
 
-            if train_state["neval"] % o.log_every == 0:
-                logger.info(
-                    "epoch %d iter %d loss %.6f lr %.5g %.1f rec/s [%s]",
-                    train_state["epoch"], train_state["neval"], float(loss),
-                    lr, throughput, self.metrics.summary())
+            fence = (o.train_summary is not None
+                     or train_state["neval"] % o.log_every == 0)
+            if fence or obs.enabled():
+                loss_host = None
+                if fence:
+                    with Timer(self.metrics, "fence_s"):
+                        loss_host = float(loss)
+                self.telemetry.emit_step(
+                    epoch=train_state["epoch"],
+                    step=train_state["neval"],
+                    loss=loss_host, lr=lr, throughput=throughput,
+                    records=real, update_applied=ok_host,
+                    gnorm=gnorm_host,
+                    metrics_summary=self.metrics.summary())
 
             if train_state["records"] >= dataset_size:
                 train_state["epoch"] += 1
@@ -420,14 +435,17 @@ class DistriOptimizer(LocalOptimizer):
                 if micro_n:  # mid-cycle: persist the partial accumulator
                     accum_state = {"g_acc": self._gather(g_acc),
                                    "micro_n": micro_n}
-                path = o.checkpoint.save(
-                    train_state["neval"], saved_variables,
-                    self._gather(slots),
-                    {k: train_state[k] for k in
-                     ("epoch", "neval", "nupdates", "records")},
-                    optim_meta={"layout": "zero1_flat", "num_shards": n,
-                                "total": spec.total, "padded": spec.padded},
-                    accum_state=accum_state)
+                with Timer(self.metrics, "checkpoint_s"):
+                    path = o.checkpoint.save(
+                        train_state["neval"], saved_variables,
+                        self._gather(slots),
+                        {k: train_state[k] for k in
+                         ("epoch", "neval", "nupdates", "records")},
+                        optim_meta={"layout": "zero1_flat",
+                                    "num_shards": n,
+                                    "total": spec.total,
+                                    "padded": spec.padded},
+                        accum_state=accum_state)
                 if nproc > 1:
                     # barrier: no host may run ahead (and potentially
                     # recover from this checkpoint) until host 0 has

@@ -279,6 +279,10 @@ class Checkpoint:
         os.rename(tmp, d)
         if os.path.isdir(old):
             shutil.rmtree(old)
+        from bigdl_tpu import obs
+
+        obs.emit_event("checkpoint_save", step=int(step), path=d,
+                       mid_cycle=accum_state is not None)
         if plan.fires("ckpt_corrupt", step):
             # bit-rot model: the publish succeeded, the bytes did not
             # survive — load() must detect this and fall back
@@ -343,6 +347,9 @@ class Checkpoint:
         model_variables, meta = load_pytree(d, self.MODEL)
         optim_state, optim_meta = load_pytree(d, self.OPTIM)
         self._last_loaded = d
+        from bigdl_tpu import obs
+
+        obs.emit_event("checkpoint_load", path=d)
         if with_optim_meta:
             return (model_variables, optim_state, meta.get("train_state", {}),
                     optim_meta)
@@ -371,6 +378,10 @@ class Checkpoint:
             except (CheckpointCorruptError, FileNotFoundError) as e:
                 self.corrupt_skipped.append(d)
                 last_err = e
+                from bigdl_tpu import obs
+
+                obs.emit_event("checkpoint_corrupt_skipped", path=d,
+                               error=str(e))
                 logger.warning(
                     "checkpoint %s failed verification (%s); falling "
                     "back to the previous checkpoint", d, e)

@@ -103,6 +103,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from bigdl_tpu import obs
 from bigdl_tpu.serving.bucketing import (bucket_for, bucket_histogram,
                                          default_buckets, pad_tokens)
 from bigdl_tpu.serving.sampler import sample_logits
@@ -120,6 +121,13 @@ OVERLOAD_POLICIES = ("reject", "shed-oldest", "shed-lowest-priority")
 _STATUS_COUNTER = {"done": "requests_done", "shed": "shed",
                    "expired": "deadline_misses", "poisoned": "poisoned",
                    "failed": "failed"}
+# reverse view: stats key → terminal status (registry label)
+_COUNTER_STATUS = {v: k for k, v in _STATUS_COUNTER.items()}
+
+# per-process engine index — the registry label distinguishing
+# co-resident engines' series (deterministic within a process run, so
+# drill snapshots stay bit-reproducible)
+_ENGINE_IDS = itertools.count()
 
 # process-wide trace tallies for the SHARED jitted steps below; an
 # engine snapshots them at creation and reports its own deltas
@@ -245,7 +253,8 @@ class InferenceEngine:
                  step_timeout_s: Optional[float] = None,
                  step_retries: int = 0,
                  retry_backoff_s: float = 0.05,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 obs_label: Optional[str] = None):
         self.model = model
         self.variables = variables if variables is not None \
             else model.variables
@@ -284,6 +293,42 @@ class InferenceEngine:
             "poisoned": 0, "failed": 0, "retries": 0,
             "watchdog_trips": 0, "cancelled": 0,
         }
+        # ---- telemetry plane (ISSUE 5): every _stats increment also
+        # mirrors into the process-wide registry under this engine's
+        # label; decode-step latency feeds a FIXED-BUCKET histogram
+        # (bounded memory for a long-lived engine — replaces the old
+        # per-engine recent-latency deque) and health() percentiles
+        # are estimated from its buckets. Children are resolved once
+        # here (per the ACTIVE registry — install custom telemetry
+        # before building engines); the per-step cost is an int add +
+        # a bisect. `obs_label`: a replacement engine (the documented
+        # degrade-and-rebuild path) should pass its predecessor's
+        # health()["metrics"]["engine"] label to CONTINUE that series
+        # instead of growing the registry with one label set per
+        # rebuild.
+        self._obs_name = obs_label or f"engine{next(_ENGINE_IDS)}"
+        reg = obs.get_registry()
+        self._m_requests = reg.counter(
+            "serving_requests_total",
+            "requests reaching a terminal status",
+            labelnames=("engine", "status"))
+        op_help = {
+            "prefill_calls": "prefill dispatches",
+            "decode_steps": "batched decode steps",
+            "retries": "decode-step retries",
+            "watchdog_trips": "step-watchdog trips",
+            "rejected": "submissions rejected under overload",
+            "cancelled": "host-side cancellations",
+        }
+        self._m_ops = {
+            key: reg.counter(f"serving_{key}_total", help_,
+                             labelnames=("engine",)
+                             ).labels(engine=self._obs_name)
+            for key, help_ in op_help.items()}
+        self._m_lat = reg.histogram(
+            "serving_decode_step_seconds",
+            "decode dispatch+fetch wall seconds",
+            labelnames=("engine",)).labels(engine=self._obs_name)
         self._trace0 = dict(_TRACES)
         # finished results not yet handed back by a run(requests=...)
         # call — retrievable here (results are never silently dropped)
@@ -300,7 +345,6 @@ class InferenceEngine:
         self._topk = np.zeros(slots, np.int32)
         self._topp = np.ones(slots, np.float32)
         self._meta: Dict[int, Dict[str, float]] = {}  # id → submit time
-        self._lat: deque = deque(maxlen=256)     # recent step seconds
         self._degraded: Optional[str] = None
         if step_timeout_s is not None:
             # arming the watchdog opts into a warmup decode at
@@ -331,15 +375,21 @@ class InferenceEngine:
 
     def health(self) -> Dict[str, object]:
         """Operational snapshot: engine state, slot occupancy, queue
-        depth + per-bucket composition, p50/p95 decode-step latency
-        (over the last 256 steps), and every reliability counter."""
-        lat = sorted(self._lat)
+        depth + per-bucket composition, p50/p95 decode-step latency,
+        and every reliability counter.
 
+        Percentiles are estimated from the registry's FIXED-BUCKET
+        latency histogram over the engine's whole lifetime — bounded
+        memory however long the engine lives (ISSUE 5: previously a
+        recent-sample deque). None before the first decode step. The
+        histogram is fed unconditionally (core health bookkeeping,
+        like `stats` — BIGDL_OBS=off gates events/spans/counter
+        mirrors, not this). `metrics` is the raw registry view of
+        this engine's series, for scrapers that want more than two
+        percentiles."""
         def pct(q):
-            if not lat:
-                return None
-            return round(lat[min(len(lat) - 1, int(q * len(lat)))]
-                         * 1e3, 3)
+            v = self._m_lat.quantile(q)
+            return None if v is None else round(v * 1e3, 3)
 
         s = self._stats
         return {
@@ -359,6 +409,16 @@ class InferenceEngine:
             "failed": s["failed"], "cancelled": s["cancelled"],
             "requests_done": s["requests_done"],
             "decode_steps": s["decode_steps"],
+            "metrics": {
+                "engine": self._obs_name,
+                "decode_step_seconds": {
+                    "count": self._m_lat.count,
+                    "sum": round(self._m_lat.sum, 6),
+                    "p50_ms": pct(0.50), "p95_ms": pct(0.95),
+                    "p99_ms": pct(0.99)},
+                "requests_total": {
+                    st: s[_STATUS_COUNTER[st]] for st in STATUSES},
+            },
         }
 
     # --------------------------------------------------------------- host
@@ -401,6 +461,9 @@ class InferenceEngine:
                 return request.id
         self._meta[request.id] = {"t": self._clock()}
         self._queue.append(request)
+        obs.emit_event("request_submit", plane="serving",
+                       engine=self._obs_name, request=request.id,
+                       prompt_len=n, priority=request.priority)
         return request.id
 
     def _overload(self, request: Request) -> None:
@@ -409,7 +472,10 @@ class InferenceEngine:
         `request` itself (shed-lowest-priority when it IS the lowest —
         its result lands in `completed` and submit returns its id)."""
         if self.overload_policy == "reject":
-            self._stats["rejected"] += 1
+            self._bump("rejected")
+            obs.emit_event("request_rejected", plane="serving",
+                           engine=self._obs_name, request=request.id,
+                           queue_depth=len(self._queue))
             raise OverloadError(
                 f"queue full ({self.max_queue}); request {request.id} "
                 "rejected (overload_policy='reject')")
@@ -432,11 +498,11 @@ class InferenceEngine:
         for r in self._queue:
             if r.id == request_id:
                 self._queue.remove(r)
-                self._stats["cancelled"] += 1
+                self._bump("cancelled")
                 return self._terminal(r, "cancelled", "shed")
         for i, r in enumerate(self._req):
             if r is not None and r.id == request_id:
-                self._stats["cancelled"] += 1
+                self._bump("cancelled")
                 res = self._finish(i, "cancelled", "shed")
                 self.completed[res.id] = res
                 return res
@@ -450,12 +516,46 @@ class InferenceEngine:
             return math.inf
         return self._meta[req.id]["t"] + req.deadline_s
 
+    def _bump(self, key: str, n: int = 1) -> None:
+        """One increment path: the engine-local stats dict (always,
+        core bookkeeping) plus the registry mirror (when telemetry is
+        on). Terminal-status keys land in serving_requests_total
+        {engine,status}; operational keys in their own counters."""
+        self._stats[key] += n
+        if not obs.enabled():
+            return
+        status = _COUNTER_STATUS.get(key)
+        if status is not None:
+            self._m_requests.labels(engine=self._obs_name,
+                                    status=status).inc(n)
+        else:
+            self._m_ops[key].inc(n)
+
+    def _observe_terminal(self, req: Request, reason: str, status: str,
+                          tokens: int) -> None:
+        """Telemetry for a request's terminal transition: structured
+        event + (tracer on) a whole-lifecycle span stamped with the
+        ENGINE clock, so deadline drills trace deterministically."""
+        if not obs.enabled():
+            return
+        now = self._clock()
+        obs.emit_event("request_terminal", plane="serving",
+                       engine=self._obs_name, request=req.id,
+                       status=status, reason=reason, tokens=tokens)
+        tracer = obs.get_tracer()
+        if tracer.enabled:
+            t0 = self._meta.get(req.id, {}).get("t", now)
+            tracer.complete(f"request[{status}]", "serving", t0, now,
+                            args={"request": req.id, "reason": reason,
+                                  "tokens": tokens})
+
     def _terminal(self, req: Request, reason: str, status: str
                   ) -> GenerationResult:
         """Terminal event for a request that never reached (or is no
         longer in) a slot — result goes straight to `completed`."""
+        self._observe_terminal(req, reason, status, 0)
         self._meta.pop(req.id, None)
-        self._stats[_STATUS_COUNTER[status]] += 1
+        self._bump(_STATUS_COUNTER[status])
         res = GenerationResult(req.id, list(req.prompt), [], reason,
                                status)
         self.completed[req.id] = res
@@ -495,6 +595,13 @@ class InferenceEngine:
             prompt = list(req.prompt)
             b = bucket_for(len(prompt), self.buckets)
             toks = pad_tokens(prompt, b)[None, :]          # (1, bucket)
+            tracer = obs.get_tracer()
+            t_admit = self._clock()
+            if tracer.enabled:
+                # the queued phase closes when the slot is granted
+                t_sub = self._meta.get(req.id, {}).get("t", t_admit)
+                tracer.complete("queued", "serving", t_sub, t_admit,
+                                args={"request": req.id, "slot": slot})
             with warnings.catch_warnings():
                 # donation is a per-call no-op warning on CPU backends;
                 # on TPU it aliases the cache update in place
@@ -503,7 +610,12 @@ class InferenceEngine:
                 self.cache = _prefill_step(
                     self.model, self.cache_dtype, self._params,
                     self.cache, jnp.asarray(toks), np.int32(slot))
-            self._stats["prefill_calls"] += 1
+            if tracer.enabled:
+                tracer.complete("prefill", "serving", t_admit,
+                                self._clock(),
+                                args={"request": req.id, "slot": slot,
+                                      "bucket": int(b)})
+            self._bump("prefill_calls")
             self._req[slot] = req
             self._gen[slot] = []
             self._pos[slot] = len(prompt) - 1   # re-decode last prompt tok
@@ -519,11 +631,13 @@ class InferenceEngine:
         req = self._req[slot]
         res = GenerationResult(req.id, list(req.prompt),
                                self._gen[slot], reason, status)
+        self._observe_terminal(req, reason, status,
+                               len(self._gen[slot]))
         self._req[slot] = None
         self._gen[slot] = []
         self._temp[slot] = 0.0
         self._meta.pop(req.id, None)
-        self._stats[_STATUS_COUNTER[status]] += 1
+        self._bump(_STATUS_COUNTER[status])
         return res
 
     def _scrub_slot(self, slot: int) -> None:
@@ -552,6 +666,8 @@ class InferenceEngine:
         straight to `completed`)."""
         self._degraded = reason
         logger.error("serving engine degraded: %s", reason)
+        obs.emit_event("engine_degraded", plane="serving",
+                       engine=self._obs_name, reason=reason)
         out = [self._finish(i, "failed", "failed")
                for i, r in enumerate(self._req) if r is not None]
         for r in list(self._queue):
@@ -633,11 +749,26 @@ class InferenceEngine:
                 if plan.fires("serve_slow", stepno):
                     slow_s = (self.step_timeout_s or 0.05) * 5
                 t0 = time.perf_counter()
+                tc0 = self._clock()
                 nxt, finite = self._dispatch_and_fetch(poison, slow_s)
-                self._lat.append(time.perf_counter() - t0)
+                # dispatch+fetch wall time into the fixed-bucket
+                # histogram UNCONDITIONALLY: health() percentiles are
+                # core engine bookkeeping (this store replaced the
+                # recent-latency deque), not optional telemetry — the
+                # kill switch gates events/spans/counter mirrors only
+                self._m_lat.observe(time.perf_counter() - t0)
+                if obs.enabled():
+                    tracer = obs.get_tracer()
+                    if tracer.enabled:
+                        tracer.complete(
+                            "decode_step", "serving", tc0,
+                            self._clock(),
+                            args={"step": stepno,
+                                  "active": sum(r is not None
+                                                for r in self._req)})
                 break
             except StepTimeout as e:
-                self._stats["watchdog_trips"] += 1
+                self._bump("watchdog_trips")
                 return self._degrade(
                     f"watchdog trip at decode step {stepno}: {e}")
             except Exception as e:              # noqa: BLE001
@@ -655,12 +786,12 @@ class InferenceEngine:
                     return self._degrade(
                         f"decode step {stepno} failed after "
                         f"{attempt + 1} attempt(s): {e}")
-                self._stats["retries"] += 1
+                self._bump("retries")
                 logger.warning("decode step %d attempt %d failed (%s); "
                                "retrying", stepno, attempt + 1, e)
                 if self.retry_backoff_s:
                     time.sleep(self.retry_backoff_s * (2 ** attempt))
-        self._stats["decode_steps"] += 1
+        self._bump("decode_steps")
         now = self._clock()
         done = []
         for i, req in enumerate(self._req):

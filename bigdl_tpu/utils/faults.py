@@ -107,6 +107,11 @@ class FaultPlan:
         self._budget[key] = left - 1
         self.fired.append(key)
         logger.warning("fault injected: %s@%d", kind, step)
+        # every shot that fires is a structured event — the drills
+        # assert on telemetry, not stdout (ISSUE 5)
+        from bigdl_tpu import obs
+
+        obs.emit_event("fault_injected", fault=kind, step=int(step))
         return True
 
     def maybe_raise(self, kind: str, step: int) -> None:

@@ -14,8 +14,20 @@ any tier-1 invocation and teeing to a log, e.g.:
         --durations=0 --durations-min=0.05 2>&1 | tee /tmp/_t1.log
     python scripts/check_tier1_budget.py --log /tmp/_t1.log
 
-Exit codes: 0 within budget, 1 over budget, 2 no durations found in
-the log (wrong file, or the run omitted --durations).
+Telemetry-overhead mode (ISSUE 5): pass `--baseline-log` with a
+durations dump from a `BIGDL_OBS=off` run of the same suite and the
+check ALSO fails if the telemetry-on run (`--log`) adds more than
+`--max-delta-pct` (default 2%) over the baseline on the recorded
+durations — the registry/event/span plane must stay effectively free:
+
+    BIGDL_OBS=off JAX_PLATFORMS=cpu python -m pytest ... | tee /tmp/_t1_off.log
+    JAX_PLATFORMS=cpu python -m pytest ...             | tee /tmp/_t1.log
+    python scripts/check_tier1_budget.py --log /tmp/_t1.log \
+        --baseline-log /tmp/_t1_off.log
+
+Exit codes: 0 within budget, 1 over budget (runtime OR telemetry
+delta), 2 no durations found in the log (wrong file, or the run
+omitted --durations).
 
 Projection note: the durations dump counts per-test setup/call/teardown
 only; interpreter start, collection and module imports ride on top, so
@@ -54,6 +66,20 @@ def projected_runtime_s(entries: List[Tuple[float, str, str]],
     return sum(e[0] for e in entries) + overhead_s
 
 
+def telemetry_delta_pct(on_entries: List[Tuple[float, str, str]],
+                        off_entries: List[Tuple[float, str, str]]
+                        ) -> float:
+    """Relative runtime the telemetry-on suite adds over the
+    telemetry-off baseline, in percent (negative = faster). Sums the
+    recorded phases only — interpreter/collection overhead cancels
+    between the two runs by construction."""
+    on_s = sum(e[0] for e in on_entries)
+    off_s = sum(e[0] for e in off_entries)
+    if off_s <= 0:
+        raise ValueError("baseline durations sum to zero")
+    return (on_s - off_s) / off_s * 100.0
+
+
 def slowest_tests(entries: List[Tuple[float, str, str]],
                   top: int = 10) -> List[Tuple[float, str]]:
     """Top test ids by total time across phases."""
@@ -75,6 +101,13 @@ def main(argv=None) -> int:
                          "durations sum")
     ap.add_argument("--top", type=int, default=10,
                     help="how many slowest tests to list")
+    ap.add_argument("--baseline-log", default=None,
+                    help="durations dump from a BIGDL_OBS=off run of "
+                         "the same suite; enables the telemetry-"
+                         "overhead check")
+    ap.add_argument("--max-delta-pct", type=float, default=2.0,
+                    help="max %% the telemetry-on suite may add over "
+                         "--baseline-log")
     args = ap.parse_args(argv)
 
     try:
@@ -95,12 +128,32 @@ def main(argv=None) -> int:
           f"(= {projected - args.overhead_s:.0f}s measured across "
           f"{len(entries)} phases + {args.overhead_s:.0f}s overhead) "
           f"vs budget {args.budget:.0f}s — {verdict}")
-    if projected > args.budget:
+    failed = projected > args.budget
+    if failed:
         print(f"slowest {args.top} tests:")
         for secs, name in slowest_tests(entries, args.top):
             print(f"  {secs:8.2f}s  {name}")
-        return 1
-    return 0
+
+    if args.baseline_log is not None:
+        try:
+            with open(args.baseline_log) as f:
+                base_entries = parse_durations(f.read())
+        except OSError as e:
+            print(f"tier1-budget: cannot read baseline "
+                  f"{args.baseline_log}: {e}")
+            return 2
+        if not base_entries:
+            print(f"tier1-budget: no --durations entries in baseline "
+                  f"{args.baseline_log}")
+            return 2
+        delta = telemetry_delta_pct(entries, base_entries)
+        over = delta > args.max_delta_pct
+        print(f"tier1-budget: telemetry-on adds {delta:+.2f}% over "
+              f"the BIGDL_OBS=off baseline (limit "
+              f"{args.max_delta_pct:.2f}%) — "
+              f"{'OVER LIMIT' if over else 'ok'}")
+        failed = failed or over
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

@@ -60,6 +60,15 @@ TOKENS bit-for-bit against a clean or run-alone reference — so
 "recovered"/"isolated" means "indistinguishable from never having
 failed", not merely "didn't crash".
 
+ISSUE 5: drill outcomes are asserted against the unified telemetry
+plane — every leg runs under a fresh event log + metrics registry
+(`_telemetry()`), and "the fault fired / the guard acted / the request
+reached status X" is read from structured events (fault_injected,
+anomaly, checkpoint_*, request_terminal, engine_degraded), not from
+stdout or private state. Each leg's JSON gains an `events` section
+(counts by kind) so the machine-readable drill record is
+self-describing.
+
 Usage:
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python scripts/fault_drill.py            # all legs, both planes
@@ -72,6 +81,7 @@ CI: tests/test_fault_drill.py runs these legs on every tier-1 pass.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -134,6 +144,27 @@ def _train(workdir, end_iter, *, faults="", guard=None, mesh=False,
     return _flat(trained), opt, plan
 
 
+@contextlib.contextmanager
+def _telemetry():
+    """Fresh event log + metrics registry for one drilled run, so the
+    leg's assertions read exactly that run's telemetry; both are
+    restored to fresh defaults afterwards (no cross-leg leakage). The
+    captured log stays readable through the yielded reference.
+    Telemetry is force-ENABLED for the drilled run (and the previous
+    switch state restored): the drills assert on events, so they must
+    opt in even when the surrounding process runs BIGDL_OBS=off (the
+    tier-1 telemetry-overhead baseline does exactly that)."""
+    from bigdl_tpu import obs
+
+    prev = obs.set_enabled(True)
+    obs.reset_all()
+    try:
+        yield obs.get_event_log()
+    finally:
+        obs.reset_all()
+        obs.set_enabled(prev)
+
+
 # ------------------------------------------------------------------ legs
 
 def drill_nan_skip(workdir, mesh=False):
@@ -148,13 +179,23 @@ def drill_nan_skip(workdir, mesh=False):
     against an unguarded run."""
     ref, _, _ = _train(workdir, end_iter=4, guard="skip_step", mesh=mesh,
                        tag="nsr")
-    got, opt, plan = _train(workdir, end_iter=5, faults="nan@4",
-                            guard="skip_step", mesh=mesh, tag="nsf")
+    with _telemetry() as log:
+        got, opt, plan = _train(workdir, end_iter=5, faults="nan@4",
+                                guard="skip_step", mesh=mesh, tag="nsf")
     g = opt.anomaly_guard
+    injected = log.events("fault_injected", fault="nan", step=4)
+    skipped = log.events("anomaly", action="skipped", step=4)
+    steps = log.events("train_step")
     return {"ok": bool(np.array_equal(ref, got)) and g.skipped == 1
-            and ("nan", 4) in plan.fired,
+            and len(injected) == 1 and len(skipped) == 1
+            and len(steps) == 5
+            # the poisoned iteration is the last one (neval 4 at
+            # consult time → train_step event step=5): its update was
+            # discarded on device
+            and not steps[-1]["update_applied"]
+            and all(s["update_applied"] for s in steps[:-1]),
             "bit_identical_to_pre_step": bool(np.array_equal(ref, got)),
-            "guard": g.stats(), "fired": plan.fired}
+            "guard": g.stats(), "events": log.counts_by_kind()}
 
 
 def drill_rollback(workdir):
@@ -165,13 +206,18 @@ def drill_rollback(workdir):
     compiled graph)."""
     ref, _, _ = _train(workdir, end_iter=8, guard="rollback", ckpt_iter=3,
                        tag="rbr")
-    got, opt, plan = _train(workdir, end_iter=8, faults="nan@5",
-                            guard="rollback", ckpt_iter=3, tag="rbf")
+    with _telemetry() as log:
+        got, opt, plan = _train(workdir, end_iter=8, faults="nan@5",
+                                guard="rollback", ckpt_iter=3, tag="rbf")
     g = opt.anomaly_guard
+    injected = log.events("fault_injected", fault="nan", step=5)
+    rolled = log.events("anomaly", action="rollback", step=5)
+    reloads = log.events("checkpoint_load")
     return {"ok": bool(np.array_equal(ref, got)) and g.rollbacks == 1
-            and ("nan", 5) in plan.fired,
+            and len(injected) == 1 and len(rolled) == 1
+            and len(reloads) == 1,         # the rollback reload itself
             "bit_identical": bool(np.array_equal(ref, got)),
-            "guard": g.stats(), "fired": plan.fired}
+            "guard": g.stats(), "events": log.counts_by_kind()}
 
 
 def drill_step_retry(workdir):
@@ -179,24 +225,30 @@ def drill_step_retry(workdir):
     retry budget reloads checkpoint-3 and replays to a bit-identical
     finish (the reference's reload-last-checkpoint recovery)."""
     ref, _, _ = _train(workdir, end_iter=8, mesh=True, tag="srr")
-    got, _, plan = _train(workdir, end_iter=8, faults="step@5",
-                          mesh=True, ckpt_iter=3, tag="srf")
+    with _telemetry() as log:
+        got, _, plan = _train(workdir, end_iter=8, faults="step@5",
+                              mesh=True, ckpt_iter=3, tag="srf")
+    injected = log.events("fault_injected", fault="step", step=5)
+    reloads = log.events("checkpoint_load")
     return {"ok": bool(np.array_equal(ref, got))
-            and ("step", 5) in plan.fired,
+            and len(injected) == 1 and len(reloads) == 1,
             "bit_identical": bool(np.array_equal(ref, got)),
-            "fired": plan.fired}
+            "events": log.counts_by_kind()}
 
 
 def drill_data_retry(workdir):
     """Data-loader failure at stream position 5: enters the same retry
     path from the batch iterator instead of the step dispatch."""
     ref, _, _ = _train(workdir, end_iter=8, mesh=True, tag="drr")
-    got, _, plan = _train(workdir, end_iter=8, faults="data@5",
-                          mesh=True, ckpt_iter=3, tag="drf")
+    with _telemetry() as log:
+        got, _, plan = _train(workdir, end_iter=8, faults="data@5",
+                              mesh=True, ckpt_iter=3, tag="drf")
+    injected = log.events("fault_injected", fault="data", step=5)
+    reloads = log.events("checkpoint_load")
     return {"ok": bool(np.array_equal(ref, got))
-            and ("data", 5) in plan.fired,
+            and len(injected) == 1 and len(reloads) == 1,
             "bit_identical": bool(np.array_equal(ref, got)),
-            "fired": plan.fired}
+            "events": log.counts_by_kind()}
 
 
 def drill_ckpt_torn(workdir):
@@ -208,21 +260,32 @@ def drill_ckpt_torn(workdir):
 
     ref, _, _ = _train(workdir, end_iter=6, tag="ctr")
     died = False
-    try:
-        _train(workdir, end_iter=6, faults="ckpt_torn@4", ckpt_iter=2,
-               tag="ctf")
-    except FaultInjected:
-        died = True  # the modeled crash
+    with _telemetry() as log:
+        try:
+            _train(workdir, end_iter=6, faults="ckpt_torn@4",
+                   ckpt_iter=2, tag="ctf")
+        except FaultInjected:
+            died = True  # the modeled crash
     ckdir = os.path.join(workdir, "ctf")
     leftovers = [d for d in os.listdir(ckdir) if d.endswith(".inprogress")]
-    got, opt, _ = _train(workdir, end_iter=6, ckpt_iter=2, resume=True,
-                         tag="ctf")
+    # the torn save fired AND never published: a fault_injected event
+    # with no checkpoint_save for that step
+    torn = log.events("fault_injected", fault="ckpt_torn", step=4)
+    torn_saves = [e for e in log.events("checkpoint_save")
+                  if e["step"] == 4]
+    with _telemetry() as rlog:
+        got, opt, _ = _train(workdir, end_iter=6, ckpt_iter=2,
+                             resume=True, tag="ctf")
+    resumed = rlog.events("checkpoint_load")
     latest = opt.checkpoint.latest()
-    return {"ok": died and bool(leftovers)
+    return {"ok": died and bool(leftovers) and len(torn) == 1
+            and not torn_saves and len(resumed) == 1
             and bool(np.array_equal(ref, got)),
             "crashed_mid_write": died, "staging_leftovers": leftovers,
             "latest_after_resume": os.path.basename(latest or ""),
-            "bit_identical": bool(np.array_equal(ref, got))}
+            "bit_identical": bool(np.array_equal(ref, got)),
+            "events": log.counts_by_kind(),
+            "resume_events": rlog.counts_by_kind()}
 
 
 def drill_ckpt_fallback(workdir):
@@ -232,15 +295,21 @@ def drill_ckpt_fallback(workdir):
     ref, _, _ = _train(workdir, end_iter=9, tag="cfr")
     _train(workdir, end_iter=7, faults="ckpt_corrupt@6", ckpt_iter=3,
            tag="cff")
-    got, opt, _ = _train(workdir, end_iter=9, ckpt_iter=3, resume=True,
-                         tag="cff")
-    skipped = [os.path.basename(d) for d in opt.checkpoint.corrupt_skipped]
+    with _telemetry() as log:
+        got, opt, _ = _train(workdir, end_iter=9, ckpt_iter=3,
+                             resume=True, tag="cff")
+    skipped_ev = log.events("checkpoint_corrupt_skipped")
+    loaded_ev = log.events("checkpoint_load")
+    skipped = [os.path.basename(e["path"]) for e in skipped_ev]
+    resumed_from = os.path.basename(loaded_ev[0]["path"]) \
+        if loaded_ev else ""
     return {"ok": "checkpoint-6" in skipped
+            and resumed_from == "checkpoint-3"
             and bool(np.array_equal(ref, got)),
             "corrupt_skipped": skipped,
-            "resumed_from": os.path.basename(
-                opt.checkpoint._last_loaded or ""),
-            "bit_identical": bool(np.array_equal(ref, got))}
+            "resumed_from": resumed_from,
+            "bit_identical": bool(np.array_equal(ref, got)),
+            "events": log.counts_by_kind()}
 
 
 # ---------------------------------------------------------- serving legs
@@ -300,67 +369,88 @@ def drill_serve_poison(workdir):
 
     fm = _plan("serve_nan@2")
     try:
-        eng = _engine()
-        got_a, got_b = eng.run([_req(**A), _req(**B)])
-        # slot 0 (A's) was poisoned and scrubbed — reuse it
-        reuse = eng.run([_req(**A)])[0]
-        fired = fm.get_plan().fired
+        with _telemetry() as log:
+            eng = _engine()
+            got_a, got_b = eng.run([_req(**A), _req(**B)])
+            # slot 0 (A's) was poisoned and scrubbed — reuse it
+            reuse = eng.run([_req(**A)])[0]
     finally:
         fm.set_plan(None)
+    injected = log.events("fault_injected", fault="serve_nan", step=2)
+    poisoned = log.events("request_terminal", status="poisoned")
+    done = log.events("request_terminal", status="done")
     ok = (got_a.status == "poisoned" and len(got_a.tokens) == 2
           and got_b.status == "done" and got_b.tokens == alone_b.tokens
           and reuse.tokens == alone_a2.tokens
-          and eng.stats["poisoned"] == 1
-          and ("serve_nan", 2) in fired)
+          and len(injected) == 1
+          and len(poisoned) == 1 and poisoned[0]["tokens"] == 2
+          and len(done) == 2)                # co-batch B + reuse probe
     return {"ok": bool(ok), "poisoned_status": got_a.status,
             "poisoned_tokens_kept": len(got_a.tokens),
             "cobatch_bit_identical": got_b.tokens == alone_b.tokens,
             "slot_reuse_bit_identical": reuse.tokens == alone_a2.tokens,
-            "fired": fired}
+            "events": log.counts_by_kind()}
 
 
 def drill_serve_overload(workdir):
     """Bounded queue, all three policies: reject raises OverloadError;
     shed-oldest evicts the longest-queued request; shed-lowest-priority
     evicts the lowest priority (or the newcomer when IT is lowest)."""
+    from bigdl_tpu import obs
     from bigdl_tpu.serving import OverloadError
 
-    # reject
-    e1 = _engine(max_queue=1, overload_policy="reject")
-    e1.submit(_req(prompt=[1, 2]))
-    rejected = False
-    try:
-        e1.submit(_req(prompt=[3, 4]))
-    except OverloadError:
-        rejected = True
-    # shed-oldest
-    e2 = _engine(max_queue=2, overload_policy="shed-oldest")
-    old = e2.submit(_req(prompt=[1, 2], seed=1))
-    e2.submit(_req(prompt=[3, 4], seed=2))
-    e2.submit(_req(prompt=[5, 6], seed=3))       # sheds `old`
-    shed_oldest = (old in e2.completed
-                   and e2.completed[old].status == "shed")
-    done2 = e2.run()
-    # shed-lowest-priority: queued low-priority victim...
-    e3 = _engine(max_queue=2, overload_policy="shed-lowest-priority")
-    low = e3.submit(_req(prompt=[1, 2], priority=1))
-    e3.submit(_req(prompt=[3, 4], priority=5))
-    e3.submit(_req(prompt=[5, 6], priority=3))   # sheds `low`
-    shed_low = (low in e3.completed
-                and e3.completed[low].status == "shed")
-    # ...and the newcomer itself when IT is the lowest
-    new = e3.submit(_req(prompt=[7, 8], priority=0))
-    shed_new = (new in e3.completed
-                and e3.completed[new].status == "shed")
-    e3.run()
-    ok = (rejected and e1.stats["rejected"] == 1
-          and shed_oldest and e2.stats["shed"] == 1
+    with _telemetry() as log:
+        # reject
+        e1 = _engine(max_queue=1, overload_policy="reject")
+        e1.submit(_req(prompt=[1, 2]))
+        rejected = False
+        try:
+            e1.submit(_req(prompt=[3, 4]))
+        except OverloadError:
+            rejected = True
+        # shed-oldest
+        e2 = _engine(max_queue=2, overload_policy="shed-oldest")
+        old = e2.submit(_req(prompt=[1, 2], seed=1))
+        e2.submit(_req(prompt=[3, 4], seed=2))
+        e2.submit(_req(prompt=[5, 6], seed=3))       # sheds `old`
+        shed_oldest = (old in e2.completed
+                       and e2.completed[old].status == "shed")
+        done2 = e2.run()
+        # shed-lowest-priority: queued low-priority victim...
+        e3 = _engine(max_queue=2,
+                     overload_policy="shed-lowest-priority")
+        low = e3.submit(_req(prompt=[1, 2], priority=1))
+        e3.submit(_req(prompt=[3, 4], priority=5))
+        e3.submit(_req(prompt=[5, 6], priority=3))   # sheds `low`
+        shed_low = (low in e3.completed
+                    and e3.completed[low].status == "shed")
+        # ...and the newcomer itself when IT is the lowest
+        new = e3.submit(_req(prompt=[7, 8], priority=0))
+        shed_new = (new in e3.completed
+                    and e3.completed[new].status == "shed")
+        e3.run()
+        # outcomes from the telemetry plane: one rejection event,
+        # three shed terminals, and the registry mirrors of the same
+        # counters (snapshot INSIDE the capture — its exit restores a
+        # fresh registry)
+        snap = obs.get_registry().snapshot()["metrics"]
+    shed_ev = log.events("request_terminal", status="shed")
+    rej_ev = log.events("request_rejected")
+    shed_reg = sum(
+        s["value"] for s in snap.get("serving_requests_total",
+                                     {"series": []})["series"]
+        if s["labels"].get("status") == "shed")
+    ok = (rejected and len(rej_ev) == 1
+          and shed_oldest and shed_low and shed_new
+          and len(shed_ev) == 3 and shed_reg == 3
           and all(r.status == "done" for r in done2
-                  if r.status != "shed")
-          and shed_low and shed_new and e3.stats["shed"] == 2)
+                  if r.status != "shed"))
     return {"ok": bool(ok), "rejected": rejected,
             "shed_oldest": shed_oldest, "shed_lowest": shed_low,
-            "shed_new_lowest": shed_new}
+            "shed_new_lowest": shed_new,
+            "shed_events": len(shed_ev),
+            "shed_counter": shed_reg,
+            "events": log.counts_by_kind()}
 
 
 def drill_serve_deadline(workdir):
@@ -368,35 +458,39 @@ def drill_serve_deadline(workdir):
     request expires with 0 tokens while both slots are busy; a decoding
     request expires mid-generation keeping its partial tokens."""
     clk = {"t": 0.0}
-    # expiry while QUEUED: both slots busy with 8-token requests, the
-    # queued request's 3 s TTL passes at 1 s/step
-    eng = _engine(clock=lambda: clk["t"])
-    eng.submit(_req(prompt=[1, 2], max_new_tokens=8, seed=1))
-    eng.submit(_req(prompt=[3, 4], max_new_tokens=8, seed=2))
-    qid = eng.submit(_req(prompt=[5, 6], deadline_s=3.0))
-    while eng._queue or any(r is not None for r in eng._req):
-        for res in eng.step():
-            eng.completed[res.id] = res
-        clk["t"] += 1.0
-    queued_exp = eng.completed[qid]
-    # expiry while DECODING: deadline 2 s passes after the 3rd token
-    clk["t"] = 0.0
-    eng2 = _engine(clock=lambda: clk["t"])
-    did = eng2.submit(_req(prompt=[1, 2, 3], max_new_tokens=8,
-                           deadline_s=2.0))
-    while eng2._queue or any(r is not None for r in eng2._req):
-        for res in eng2.step():
-            eng2.completed[res.id] = res
-        clk["t"] += 1.0
-    dec_exp = eng2.completed[did]
+    with _telemetry() as log:
+        # expiry while QUEUED: both slots busy with 8-token requests,
+        # the queued request's 3 s TTL passes at 1 s/step
+        eng = _engine(clock=lambda: clk["t"])
+        eng.submit(_req(prompt=[1, 2], max_new_tokens=8, seed=1))
+        eng.submit(_req(prompt=[3, 4], max_new_tokens=8, seed=2))
+        qid = eng.submit(_req(prompt=[5, 6], deadline_s=3.0))
+        while eng._queue or any(r is not None for r in eng._req):
+            for res in eng.step():
+                eng.completed[res.id] = res
+            clk["t"] += 1.0
+        queued_exp = eng.completed[qid]
+        # expiry while DECODING: deadline 2 s passes after the 3rd
+        # token
+        clk["t"] = 0.0
+        eng2 = _engine(clock=lambda: clk["t"])
+        did = eng2.submit(_req(prompt=[1, 2, 3], max_new_tokens=8,
+                               deadline_s=2.0))
+        while eng2._queue or any(r is not None for r in eng2._req):
+            for res in eng2.step():
+                eng2.completed[res.id] = res
+            clk["t"] += 1.0
+        dec_exp = eng2.completed[did]
+    expired = log.events("request_terminal", status="expired")
     ok = (queued_exp.status == "expired" and queued_exp.tokens == []
           and dec_exp.status == "expired" and len(dec_exp.tokens) == 3
-          and eng.stats["deadline_misses"] == 1
-          and eng2.stats["deadline_misses"] == 1)
+          and len(expired) == 2
+          and sorted(e["tokens"] for e in expired) == [0, 3])
     return {"ok": bool(ok), "queued_status": queued_exp.status,
             "queued_tokens": len(queued_exp.tokens),
             "decoding_status": dec_exp.status,
-            "decoding_tokens_kept": len(dec_exp.tokens)}
+            "decoding_tokens_kept": len(dec_exp.tokens),
+            "events": log.counts_by_kind()}
 
 
 def drill_serve_retry(workdir):
@@ -408,28 +502,38 @@ def drill_serve_retry(workdir):
     ref = _engine().run([_req(**A)])[0]
     fm = _plan("serve_err@1")
     try:
-        eng = _engine(step_retries=1, retry_backoff_s=0.0)
-        got = eng.run([_req(**A)])[0]
-        fired = fm.get_plan().fired
+        with _telemetry() as log:
+            eng = _engine(step_retries=1, retry_backoff_s=0.0)
+            got = eng.run([_req(**A)])[0]
     finally:
         fm.set_plan(None)
     transient_ok = (got.status == "done" and got.tokens == ref.tokens
                     and eng.stats["retries"] == 1
-                    and ("serve_err", 1) in fired)
+                    and len(log.events("fault_injected",
+                                       fault="serve_err", step=1)) == 1
+                    and len(log.events("request_terminal",
+                                       status="done")) == 1
+                    and not log.events("engine_degraded"))
     fm = _plan("serve_err@1x3")
     try:
-        eng2 = _engine(step_retries=1, retry_backoff_s=0.0)
-        got2 = eng2.run([_req(**A)])[0]
+        with _telemetry() as log2:
+            eng2 = _engine(step_retries=1, retry_backoff_s=0.0)
+            got2 = eng2.run([_req(**A)])[0]
     finally:
         fm.set_plan(None)
+    degraded_ev = log2.events("engine_degraded")
     persistent_ok = (got2.status == "failed" and len(got2.tokens) == 1
-                     and eng2.degraded is not None
+                     and len(degraded_ev) == 1
+                     and len(log2.events("request_terminal",
+                                         status="failed")) == 1
                      and eng2.stats["retries"] == 1)
     return {"ok": bool(transient_ok and persistent_ok),
             "transient_bit_identical": got.tokens == ref.tokens,
             "retries": eng.stats["retries"],
             "persistent_status": got2.status,
-            "persistent_degraded": eng2.degraded is not None}
+            "persistent_degraded": bool(degraded_ev),
+            "events": log.counts_by_kind(),
+            "persistent_events": log2.counts_by_kind()}
 
 
 def drill_serve_watchdog(workdir):
@@ -445,8 +549,9 @@ def drill_serve_watchdog(workdir):
     ref = _engine().run([_req(**A)])[0]          # clean tokens oracle
     fm = _plan("serve_slow@1")
     try:
-        eng = _engine(step_timeout_s=0.05)
-        got = eng.run([_req(**A), _req(**B)])
+        with _telemetry() as log:
+            eng = _engine(step_timeout_s=0.05)
+            got = eng.run([_req(**A), _req(**B)])
     finally:
         fm.set_plan(None)
     h = eng.health()
@@ -455,15 +560,21 @@ def drill_serve_watchdog(workdir):
         eng.submit(_req(prompt=[1]))
     except EngineDegraded:
         quiesced = True
+    degraded_ev = log.events("engine_degraded")
+    failed_ev = log.events("request_terminal", status="failed")
     ok = (all(r.status == "failed" for r in got)
           and got[0].tokens == ref.tokens[:1]    # step-0 token kept
           and h["state"] == "degraded" and h["watchdog_trips"] == 1
+          and len(degraded_ev) == 1
+          and "watchdog" in degraded_ev[0]["reason"]
+          and len(failed_ev) == 2
           and quiesced)
     return {"ok": bool(ok),
             "statuses": [r.status for r in got],
             "tokens_before_trip": [len(r.tokens) for r in got],
             "watchdog_trips": h["watchdog_trips"], "state": h["state"],
-            "quiesced": quiesced}
+            "quiesced": quiesced,
+            "events": log.counts_by_kind()}
 
 
 TRAINING_LEGS = {

@@ -1,0 +1,201 @@
+"""Render a run summary from a telemetry JSONL event file (ISSUE 5).
+
+Input: a file written by the structured event log
+(`BIGDL_OBS_EVENTS=/tmp/run.jsonl python <anything>`, or an explicit
+`EventLog(path=...)`). Output: a human-readable report —
+
+* event counts by kind (the run's shape at a glance)
+* training summary: steps, loss first→last, throughput, anomalies
+* serving summary: requests by terminal status, tokens generated,
+  degradations
+* metrics tables + latency percentiles, when the file carries a
+  `metrics_snapshot` event (`obs.log_metrics_snapshot()` embeds the
+  registry, making the JSONL self-contained)
+* a timeline tail (the last N events)
+
+Measurement caveat (CLAUDE.md): wall-clock numbers recorded around
+un-fenced device dispatch measure dispatch, not compute —
+`block_until_ready` can lie through remote-device transports. Trust
+`train_step`/`decode_step` timings only where the emitting loop fenced
+them with a real device→host fetch (the shipped instrumentation does:
+the loss fetch fences training steps, the token fetch fences decode).
+
+Usage:
+    python scripts/obs_report.py /tmp/run.jsonl [--tail 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# THE bucket-quantile estimator and series-key rendering, shared with
+# the live registry so report percentiles/keys can never drift from
+# engine.health()'s or bench-row provenance
+from bigdl_tpu.obs.registry import (quantile_from_buckets,  # noqa: E402
+                                    series_key)
+
+
+def summarize(events: List[dict]) -> Dict[str, object]:
+    """Machine-readable digest of an event list (the report renders
+    this; tests assert on it)."""
+    out: Dict[str, object] = {"total_events": len(events)}
+    by_kind: Dict[str, int] = {}
+    for e in events:
+        by_kind[e.get("kind", "?")] = by_kind.get(e.get("kind", "?"), 0) + 1
+    out["by_kind"] = dict(sorted(by_kind.items()))
+
+    steps = [e for e in events if e.get("kind") == "train_step"]
+    if steps:
+        # loss is omitted on non-fence steps (no summary/log sink
+        # needed it, so the loop never fetched it) — report from the
+        # steps that carry one
+        losses = [s["loss"] for s in steps if "loss" in s]
+        out["training"] = {
+            "steps": len(steps),
+            "first_loss": losses[0] if losses else None,
+            "last_loss": losses[-1] if losses else None,
+            "mean_throughput": round(
+                sum(s["throughput"] for s in steps) / len(steps), 2),
+            "updates_applied": sum(
+                1 for s in steps if s.get("update_applied", True)),
+            "anomalies": by_kind.get("anomaly", 0),
+        }
+    term = [e for e in events if e.get("kind") == "request_terminal"]
+    if term:
+        by_status: Dict[str, int] = {}
+        for e in term:
+            by_status[e["status"]] = by_status.get(e["status"], 0) + 1
+        out["serving"] = {
+            "requests": len(term),
+            "by_status": dict(sorted(by_status.items())),
+            "tokens_generated": sum(e.get("tokens", 0) for e in term),
+            "degradations": by_kind.get("engine_degraded", 0),
+            "rejected": by_kind.get("request_rejected", 0),
+        }
+    faults = [e for e in events if e.get("kind") == "fault_injected"]
+    if faults:
+        out["faults"] = [f'{e["fault"]}@{e["step"]}' for e in faults]
+    ckpt = {k: by_kind.get(k, 0) for k in
+            ("checkpoint_save", "checkpoint_load",
+             "checkpoint_corrupt_skipped") if by_kind.get(k)}
+    if ckpt:
+        out["checkpoints"] = ckpt
+
+    snaps = [e for e in events if e.get("kind") == "metrics_snapshot"]
+    if snaps:
+        out["metrics"] = _digest_snapshot(snaps[-1]["snapshot"])
+    return out
+
+
+def _digest_snapshot(snapshot: dict) -> dict:
+    """Counters/gauges verbatim; histograms → count/sum/p50/p95/p99."""
+    out = {}
+    for name, fam in sorted(snapshot.get("metrics", {}).items()):
+        for s in fam["series"]:
+            label = series_key(name, s["labels"])
+            if fam["kind"] == "histogram":
+                out[label] = {
+                    "count": s["count"], "sum": round(s["sum"], 6),
+                    "p50": quantile_from_buckets(
+                        s["buckets"], s["counts"], 0.50),
+                    "p95": quantile_from_buckets(
+                        s["buckets"], s["counts"], 0.95),
+                    "p99": quantile_from_buckets(
+                        s["buckets"], s["counts"], 0.99)}
+            else:
+                out[label] = s["value"]
+    return out
+
+
+def _fmt_table(rows: List[tuple], indent: str = "  ") -> str:
+    if not rows:
+        return ""
+    w = max(len(str(r[0])) for r in rows)
+    return "\n".join(f"{indent}{str(k):<{w}}  {v}" for k, v in rows)
+
+
+def render(events: List[dict], tail: int = 15) -> str:
+    s = summarize(events)
+    lines = [f"telemetry report — {s['total_events']} events"]
+    lines.append("\nevents by kind:")
+    lines.append(_fmt_table(sorted(s["by_kind"].items())))
+    if "training" in s:
+        t = s["training"]
+        lines.append("\ntraining:")
+        loss_txt = "n/a" if t["first_loss"] is None else \
+            f"{t['first_loss']:.6g} -> {t['last_loss']:.6g}"
+        lines.append(_fmt_table([
+            ("steps", t["steps"]),
+            ("loss", loss_txt),
+            ("mean throughput", f"{t['mean_throughput']} rec/s"),
+            ("updates applied", f"{t['updates_applied']}/{t['steps']}"),
+            ("anomalies", t["anomalies"])]))
+    if "serving" in s:
+        v = s["serving"]
+        lines.append("\nserving:")
+        lines.append(_fmt_table(
+            [("requests", v["requests"]),
+             ("tokens generated", v["tokens_generated"]),
+             ("degradations", v["degradations"]),
+             ("rejected", v["rejected"])]
+            + [(f"status {k}", n)
+               for k, n in v["by_status"].items()]))
+    if "faults" in s:
+        lines.append("\ninjected faults: " + ", ".join(s["faults"]))
+    if "checkpoints" in s:
+        lines.append("\ncheckpoints:")
+        lines.append(_fmt_table(sorted(s["checkpoints"].items())))
+    if "metrics" in s:
+        lines.append("\nmetrics (last snapshot):")
+        rows = []
+        for k, v in s["metrics"].items():
+            if isinstance(v, dict):
+                pcts = "/".join(
+                    "-" if v[p] is None else f"{v[p] * 1e3:.3g}ms"
+                    for p in ("p50", "p95", "p99"))
+                rows.append((k, f"n={v['count']} sum={v['sum']}s "
+                                f"p50/p95/p99={pcts}"))
+            else:
+                rows.append((k, v))
+        lines.append(_fmt_table(rows))
+    if tail and events:
+        lines.append(f"\ntimeline (last {min(tail, len(events))}):")
+        rows = []
+        for e in events[-tail:]:
+            extra = {k: v for k, v in e.items()
+                     if k not in ("schema", "ts", "seq", "kind",
+                                  "snapshot")}
+            rows.append((f"[{e.get('seq', '?')}] {e.get('kind')}",
+                         " ".join(f"{k}={v}" for k, v in extra.items())))
+        lines.append(_fmt_table(rows))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", help="JSONL event file (EventLog sink / "
+                                 "BIGDL_OBS_EVENTS)")
+    ap.add_argument("--tail", type=int, default=15,
+                    help="timeline tail length (0 disables)")
+    args = ap.parse_args(argv)
+    from bigdl_tpu.obs.events import read_jsonl
+
+    try:
+        events = read_jsonl(args.path)
+    except OSError as e:
+        print(f"obs-report: cannot read {args.path}: {e}")
+        return 2
+    if not events:
+        print(f"obs-report: no events in {args.path}")
+        return 2
+    print(render(events, tail=args.tail))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,3 +47,49 @@ def test_main_verdicts(tmp_path, capsys):
     log.write_text("no durations here\n")
     assert m.main(["--log", str(log)]) == 2
     assert m.main(["--log", str(tmp_path / "missing.log")]) == 2
+
+
+def _scaled_log(factor):
+    """_SYNTHETIC with every duration multiplied by `factor`."""
+    out = []
+    for line in _SYNTHETIC.splitlines():
+        e = _load().parse_durations(line)
+        if e:
+            secs, phase, test = e[0]
+            out.append(f"{secs * factor:.2f}s {phase}     {test}")
+        else:
+            out.append(line)
+    return "\n".join(out) + "\n"
+
+
+def test_telemetry_delta(tmp_path, capsys):
+    """ISSUE 5 satellite: the budget guard also fails when the
+    telemetry-on suite adds >max-delta-pct over the BIGDL_OBS=off
+    baseline durations."""
+    m = _load()
+    on, off = tmp_path / "on.log", tmp_path / "off.log"
+    off.write_text(_SYNTHETIC)
+    # +1% — within the 2% default limit
+    on.write_text(_scaled_log(1.01))
+    assert m.main(["--log", str(on), "--baseline-log", str(off),
+                   "--budget", "500"]) == 0
+    # +5% — over the limit (runtime budget itself still fine)
+    on.write_text(_scaled_log(1.05))
+    assert m.main(["--log", str(on), "--baseline-log", str(off),
+                   "--budget", "500"]) == 1
+    out = capsys.readouterr().out
+    assert "OVER LIMIT" in out
+    # a tighter explicit limit flips the verdict the other way too
+    on.write_text(_scaled_log(1.01))
+    assert m.main(["--log", str(on), "--baseline-log", str(off),
+                   "--budget", "500", "--max-delta-pct", "0.5"]) == 1
+    # unreadable/empty baseline is a usage error, not a pass
+    assert m.main(["--log", str(on), "--baseline-log",
+                   str(tmp_path / "missing.log"),
+                   "--budget", "500"]) == 2
+    off.write_text("nothing recorded\n")
+    assert m.main(["--log", str(on), "--baseline-log", str(off),
+                   "--budget", "500"]) == 2
+    # pure function: delta math
+    a = m.parse_durations(_SYNTHETIC)
+    assert m.telemetry_delta_pct(a, a) == 0.0
