@@ -1,0 +1,13 @@
+"""graftlint rule set — importing this package registers every rule
+with `bigdl_tpu.analysis.engine.RULES`."""
+
+from bigdl_tpu.analysis.rules import (  # noqa: F401
+    hidden_device_sync,
+    missing_reference_docstring,
+    nondeterministic_drill,
+    retrace_hazard,
+    telemetry_bypass,
+    tf_import_in_core,
+    trace_env_read,
+    unfenced_timing,
+)

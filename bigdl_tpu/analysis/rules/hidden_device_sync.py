@@ -1,0 +1,73 @@
+"""hidden-device-sync — no device→host fetches on hot/emission paths.
+
+Two contracts meet here:
+
+* obs emission consumes ALREADY-FETCHED host values — zero new device
+  syncs (tests/test_obs.py pins compile counts; a sync hiding in an
+  emission helper would stall the decode loop once per event);
+* the serving decode loop performs exactly ONE deliberate fetch per
+  step (the watchdog-guarded `np.asarray` in `_dispatch_and_fetch`) —
+  any other `.item()`/`np.asarray`/`device_get` on that path is a
+  stealth round-trip through the axon tunnel.
+
+The deliberate fetch carries an inline
+`# graftlint: disable=hidden-device-sync` with its justification;
+everything else is a finding. Scope: all of `bigdl_tpu/obs/`, plus
+hot-path functions (decode/prefill/step/dispatch/sample/work/emit/
+observe) in `serving/`, `ops/kv_cache.py` and `models/transformer.py`.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from bigdl_tpu.analysis.engine import Rule, register
+from bigdl_tpu.analysis.rules._common import call_name, last_segment
+
+_SYNC_CALLS = {"np.asarray", "numpy.asarray", "np.array",
+               "numpy.array", "jax.device_get", "device_get",
+               "jax.block_until_ready"}
+_SYNC_METHODS = {"item", "block_until_ready", "tolist", "__array__"}
+_HOT_FN = re.compile(
+    r"(decode|prefill|dispatch|step|sample|work|emit|observe)")
+
+
+@register
+class HiddenDeviceSync(Rule):
+    name = "hidden-device-sync"
+    severity = "error"
+    description = ("device→host fetch on a decode/step hot path or "
+                   "obs emission path")
+    scope = ("bigdl_tpu/obs/", "bigdl_tpu/serving/",
+             "bigdl_tpu/ops/kv_cache.py",
+             "bigdl_tpu/models/transformer.py")
+
+    def _in_scope(self, ctx, node) -> bool:
+        fns = ctx.enclosing_functions(node)
+        if not fns:
+            return False
+        if ctx.path.startswith("bigdl_tpu/obs/"):
+            return True
+        return any(_HOT_FN.search(fn.name) for fn in fns)
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            hit = None
+            if name in _SYNC_CALLS:
+                hit = name
+            elif isinstance(node.func, ast.Attribute) \
+                    and not node.args and not node.keywords \
+                    and last_segment(name) in _SYNC_METHODS:
+                hit = f".{last_segment(name)}()"
+            if hit is None or not self._in_scope(ctx, node):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"{hit} forces a device→host sync on a hot/emission "
+                f"path — consume already-fetched host values (the one "
+                f"deliberate per-step fetch carries an inline "
+                f"suppression with its why)")

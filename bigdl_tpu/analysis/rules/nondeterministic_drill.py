@@ -1,0 +1,67 @@
+"""nondeterministic-drill — drill/serving code uses the injectable
+clock and seeded RNG, never the wall clock or global `random`.
+
+The fault drills (scripts/fault_drill.py) are bit-deterministic by
+contract: every leg asserts exact counters/events, which only works
+because the engine clock is injectable (`InferenceEngine(clock=)`) and
+every random stream is explicitly seeded (np.random.RandomState(seed),
+jax.random.PRNGKey). A `time.time()` or bare `random.random()` on
+those paths reintroduces run-to-run drift that CPU CI can't
+distinguish from a real regression.
+
+Allowed: *references* to clock functions (e.g. the
+`clock: Callable = time.monotonic` default — that IS the injection
+point), `time.sleep` (models injected stragglers; not a clock read),
+seeded constructors (`np.random.RandomState(...)`,
+`np.random.default_rng(...)`), and all of `jax.random.*`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bigdl_tpu.analysis.engine import Rule, register
+from bigdl_tpu.analysis.rules._common import call_name
+
+_CLOCK_CALLS = {"time.time", "time.monotonic", "time.perf_counter",
+                "time.monotonic_ns", "time.perf_counter_ns",
+                "datetime.now", "datetime.datetime.now",
+                "datetime.utcnow"}
+_RNG_OK = {"np.random.RandomState", "numpy.random.RandomState",
+           "np.random.default_rng", "numpy.random.default_rng",
+           "np.random.SeedSequence", "numpy.random.SeedSequence"}
+
+
+@register
+class NondeterministicDrill(Rule):
+    name = "nondeterministic-drill"
+    severity = "error"
+    description = ("wall clock / unseeded RNG in drill or serving "
+                   "code — use the injectable clock / seeded streams")
+    scope = ("bigdl_tpu/serving/", "bigdl_tpu/utils/faults.py",
+             "scripts/fault_drill.py")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            if name in _CLOCK_CALLS:
+                yield self.finding(
+                    ctx, node,
+                    f"{name}() bypasses the injectable clock — "
+                    f"thread the engine/drill clock "
+                    f"(InferenceEngine(clock=...)) so drills stay "
+                    f"bit-deterministic")
+            elif (name.startswith(("random.", "np.random.",
+                                   "numpy.random."))
+                  and name not in _RNG_OK
+                  and not name.startswith(("np.random.RandomState.",
+                                           "numpy.random.RandomState."))):
+                yield self.finding(
+                    ctx, node,
+                    f"{name}() draws from a global/unseeded stream — "
+                    f"use np.random.RandomState(seed) or "
+                    f"jax.random with an explicit key")

@@ -1,0 +1,102 @@
+"""retrace-hazard — no Python-value branches on traced arguments
+inside jit roots.
+
+A `jit`-decorated function branching on a *traced* argument either
+concretization-errors (`if x > 0:`) or, when the value sneaks in as a
+Python scalar (a non-static kwarg, a `float()`/`bool()` coercion),
+silently retraces per distinct value — the resharding/retrace hazard
+class of arXiv 2004.13336, and the reason the serving plane pins the
+#buckets+1 compile contract.
+
+The rule inspects functions decorated `@jax.jit` /
+`@functools.partial(jax.jit, ...)`: an `if`/`while` test or a
+`bool()`/`float()`/`int()` coercion that touches a *bare* non-static
+parameter is flagged. Shape metadata (`x.shape`, `x.ndim`, `x.dtype`,
+`len(x)`, `isinstance(x, ...)`) is static under trace and allowed, as
+are parameters named in `static_argnums`/`static_argnames`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bigdl_tpu.analysis.engine import Rule, register
+from bigdl_tpu.analysis.rules._common import call_name, functions, \
+    jit_decoration, param_names
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                 "itemsize"}
+_STATIC_FNS = {"len", "isinstance", "getattr", "hasattr", "type"}
+_COERCIONS = {"bool", "float", "int"}
+
+
+@register
+class RetraceHazard(Rule):
+    name = "retrace-hazard"
+    severity = "warning"
+    description = ("Python-value branch/coercion on a traced argument "
+                   "inside a jit root")
+    scope = ("bigdl_tpu/",)
+
+    def check(self, ctx):
+        for fn in functions(ctx.tree):
+            jit = jit_decoration(fn)
+            if jit is None:
+                continue
+            nums, names = jit
+            params = param_names(fn)
+            traced = {p for i, p in enumerate(params)
+                      if i not in nums and p not in names}
+            traced.discard("self")
+            yield from self._check_fn(ctx, fn, traced)
+
+    def _bare_traced_names(self, ctx, expr, traced):
+        """Name nodes of traced params used by VALUE (not via static
+        metadata like .shape/.ndim, len(), or an `is None` pytree-
+        structure test — all static under trace)."""
+        out = []
+        for node in ast.walk(expr):
+            if not (isinstance(node, ast.Name) and node.id in traced):
+                continue
+            parent = ctx.parent(node)
+            if isinstance(parent, ast.Attribute) \
+                    and parent.value is node \
+                    and parent.attr in _STATIC_ATTRS:
+                continue
+            if isinstance(parent, ast.Call) \
+                    and call_name(parent) in _STATIC_FNS \
+                    and node in parent.args:
+                continue
+            if isinstance(parent, ast.Compare) \
+                    and all(isinstance(op, (ast.Is, ast.IsNot))
+                            for op in parent.ops) \
+                    and all(isinstance(c, ast.Constant)
+                            and c.value is None
+                            for c in parent.comparators):
+                continue  # `x is (not) None`: argument-structure test
+            out.append(node)
+        return out
+
+    def _check_fn(self, ctx, fn, traced):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                for name in self._bare_traced_names(ctx, node.test,
+                                                    traced):
+                    kind = "while" if isinstance(node, ast.While) \
+                        else "if"
+                    yield self.finding(
+                        ctx, node,
+                        f"`{kind}` on traced argument "
+                        f"`{name.id}` inside a jit root — "
+                        f"concretizes/retraces per value; use "
+                        f"lax.cond/jnp.where, or mark the argument "
+                        f"static if it is host metadata")
+            elif isinstance(node, ast.Call) \
+                    and call_name(node) in _COERCIONS and node.args:
+                for name in self._bare_traced_names(ctx, node.args[0],
+                                                    traced):
+                    yield self.finding(
+                        ctx, node,
+                        f"{call_name(node)}() coerces traced argument "
+                        f"`{name.id}` to a Python value inside a jit "
+                        f"root — forces a sync or a per-value retrace")

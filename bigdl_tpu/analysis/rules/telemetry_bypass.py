@@ -1,0 +1,47 @@
+"""telemetry-bypass — core code reports through `bigdl_tpu.obs`/logging,
+never `print()`.
+
+The telemetry convention (CLAUDE.md): metrics/events/spans go through
+`bigdl_tpu.obs` ONLY, human-readable diagnostics through the
+`bigdl_tpu.*` loggers. A stray `print()` in library code bypasses the
+BIGDL_OBS kill switch, the event log, and every consumer parsing
+stdout (bench JSON rows, drill output).
+
+Scope is the `bigdl_tpu/` package only — scripts and examples are
+CLIs and own their stdout.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bigdl_tpu.analysis.engine import Rule, register
+from bigdl_tpu.analysis.rules._common import call_name
+
+_WRITES = {"sys.stdout.write", "sys.stderr.write"}
+
+
+@register
+class TelemetryBypass(Rule):
+    name = "telemetry-bypass"
+    severity = "error"
+    description = ("print()/direct stdout writes in core — route "
+                   "through logging or bigdl_tpu.obs")
+    scope = ("bigdl_tpu/",)
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name == "print":
+                yield self.finding(
+                    ctx, node,
+                    "print() in core bypasses the obs plane and the "
+                    "BIGDL_OBS kill switch — use "
+                    "logging.getLogger('bigdl_tpu.*') for diagnostics "
+                    "or bigdl_tpu.obs for telemetry")
+            elif name in _WRITES:
+                yield self.finding(
+                    ctx, node,
+                    f"{name} in core — use logging or bigdl_tpu.obs")

@@ -1,0 +1,36 @@
+"""tf-import-in-core — TensorFlow is a test oracle, never a core dep.
+
+The image ships TensorFlow for oracle comparisons
+(tests/test_tf_interop.py) only; `bigdl_tpu/` interop uses the bundled
+wire-compatible protos (`bigdl_tpu/utils/tf/`). A TF import in core
+would drag a second ML runtime into every user process.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bigdl_tpu.analysis.engine import Rule, register
+
+
+@register
+class TfImportInCore(Rule):
+    name = "tf-import-in-core"
+    severity = "error"
+    description = "core must not import TensorFlow (test oracle only)"
+    scope = ("bigdl_tpu/",)
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            mods = []
+            if isinstance(node, ast.Import):
+                mods = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                mods = [node.module]
+            for m in mods:
+                if m == "tensorflow" or m.startswith("tensorflow."):
+                    yield self.finding(
+                        ctx, node,
+                        f"import of {m!r} in core — TensorFlow is a "
+                        f"test oracle only; interop goes through the "
+                        f"bundled protos (bigdl_tpu/utils/tf)")

@@ -12,10 +12,14 @@ from __future__ import annotations
 
 import argparse
 import json
+import logging
+import sys
 import time
 from typing import Optional
 
 import numpy as np
+
+from bigdl_tpu import obs
 
 
 def _build_model(name: str, class_num: int):
@@ -165,7 +169,15 @@ def main(argv=None):
     result = run_perf(args.model, args.batch_size, args.iterations,
                       args.mesh, args.optimizer, args.class_num,
                       args.precision)
-    print(json.dumps(result))
+    # telemetry convention: results go through the obs plane + logger,
+    # never print (graftlint telemetry-bypass). The handler is pinned
+    # to STDOUT (basicConfig defaults to stderr) so the machine-read
+    # `... | jq .` contract of the old print() survives; force=True
+    # wins even if an import already configured the root logger
+    obs.emit_event("perf_result", plane="training", **result)
+    logging.basicConfig(level=logging.INFO, format="%(message)s",
+                        stream=sys.stdout, force=True)
+    logging.getLogger("bigdl_tpu.models").info(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -177,15 +177,16 @@ class HardSigmoid(_Elementwise):
 
 
 class Swish(_Elementwise):
-    """x·sigmoid(x) — SiLU (post-reference addition; torch.nn.SiLU is the
-    oracle)."""
+    """x·sigmoid(x) — SiLU. No reference counterpart (post-reference
+    addition; torch.nn.SiLU is the oracle)."""
 
     def _fn(self, x):
         return x * jax.nn.sigmoid(x)
 
 
 class Mish(_Elementwise):
-    """x·tanh(softplus(x)) (reference line's nn/Mish)."""
+    """x·tanh(softplus(x)) (reference: nn/Mish.scala — the reference
+    line's later snapshots)."""
 
     def _fn(self, x):
         return x * jnp.tanh(jax.nn.softplus(x))

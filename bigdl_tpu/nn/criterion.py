@@ -349,7 +349,9 @@ class CosineProximityCriterion(Criterion):
 
 
 class ChunkedSoftmaxCE(Criterion):
-    """Large-vocabulary softmax cross-entropy with model fusion.
+    """Large-vocabulary softmax cross-entropy with model fusion
+    (reference: nn/ClassNLLCriterion.scala + nn/LogSoftMax.scala,
+    fused — a TPU memory redesign of that pairing).
 
     The reference pairs nn/LogSoftMax.scala with nn/ClassNLLCriterion.
     scala — fine at its vocabulary sizes, but on a TPU LM the (B, S, V)

@@ -192,7 +192,9 @@ class LayerNorm(Module):
 
 
 class RMSNorm(Module):
-    """RMS normalization over the last axis (no mean subtraction)."""
+    """RMS normalization over the last axis (no mean subtraction).
+    No reference counterpart (post-reference transformer norm; kept
+    next to LayerNorm for the transformer stack)."""
 
     def __init__(self, size: int, eps: float = 1e-6,
                  name: Optional[str] = None):

@@ -12,12 +12,15 @@ full tensor, negative allowed), matching reference conventions.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 
 from bigdl_tpu.nn.module import Module
+
+logger = logging.getLogger("bigdl_tpu.nn")
 
 
 def _axis(dim: int, ndim: int) -> int:
@@ -129,11 +132,13 @@ class Identity(Module):
 
 
 class Echo(Module):
-    """Identity that prints its input shape — host-side debug only
-    (reference: nn/Echo.scala)."""
+    """Identity that logs its input shape — host-side debug only, fires
+    at trace time under jit (reference: nn/Echo.scala). Logs through
+    the `bigdl_tpu.nn` logger, not stdout (telemetry convention)."""
 
     def apply(self, variables, x, training=False, rng=None):
-        print(f"[{self.name}] shape={getattr(x, 'shape', None)} dtype={getattr(x, 'dtype', None)}")
+        logger.info("[%s] shape=%s dtype=%s", self.name,
+                    getattr(x, "shape", None), getattr(x, "dtype", None))
         return x, variables["state"]
 
 

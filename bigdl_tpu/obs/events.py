@@ -113,11 +113,18 @@ class EventLog:
 
 def _jsonable(o):
     """Sink fallback for numpy scalars etc. — never let a telemetry
-    write throw out of a training/serving loop."""
-    try:
-        return o.item()
-    except AttributeError:
-        return repr(o)
+    write throw out of a training/serving loop, and NEVER fetch a
+    device array: emission consumes already-fetched host values (the
+    obs contract), so a jax.Array reaching the sink is a caller bug —
+    it is repr'd, not synced (a silent `.item()` here would stall the
+    decode loop once per event through the axon tunnel)."""
+    import numpy as np
+
+    if isinstance(o, np.generic) or (isinstance(o, np.ndarray)
+                                     and o.ndim == 0):
+        # host-memory numpy scalar: .item() is a pure host conversion
+        return o.item()  # graftlint: disable=hidden-device-sync
+    return repr(o)
 
 
 def read_jsonl(path: str) -> List[dict]:

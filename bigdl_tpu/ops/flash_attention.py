@@ -28,18 +28,19 @@ so fully-masked rows produce zeros (not NaN) after normalization — the
 convention the ring combine relies on.
 
 Env tile overrides (`BIGDL_FLASH_FWD_TILES` / `BIGDL_FLASH_BWD_TILES`)
-are read at TRACE time: the value in the environment when a given
-(shape, dtype, flags) combination first compiles is baked into that
-executable, and changing the env afterwards is a silent no-op for
-shapes already in jit's cache. Sweeps must set the env before the first
-call — or run each config in a fresh process, as the sweep scripts do
-(scripts/sweep_attn_blocks.py, scripts/sweep_attn_bwd_tiles.py).
+are snapshotted at IMPORT via utils/envknobs — never read at trace
+time, so the value in the environment when `bigdl_tpu` is imported
+wins and later env mutations are visibly inert (graftlint
+`trace-env-read` guards the class). Sweeps set the env before the
+process starts — or run each config in a fresh process, as the sweep
+scripts do (scripts/sweep_attn_blocks.py,
+scripts/sweep_attn_bwd_tiles.py); in-process rotation requires an
+explicit `envknobs.refresh()` plus a fresh jit root per config.
 """
 
 from __future__ import annotations
 
 import functools
-import logging
 from typing import Optional, Tuple
 
 import jax
@@ -47,7 +48,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-logger = logging.getLogger("bigdl_tpu.ops")
+from bigdl_tpu.utils import envknobs
 
 _NEG_INF = -1e30
 _LOG2E = 1.4426950408889634  # MUST match between _bwd_recompute (s2) and _bwd_prep (lse2)
@@ -594,27 +595,6 @@ def _flash_bwd_pallas_fused(q, k, v, o, lse, do, causal, sm_scale,
 _FUSED_BWD_MAX_RESIDENT_BYTES = 13 * 1024 * 1024
 
 
-def _env_tiles(var):
-    """Parse a `BQxBK` tile override from the named env var (the
-    perf-tuning knobs the tile sweeps drive; see PROFILE_r05)."""
-    import os
-
-    v = os.environ.get(var)
-    if not v:
-        return None
-    try:
-        bq, bk = v.lower().split("x")
-        return int(bq), int(bk)
-    except ValueError:
-        raise ValueError(
-            f"{var}={v!r}: expected 'BQxBK', e.g. '512x1024'") from None
-
-
-def _env_bwd_tiles():
-    """`BIGDL_FLASH_BWD_TILES` — fused-backward tile override."""
-    return _env_tiles("BIGDL_FLASH_BWD_TILES")
-
-
 _FUSED_BWD_MAX_TILE = 1024 * 512  # bq*bk cap for the fused backward's
 # DEFAULT tile derivation (512x1024 at the default fwd blocks). Round-5
 # re-swept with the 64 MiB kernel-vmem limit: true 1024x1024 and
@@ -624,13 +604,30 @@ _FUSED_BWD_MAX_TILE = 1024 * 512  # bq*bk cap for the fused backward's
 # overrides bypass this cap entirely.
 
 
+def resolve_bwd_form(seq_q: int, head_dim: int, itemsize: int,
+                     block_q: int = 1024) -> str:
+    """'fused' | 'split': which Mosaic backward a shape routes to.
+
+    Mirrors the resident-bytes gate in `_flash_bwd_pallas` so SWEEPS
+    can record (and refuse to mislabel) the kernel that actually runs:
+    past the cap, a `bwd_tiles`/env override does NOT apply — the
+    split backward tiles at the forward blocks. Recording this per row
+    replaced the old trace-time "override ignored" warning (the
+    ADVICE-r05 wrong-kernel-measurement hazard)."""
+    sq_padded = ((seq_q + block_q - 1) // block_q) * block_q
+    dp_padded = ((head_dim + 127) // 128) * 128
+    resident = sq_padded * dp_padded * (4 + itemsize)
+    return "fused" if resident <= _FUSED_BWD_MAX_RESIDENT_BYTES \
+        else "split"
+
+
 def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q,
                       block_k, interpret, bwd_tiles=None):
     sq_padded = ((q.shape[1] + block_q - 1) // block_q) * block_q
     dp_padded = ((q.shape[2] + 127) // 128) * 128
     # fused-path VMEM residents that scale with the FULL sequence: the
     # f32 dq scratch AND the dq output block (q.dtype) — both stay live
-    # per batch-head
+    # per batch-head (keep in sync with resolve_bwd_form above)
     resident = sq_padded * dp_padded * (4 + q.dtype.itemsize)
     if resident <= _FUSED_BWD_MAX_RESIDENT_BYTES:
         # the fused kernel's per-cell tiles cap lower than the split
@@ -641,7 +638,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q,
         # serial kv loop amortizes better with a WIDE kv tile.
         # `bwd_tiles` overrides for experimentation.
         if bwd_tiles is None:
-            bwd_tiles = _env_bwd_tiles()
+            bwd_tiles = envknobs.FLASH_BWD_TILES
         if bwd_tiles is not None:
             # explicit/env tiles are trusted as-is (only seq-clamped):
             # the auto-shrink below would silently rewrite a swept
@@ -657,17 +654,13 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, sm_scale, block_q,
                     fb_k //= 2
         return _flash_bwd_pallas_fused(q, k, v, o, lse, do, causal,
                                        sm_scale, fb_q, fb_k, interpret)
-    override = bwd_tiles if bwd_tiles is not None else _env_bwd_tiles()
-    if override is not None:
-        # the override names FUSED-backward tiles; routing to the split
-        # kernels here would silently measure the wrong kernel in a
-        # sweep (ADVICE r05) — warn at trace time, once per compile
-        logger.warning(
-            "flash backward: bwd_tiles override %dx%d ignored — "
-            "full-sequence residents (%d bytes > %d cap) route this "
-            "shape to the SPLIT backward, which tiles at the forward "
-            "blocks %dx%d", override[0], override[1], resident,
-            _FUSED_BWD_MAX_RESIDENT_BYTES, block_q, block_k)
+    # NOTE: past the resident cap a bwd_tiles/env override does not
+    # apply — the split backward tiles at the forward blocks. The old
+    # trace-time "override ignored" warning is gone: env knobs can no
+    # longer be resolved mid-trace (import-time snapshots, graftlint
+    # trace-env-read), and sweep_attn_bwd_tiles.py records
+    # `resolve_bwd_form` per row, skipping combos a split route would
+    # mislabel.
     return _flash_bwd_pallas_split(q, k, v, o, lse, do, causal, sm_scale,
                                    block_q, block_k, interpret)
 
@@ -911,7 +904,7 @@ def _resolve_impl_and_blocks(q, k, block_q, block_k, impl):
     128."""
     impl = impl or _default_impl()
     big = impl in ("pallas", "interpret")
-    env = _env_tiles("BIGDL_FLASH_FWD_TILES") if big else None
+    env = envknobs.FLASH_FWD_TILES if big else None
     if env is not None and (block_q is None and block_k is None):
         block_q, block_k = env
     default = 1024

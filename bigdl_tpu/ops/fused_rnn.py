@@ -38,16 +38,16 @@ are not lane-tileable (H % 128 != 0), and for H too large for the
 VMEM-resident weight scheme) is the plain `lax.scan` this kernel
 replaces — also the numeric oracle for the parity tests.
 
-Env knobs (read at TRACE time, like the flash-attention tiles —
-changing them after a shape has compiled is a silent no-op):
-`BIGDL_FUSED_RNN=0` disables the kernels (auto mode only);
-`BIGDL_FUSED_RNN_BLOCK_N` overrides the batch-tile rows.
+Env knobs (snapshotted at IMPORT via utils/envknobs — never read at
+trace time; in-process sweeps call `envknobs.refresh()` after
+mutating the environment): `BIGDL_FUSED_RNN=0` disables the kernels
+(auto mode only); `BIGDL_FUSED_RNN_BLOCK_N` overrides the batch-tile
+rows.
 """
 
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional, Tuple
 
 import jax
@@ -56,6 +56,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 
 from bigdl_tpu.ops.flash_attention import _tpu_compiler_params
+from bigdl_tpu.utils import envknobs
 
 # Above this hidden size the backward's VMEM residents no longer fit
 # the kernel budget: at H the resident set is the (H, 4H) weight, the
@@ -67,11 +68,6 @@ from bigdl_tpu.ops.flash_attention import _tpu_compiler_params
 # ≈25 MiB at the derated default tile below).
 _MAX_HIDDEN = 512
 _VMEM_LIMIT = 64 * 1024 * 1024
-
-
-def _env_block_n() -> Optional[int]:
-    v = os.environ.get("BIGDL_FUSED_RNN_BLOCK_N")
-    return int(v) if v else None
 
 
 def _default_platform() -> str:
@@ -95,8 +91,7 @@ def resolve_impl(hidden: int, impl: Optional[str] = None) -> str:
         raise ValueError(
             f"fused_rnn impl {impl!r}: expected None/'auto'/'pallas'/"
             f"'interpret'/'xla'")
-    if os.environ.get("BIGDL_FUSED_RNN", "1").lower() in ("0", "false",
-                                                          "off"):
+    if not envknobs.FUSED_RNN_ENABLED:
         return "xla"
     if _default_platform() != "tpu":
         return "xla"
@@ -113,7 +108,8 @@ def _pad_batch(n: int, block_n: Optional[int],
     within the VMEM budget (see _MAX_HIDDEN note); explicit/env
     overrides are trusted as-is (sweep knobs)."""
     n16 = ((n + 15) // 16) * 16
-    bn = block_n or _env_block_n() or (512 if hidden <= 256 else 256)
+    bn = block_n or envknobs.FUSED_RNN_BLOCK_N \
+        or (512 if hidden <= 256 else 256)
     bn = min(((bn + 15) // 16) * 16, n16)
     return ((n16 + bn - 1) // bn) * bn, bn
 

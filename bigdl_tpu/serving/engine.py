@@ -698,7 +698,10 @@ class InferenceEngine:
                     jnp.asarray(self._seed), jnp.asarray(self._nout),
                     jnp.asarray(self._temp), jnp.asarray(self._topk),
                     jnp.asarray(self._topp), jnp.asarray(poison))
-            return np.asarray(nxt), np.asarray(finite), cache
+            # THE one deliberate per-step device→host fetch: it fences
+            # the decode dispatch (block_until_ready lies through the
+            # tunnel) and runs inside the watchdog budget above
+            return np.asarray(nxt), np.asarray(finite), cache  # graftlint: disable=hidden-device-sync
 
         if self.step_timeout_s is None or not watchdog:
             nxt, finite, cache = work()
@@ -748,15 +751,17 @@ class InferenceEngine:
                 slow_s = 0.0
                 if plan.fires("serve_slow", stepno):
                     slow_s = (self.step_timeout_s or 0.05) * 5
-                t0 = time.perf_counter()
                 tc0 = self._clock()
                 nxt, finite = self._dispatch_and_fetch(poison, slow_s)
                 # dispatch+fetch wall time into the fixed-bucket
                 # histogram UNCONDITIONALLY: health() percentiles are
                 # core engine bookkeeping (this store replaced the
                 # recent-latency deque), not optional telemetry — the
-                # kill switch gates events/spans/counter mirrors only
-                self._m_lat.observe(time.perf_counter() - t0)
+                # kill switch gates events/spans/counter mirrors only.
+                # Timed on the INJECTABLE clock (graftlint
+                # nondeterministic-drill): drills with a fake clock get
+                # bit-deterministic latency records too
+                self._m_lat.observe(self._clock() - tc0)
                 if obs.enabled():
                     tracer = obs.get_tracer()
                     if tracer.enabled:

@@ -16,7 +16,9 @@ Usage::
     with t:
         out = step_fn(...)
         t.fence(out)                           # device-honest timing
-    print(t.elapsed)
+    logger.info("step %.3fs", t.elapsed)       # or obs registry — the
+                                               # telemetry convention:
+                                               # never print()
 
 View traces in TensorBoard's Profile tab (the trace dir also contains
 `.xplane.pb` files usable with `xprof`).

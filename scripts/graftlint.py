@@ -1,0 +1,152 @@
+"""graftlint CLI — run the repo's JAX-aware lint rules.
+
+    python scripts/graftlint.py                     # full tree, text
+    python scripts/graftlint.py --format json       # machine-readable
+    python scripts/graftlint.py bigdl_tpu/ops       # subtree / files
+    python scripts/graftlint.py --rules trace-env-read,telemetry-bypass
+    python scripts/graftlint.py --no-baseline       # ignore allowlist
+    python scripts/graftlint.py --write-baseline    # snapshot findings
+
+Exit codes: 0 clean (modulo baseline), 1 findings (or stale baseline
+entries — the baseline may only shrink, so an entry matching nothing
+is itself an error), 2 usage/parse trouble.
+
+Rules, suppression syntax and baseline policy: README "Static
+analysis". The tier-1 gate (tests/test_graftlint.py) runs the same
+engine in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from bigdl_tpu.analysis import (BASELINE_PATH, RULES, apply_baseline,
+                                format_baseline, iter_python_files,
+                                load_baseline, run_lint)
+from bigdl_tpu.analysis.engine import BaselineEntry
+
+
+def _resolve_paths(root: str, args_paths):
+    """CLI path args (abs or repo-relative files/dirs) → repo-relative
+    .py file list; None means the default full tree."""
+    if not args_paths:
+        return None
+    out = []
+    for p in args_paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        rel = os.path.relpath(full, root).replace(os.sep, "/")
+        if os.path.isdir(full):
+            out.extend(iter_python_files(root, roots=(rel,)))
+        elif full.endswith(".py") and os.path.isfile(full):
+            out.append(rel)
+        else:
+            # ValueError -> main's exit code 2 (usage trouble)
+            raise ValueError(f"not a python file or directory: {p}")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the full tree)")
+    ap.add_argument("--root", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".."),
+        help="repo root (default: this script's parent)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of rules to run")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {BASELINE_PATH})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report grandfathered findings too")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings as the new baseline "
+                         "(shrink-review before committing!)")
+    args = ap.parse_args(argv)
+    root = os.path.abspath(args.root)
+
+    if args.list_rules:
+        from bigdl_tpu.analysis.engine import _ensure_rules_loaded
+        _ensure_rules_loaded()
+        for name in sorted(RULES):
+            r = RULES[name]
+            print(f"{name:30s} {r.severity:8s} {r.description}")
+        return 0
+
+    rule_names = [r.strip() for r in args.rules.split(",")] \
+        if args.rules else None
+    try:
+        paths = _resolve_paths(root, args.paths)
+        findings = run_lint(root, paths=paths, rule_names=rule_names)
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(root, BASELINE_PATH)
+    if args.write_baseline:
+        if args.paths or args.rules:
+            # a subset run sees a subset of findings — writing it out
+            # would silently drop every grandfathered entry outside
+            # the subset
+            print("graftlint: --write-baseline requires a full run "
+                  "(no path or --rules arguments)", file=sys.stderr)
+            return 2
+        counts: dict = {}
+        for f in findings:
+            counts[f.key()] = counts.get(f.key(), 0) + 1
+        entries = [BaselineEntry(rule, path, n)
+                   for (rule, path), n in sorted(counts.items())]
+        with open(baseline_path, "w") as fh:
+            fh.write(format_baseline(entries))
+        print(f"graftlint: wrote {len(entries)} baseline entries to "
+              f"{baseline_path}")
+        return 0
+
+    stale = []
+    if not args.no_baseline:
+        baseline = load_baseline(baseline_path)
+        findings, stale = apply_baseline(findings, baseline)
+        if args.paths or args.rules:
+            # a partial run (path/rule subset) cannot see every
+            # finding, so absent ones are not evidence an entry is
+            # stale — only the full default run enforces shrink-only
+            stale = []
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.as_dict() for f in findings],
+            "stale_baseline": [vars(e) for e in stale],
+            "counts": {
+                "error": sum(f.severity == "error" for f in findings),
+                "warning": sum(f.severity == "warning"
+                               for f in findings),
+            },
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.text())
+        for e in stale:
+            print(f"{baseline_path}: stale baseline entry "
+                  f"({e.rule} @ {e.path} x{e.count}) — the finding is "
+                  f"fixed; DELETE the entry (baseline only shrinks)")
+        if findings or stale:
+            ne = sum(f.severity == "error" for f in findings)
+            nw = len(findings) - ne
+            print(f"graftlint: {ne} error(s), {nw} warning(s), "
+                  f"{len(stale)} stale baseline entr(ies)")
+        else:
+            print("graftlint: clean")
+    return 1 if (findings or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

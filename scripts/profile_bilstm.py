@@ -53,6 +53,12 @@ def run_config(tag, batch, seq, unroll, hoist, iters, fused=False,
         os.environ["BIGDL_FUSED_RNN_BLOCK_N"] = str(block_n)
     else:
         os.environ.pop("BIGDL_FUSED_RNN_BLOCK_N", None)
+    # knobs are snapshotted at import (graftlint trace-env-read) —
+    # an in-process sweep must re-snapshot explicitly; safe here
+    # because every config builds a FRESH jitted step below, so the
+    # new tile re-traces instead of hitting a stale jit cache
+    from bigdl_tpu.utils import envknobs
+    envknobs.refresh()
     # record what will ACTUALLY run, not what was requested: a fused
     # config that resolves to the lax.scan fallback (no TPU, kill
     # switch exported) would otherwise produce sweep rows measuring

@@ -41,12 +41,27 @@ def sweep_shape(tag, bh, s, d, combos):
     import jax
     import jax.numpy as jnp
 
-    from bigdl_tpu.ops.flash_attention import flash_attention
+    from bigdl_tpu.ops.flash_attention import (flash_attention,
+                                               resolve_bwd_form)
 
     rng = np.random.RandomState(0)
     q0 = jnp.asarray(rng.randn(bh, s, d), jnp.bfloat16)
     k0 = jnp.asarray(rng.randn(bh, s, d), jnp.bfloat16)
     v0 = jnp.asarray(rng.randn(bh, s, d), jnp.bfloat16)
+
+    # record what will ACTUALLY run (the profile_bilstm convention):
+    # past the fused backward's resident cap, bwd_tiles do not apply —
+    # the split backward tiles at the forward blocks, and timing it
+    # under a bwd_tiles label would be the ADVICE-r05 wrong-kernel
+    # hazard. Skip the combos instead of mislabeling them.
+    bwd_form = resolve_bwd_form(s, d, q0.dtype.itemsize, block_q=1024)
+    if bwd_form != "fused":
+        print(json.dumps({"shape": tag, "SKIPPED":
+                          f"resolve_bwd_form -> {bwd_form}: bwd_tiles "
+                          f"do not apply (split backward tiles at the "
+                          f"fwd blocks); rows would mislabel"}),
+              flush=True)
+        return
 
     for bt in combos:
         try:
@@ -63,6 +78,7 @@ def sweep_shape(tag, bh, s, d, combos):
 
             t_b = chain(fwdbwd, q0, n=4)
             row = {"shape": tag, "bwd_tiles": list(bt) if bt else None,
+                   "bwd_form": bwd_form,
                    "fwdbwd_ms": round(t_b * 1e3, 3)}
         except Exception as e:
             row = {"shape": tag, "bwd_tiles": list(bt) if bt else None,

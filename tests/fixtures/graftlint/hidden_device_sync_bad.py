@@ -1,0 +1,16 @@
+# graftlint fixture: hidden-device-sync TRUE POSITIVES (judged as if
+# at bigdl_tpu/serving/fixture.py — hot-path function names).
+import jax
+import numpy as np
+
+
+def decode_step(logits, cache):
+    tok = logits.item()  # BAD
+    host = np.asarray(cache)  # BAD
+    jax.device_get(logits)  # BAD
+    logits.block_until_ready()  # BAD
+    return tok, host
+
+
+def observe_latency(registry, value):
+    registry.observe(float(np.asarray(value)))  # BAD

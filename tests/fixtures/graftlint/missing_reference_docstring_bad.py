@@ -1,0 +1,17 @@
+# graftlint fixture: missing-reference-docstring TRUE POSITIVES
+# (judged as if at bigdl_tpu/nn/fixture.py).
+"""Fixture layers with no reference citations anywhere."""
+
+from bigdl_tpu.nn.module import Module
+
+
+class UncitedLayer(Module):  # BAD
+    """Does something, cites nothing."""
+
+    def apply(self, variables, x, training=False, rng=None):
+        return x, variables["state"]
+
+
+class UndocumentedLayer(Module):  # BAD
+    def apply(self, variables, x, training=False, rng=None):
+        return x, variables["state"]

@@ -1,0 +1,36 @@
+# graftlint fixture: missing-reference-docstring CLEAN — the four
+# sanctioned citation styles plus the exemptions.
+"""Fixture layers.
+
+Reference parity: nn/HeaderCited.scala (the module-header style).
+"""
+
+from bigdl_tpu.nn.module import Module
+
+
+class DirectlyCited(Module):
+    """Identity (reference: nn/DirectlyCited.scala)."""
+
+
+class ParityCited(Module):
+    """Identity. Reference parity: nn/abstractnn/ParityCited.scala."""
+
+
+class HeaderCited(Module):
+    """Named in the module docstring's Reference parity header."""
+
+
+class TpuExtension(Module):
+    """No reference counterpart — TPU-first extension."""
+
+
+class DisclaimedExtension(Module):
+    """No direct reference counterpart (predates the concept)."""
+
+
+class _PrivateHelper(Module):
+    """Private: exempt."""
+
+
+class PlainDataHolder:
+    """No bases: exempt."""

@@ -1,0 +1,17 @@
+# graftlint fixture: nondeterministic-drill TRUE POSITIVES (judged as
+# if at bigdl_tpu/serving/fixture.py).
+import random
+import time
+
+import numpy as np
+
+
+def admit(queue):
+    now = time.time()  # BAD
+    random.shuffle(queue)  # BAD
+    jitter = np.random.rand()  # BAD
+    return now + jitter
+
+
+def deadline_check(req):
+    return time.monotonic() > req.deadline  # BAD

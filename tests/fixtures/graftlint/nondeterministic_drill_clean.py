@@ -1,0 +1,24 @@
+# graftlint fixture: nondeterministic-drill CLEAN — injectable clock,
+# seeded streams, and sleep-as-straggler-model are all sanctioned.
+import time
+
+import jax
+import numpy as np
+
+
+class Engine:
+    def __init__(self, clock=time.monotonic):  # reference, not a call
+        self._clock = clock
+
+    def admit(self, queue, seed):
+        now = self._clock()
+        rng = np.random.RandomState(seed)
+        rng.shuffle(queue)
+        return now
+
+    def decode_keys(self, seed, nout):
+        return jax.random.fold_in(jax.random.PRNGKey(seed), nout)
+
+    def straggler_model(self, slow_s):
+        if slow_s:
+            time.sleep(slow_s)  # injected hang model, not a clock read

@@ -1,0 +1,26 @@
+# graftlint fixture: retrace-hazard TRUE POSITIVES.
+import functools
+
+import jax
+
+
+@jax.jit
+def branch_on_traced(x, flag):
+    if flag:  # BAD
+        return x * 2
+    return x
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def coerce_traced(x, mode):
+    if mode == "scale":
+        return float(x)  # BAD
+    return x
+
+
+@jax.jit
+def loop_on_traced(x, n):
+    while n > 0:  # BAD
+        x = x * 2
+        n = n - 1
+    return x

@@ -1,0 +1,46 @@
+# graftlint fixture: retrace-hazard CLEAN — static args and shape
+# metadata branches are trace-safe.
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def static_kwarg(x, mode):
+    if mode == "double":
+        return x * 2
+    return x
+
+
+@jax.jit
+def shape_metadata(x):
+    if x.ndim == 3:
+        return x[0]
+    if len(x.shape) > 4:
+        return x.reshape(-1)
+    return x
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def static_positional(x, steps):
+    while steps > 0:
+        x = x + 1
+        steps = steps - 1
+    return x
+
+
+@jax.jit
+def traced_math_only(x, y):
+    return jnp.where(y > 0, x, -x)  # traced select, not a branch
+
+
+@jax.jit
+def optional_operand(x, mask=None):
+    # `is None` tests the ARGUMENT STRUCTURE (pytree), static under
+    # trace — the standard optional-operand pattern
+    if mask is None:
+        return x
+    if mask is not None and x.ndim == 2:
+        return x * mask
+    return x

@@ -1,0 +1,12 @@
+# graftlint fixture: telemetry-bypass TRUE POSITIVES (judged as if in
+# bigdl_tpu/ core).
+import sys
+
+
+def emit_metric(step, loss):
+    print(f"step {step}: loss={loss}")  # BAD
+
+
+def write_raw(msg):
+    sys.stdout.write(msg + "\n")  # BAD
+    sys.stderr.write("warn: " + msg)  # BAD

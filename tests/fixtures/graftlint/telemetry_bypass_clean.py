@@ -1,0 +1,17 @@
+# graftlint fixture: telemetry-bypass CLEAN — logging + obs are the
+# sanctioned channels; a print() in a docstring/string is not a call.
+import logging
+
+logger = logging.getLogger("bigdl_tpu.fixture")
+
+USAGE = """example:
+    print(t.elapsed)   # only a string, not a call
+"""
+
+
+def emit_metric(step, loss):
+    logger.info("step %d: loss=%s", step, loss)
+
+
+def emit_event(emit_event_fn, step):
+    emit_event_fn("train_step", step=step)

@@ -1,0 +1,8 @@
+# graftlint fixture: tf-import-in-core TRUE POSITIVES.
+import tensorflow as tf  # BAD
+from tensorflow.io import gfile  # BAD
+
+
+def read(path):
+    with gfile.GFile(path) as f:
+        return tf.constant(f.read())

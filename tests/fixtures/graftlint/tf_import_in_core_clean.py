@@ -1,0 +1,9 @@
+# graftlint fixture: tf-import-in-core CLEAN — the bundled
+# wire-compatible protos are the sanctioned interop path (and a module
+# merely NAMED tensorflowish is not TF).
+import tensorflow_datasets_shim_that_is_not_tf as shim  # noqa: F401
+
+
+def read(path):
+    with open(path, "rb") as f:
+        return f.read()

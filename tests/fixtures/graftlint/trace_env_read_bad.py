@@ -1,0 +1,15 @@
+# graftlint fixture: trace-env-read TRUE POSITIVES.
+# Judged as if at bigdl_tpu/ops/fixture.py; the BAD markers name the
+# expected finding lines.
+import os
+
+
+def resolve_block(n):
+    v = os.environ.get("BIGDL_FIXTURE_BLOCK")  # BAD
+    return int(v) if v else n
+
+
+def kill_switch():
+    if os.environ["BIGDL_FIXTURE"] == "0":  # BAD
+        return "xla"
+    return os.getenv("BIGDL_FIXTURE_IMPL", "pallas")  # BAD

@@ -1,0 +1,14 @@
+# graftlint fixture: trace-env-read CLEAN — import-time snapshots are
+# the sanctioned pattern (utils/envknobs).
+import os
+
+_BLOCK = os.environ.get("BIGDL_FIXTURE_BLOCK")
+_IMPL = os.getenv("BIGDL_FIXTURE_IMPL", "pallas")
+
+
+def resolve_block(n):
+    return int(_BLOCK) if _BLOCK else n
+
+
+def resolve_impl():
+    return _IMPL

@@ -1,0 +1,18 @@
+# graftlint fixture: unfenced-timing TRUE POSITIVES.
+import time
+
+
+def bench_dispatch_only(step_fn, batches):
+    t0 = time.perf_counter()
+    loss = None
+    for b in batches:
+        loss = step_fn(b)
+    return time.perf_counter() - t0  # BAD
+
+
+def bench_decode(decode_fn, n):
+    t0 = time.time()
+    for i in range(n):
+        decode_fn(i)
+    dt = time.time() - t0  # BAD
+    return dt

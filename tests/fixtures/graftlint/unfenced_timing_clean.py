@@ -1,0 +1,36 @@
+# graftlint fixture: unfenced-timing CLEAN — every window over device
+# work closes with a real fetch; host-only windows are free.
+import time
+
+import numpy as np
+
+
+def bench_fenced(step_fn, batches):
+    t0 = time.perf_counter()
+    loss = None
+    for b in batches:
+        loss = step_fn(b)
+    float(loss)  # device→host fetch bounds the whole chain
+    return time.perf_counter() - t0
+
+
+def bench_asarray(decode_fn, n):
+    t0 = time.time()
+    out = None
+    for i in range(n):
+        out = decode_fn(i)
+    np.asarray(out)
+    return time.time() - t0
+
+
+def bench_self_fencing(dispatch_and_fetch, n):
+    t0 = time.perf_counter()
+    for i in range(n):
+        dispatch_and_fetch(i)  # fetches internally (name says so)
+    return time.perf_counter() - t0
+
+
+def host_only_window():
+    t0 = time.monotonic()
+    total = sum(range(1000))
+    return total, time.monotonic() - t0

@@ -273,8 +273,21 @@ class TestDispatch:
         assert fused_rnn.resolve_impl(96, "interpret") == "interpret"
 
     def test_env_kill_switch(self, monkeypatch):
+        # the knob is snapshotted at import (utils/envknobs, graftlint
+        # trace-env-read) — mutating the env requires an explicit
+        # refresh, and the snapshot must be restored afterwards
+        from bigdl_tpu.utils import envknobs
+
+        ambient = envknobs.FUSED_RNN_ENABLED  # may be off in the shell
         monkeypatch.setenv("BIGDL_FUSED_RNN", "0")
-        assert fused_rnn.resolve_impl(128, None) == "xla"
+        envknobs.refresh()
+        try:
+            assert not envknobs.FUSED_RNN_ENABLED
+            assert fused_rnn.resolve_impl(128, None) == "xla"
+        finally:
+            monkeypatch.undo()
+            envknobs.refresh()
+        assert envknobs.FUSED_RNN_ENABLED == ambient
 
     def test_unknown_impl_raises(self):
         # a typo must not silently measure the fallback path

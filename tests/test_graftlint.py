@@ -1,0 +1,279 @@
+"""graftlint tier-1 gate + rule/engine mechanics (ISSUE 6).
+
+Three layers:
+
+* fixtures — every rule has a known-bad snippet (must fire, on exactly
+  the `# BAD`-marked lines) and a known-clean snippet (false-positive
+  guard), judged under a fake path inside the rule's scope;
+* mechanics — inline suppressions, baseline parse/format/apply,
+  shrink-only staleness;
+* the GATE — the full tree must lint clean modulo the committed
+  baseline, the baseline may only shrink (stale entries fail), and the
+  full-tree pass must stay under the ~10 s budget on the 1-core host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from bigdl_tpu.analysis import (BASELINE_PATH, RULES, apply_baseline,
+                                format_baseline, lint_source,
+                                load_baseline, parse_baseline, run_lint)
+from bigdl_tpu.analysis.engine import BaselineEntry, FileContext, \
+    _ensure_rules_loaded
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "graftlint")
+
+_ensure_rules_loaded()
+
+# rule -> (fixture stem, fake in-scope path the snippet is judged at)
+RULE_FIXTURES = {
+    "trace-env-read": ("trace_env_read", "bigdl_tpu/ops/fixture.py"),
+    "telemetry-bypass": ("telemetry_bypass",
+                         "bigdl_tpu/models/fixture.py"),
+    "hidden-device-sync": ("hidden_device_sync",
+                           "bigdl_tpu/serving/fixture.py"),
+    "unfenced-timing": ("unfenced_timing", "bigdl_tpu/utils/fixture.py"),
+    "retrace-hazard": ("retrace_hazard", "bigdl_tpu/ops/fixture.py"),
+    "tf-import-in-core": ("tf_import_in_core",
+                          "bigdl_tpu/dataset/fixture.py"),
+    "missing-reference-docstring": ("missing_reference_docstring",
+                                    "bigdl_tpu/nn/fixture.py"),
+    "nondeterministic-drill": ("nondeterministic_drill",
+                               "bigdl_tpu/serving/fixture.py"),
+}
+
+
+def _fixture(stem: str, kind: str) -> str:
+    with open(os.path.join(FIXTURES, f"{stem}_{kind}.py")) as f:
+        return f.read()
+
+
+def _lint_with(rule_name: str, path: str, source: str):
+    return lint_source(path, source, rules=[RULES[rule_name]])
+
+
+def _expected_lines(source: str):
+    return {i for i, line in enumerate(source.splitlines(), start=1)
+            if "# BAD" in line}
+
+
+class TestRuleFixtures:
+    def test_every_rule_has_a_fixture(self):
+        # adding a rule without fixture coverage fails here
+        assert set(RULE_FIXTURES) == set(RULES)
+
+    @pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+    def test_true_positives_fire_at_marked_lines(self, rule):
+        stem, path = RULE_FIXTURES[rule]
+        src = _fixture(stem, "bad")
+        expected = _expected_lines(src)
+        assert expected, f"{stem}_bad.py has no # BAD markers"
+        findings = _lint_with(rule, path, src)
+        assert {f.line for f in findings} == expected
+        assert all(f.rule == rule and f.path == path for f in findings)
+        sev = RULES[rule].severity
+        assert all(f.severity == sev for f in findings)
+
+    @pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+    def test_clean_fixture_is_clean(self, rule):
+        stem, path = RULE_FIXTURES[rule]
+        findings = _lint_with(rule, path, _fixture(stem, "clean"))
+        assert findings == [], "\n".join(f.text() for f in findings)
+
+    def test_out_of_scope_path_not_checked(self):
+        # the nn docstring rule must never judge serving code
+        src = _fixture("missing_reference_docstring", "bad")
+        assert _lint_with("missing-reference-docstring",
+                          "bigdl_tpu/serving/fixture.py", src) == []
+
+
+class TestSuppressions:
+    SRC = ("def f(step, loss):\n"
+           "    print(loss)  # graftlint: disable=telemetry-bypass\n"
+           "    print(step)\n")
+
+    def test_same_line_suppression(self):
+        found = _lint_with("telemetry-bypass", "bigdl_tpu/x.py",
+                           self.SRC)
+        assert [f.line for f in found] == [3]  # only the unsuppressed
+
+    def test_previous_comment_line_suppression(self):
+        src = ("def f(loss):\n"
+               "    # graftlint: disable=telemetry-bypass\n"
+               "    print(loss)\n")
+        assert _lint_with("telemetry-bypass", "bigdl_tpu/x.py",
+                          src) == []
+
+    def test_bare_disable_waives_all_rules(self):
+        src = "def f(loss):\n    print(loss)  # graftlint: disable\n"
+        assert _lint_with("telemetry-bypass", "bigdl_tpu/x.py",
+                          src) == []
+
+    def test_unrelated_rule_name_does_not_suppress(self):
+        src = ("def f(loss):\n"
+               "    print(loss)  # graftlint: disable=trace-env-read\n")
+        found = _lint_with("telemetry-bypass", "bigdl_tpu/x.py", src)
+        assert [f.line for f in found] == [2]
+
+    def test_disable_file(self):
+        src = ("# graftlint: disable-file=telemetry-bypass\n"
+               "def f(a, b):\n    print(a)\n    print(b)\n")
+        assert _lint_with("telemetry-bypass", "bigdl_tpu/x.py",
+                          src) == []
+
+    def test_suppression_table_parsing(self):
+        ctx = FileContext("bigdl_tpu/x.py", self.SRC)
+        assert ctx.suppressions.suppressed("telemetry-bypass", 2)
+        assert not ctx.suppressions.suppressed("telemetry-bypass", 3)
+        assert not ctx.suppressions.suppressed("trace-env-read", 2)
+
+
+class TestBaseline:
+    TEXT = ('# comment\n\n[[finding]]\nrule = "telemetry-bypass"\n'
+            'path = "bigdl_tpu/a.py"\ncount = 2\n'
+            'reason = "legacy CLI"\n\n[[finding]]\n'
+            'rule = "trace-env-read"\npath = "bigdl_tpu/b.py"\n')
+
+    def test_parse(self):
+        entries = parse_baseline(self.TEXT)
+        assert [(e.rule, e.path, e.count) for e in entries] == [
+            ("telemetry-bypass", "bigdl_tpu/a.py", 2),
+            ("trace-env-read", "bigdl_tpu/b.py", 1)]
+        assert entries[0].reason == "legacy CLI"
+
+    def test_format_roundtrip(self):
+        entries = parse_baseline(self.TEXT)
+        assert parse_baseline(format_baseline(entries)) == entries
+
+    def test_parse_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            parse_baseline("rule = oops, no table header")
+
+    def test_parse_hash_inside_string_value(self):
+        # '#' inside a quoted value is data, not a comment
+        text = ('[[finding]]\nrule = "telemetry-bypass"\n'
+                'path = "bigdl_tpu/a.py"\n'
+                'reason = "fixed by PR #12"  # trailing comment ok\n'
+                'count = 2  # inline comment on an int\n')
+        (e,) = parse_baseline(text)
+        assert e.reason == "fixed by PR #12" and e.count == 2
+
+    def test_parse_rejects_unterminated_string(self):
+        with pytest.raises(ValueError, match="unterminated"):
+            parse_baseline('[[finding]]\nrule = "oops\npath = "a"\n')
+
+    def test_parse_rejects_trailing_garbage_after_string(self):
+        with pytest.raises(ValueError, match="trailing"):
+            parse_baseline('[[finding]]\nrule = "a" junk\npath = "b"\n')
+
+    def _findings(self, n, rule="telemetry-bypass",
+                  path="bigdl_tpu/a.py"):
+        from bigdl_tpu.analysis import Finding
+        return [Finding(rule, path, 10 + i, 1, "m", "error")
+                for i in range(n)]
+
+    def test_apply_subtracts_counts(self):
+        baseline = [BaselineEntry("telemetry-bypass",
+                                  "bigdl_tpu/a.py", 2)]
+        left, stale = apply_baseline(self._findings(3), baseline)
+        assert len(left) == 1 and stale == []
+
+    def test_stale_entry_detected(self):
+        # the finding was fixed -> the entry must be deleted
+        baseline = [BaselineEntry("telemetry-bypass",
+                                  "bigdl_tpu/a.py", 2)]
+        left, stale = apply_baseline(self._findings(1), baseline)
+        assert left == [] and stale == baseline
+
+    def test_duplicate_entries_sum_counts(self):
+        # hand-split entries for one (rule, path) must pool, not
+        # overwrite each other
+        baseline = [
+            BaselineEntry("telemetry-bypass", "bigdl_tpu/a.py", 1,
+                          "first"),
+            BaselineEntry("telemetry-bypass", "bigdl_tpu/a.py", 1,
+                          "second")]
+        left, stale = apply_baseline(self._findings(2), baseline)
+        assert left == [] and stale == []
+        # and staleness of a pooled key reports once
+        left, stale = apply_baseline(self._findings(1), baseline)
+        assert left == [] and len(stale) == 1
+
+    def test_missing_baseline_file_is_empty(self):
+        assert load_baseline(os.path.join(ROOT, "no/such/file.toml")) \
+            == []
+
+
+class TestFullTreeGate:
+    """THE tier-1 contract: tree clean modulo baseline, baseline only
+    shrinks, pass stays inside the runtime budget."""
+
+    def test_full_tree_clean_and_budget(self):
+        t0 = time.perf_counter()
+        findings = run_lint(ROOT)
+        elapsed = time.perf_counter() - t0
+        baseline = load_baseline(os.path.join(ROOT, BASELINE_PATH))
+        left, stale = apply_baseline(findings, baseline)
+        assert left == [], "unbaselined graftlint findings:\n" + \
+            "\n".join(f.text() for f in left)
+        assert stale == [], (
+            "stale baseline entries (finding fixed -> DELETE the "
+            "entry; the baseline only shrinks): " +
+            ", ".join(f"{e.rule}@{e.path}" for e in stale))
+        # ~10 s contract for the full-tree pass on the 1-core host
+        # (pure ast walk; measured ~1.5 s — 10 s leaves load headroom)
+        assert elapsed < 10.0, f"graftlint full tree took {elapsed:.1f}s"
+
+    def test_baseline_entries_reference_real_rules(self):
+        baseline = load_baseline(os.path.join(ROOT, BASELINE_PATH))
+        for e in baseline:
+            assert e.rule in RULES, f"unknown rule in baseline: {e.rule}"
+
+
+class TestCli:
+    def test_cli_full_tree_json_exits_zero(self):
+        # the acceptance-criteria invocation, via the real entry point
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "scripts",
+                                          "graftlint.py"),
+             "--format", "json"],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        payload = json.loads(proc.stdout)
+        assert payload["findings"] == []
+        assert payload["stale_baseline"] == []
+        assert payload["counts"] == {"error": 0, "warning": 0}
+
+    def test_cli_write_baseline_refuses_subset_runs(self):
+        # a subset snapshot would silently drop out-of-subset entries
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "graftlint_cli", os.path.join(ROOT, "scripts",
+                                          "graftlint.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.main(["--write-baseline", "bigdl_tpu/ops"]) == 2
+        assert mod.main(["--write-baseline",
+                         "--rules", "telemetry-bypass"]) == 2
+
+    def test_cli_missing_path_exits_two(self):
+        # usage trouble is the documented exit code 2, not a traceback
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "scripts",
+                                          "graftlint.py"),
+             "bigdl_tpu/no_such_file.py"],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+        assert "Traceback" not in proc.stderr
+        assert "not a python file" in proc.stderr
