@@ -985,6 +985,112 @@ def bench_lm_decode_batched(on_tpu, context=512, new_tokens=None,
     }), flush=True)
 
 
+def bench_lm_decode_fleet(on_tpu, context=None, new_tokens=None,
+                          slots=None):
+    """Fleet row (ISSUE 7): a 2-engine routed pool on the 43M LM
+    under a deterministic loadgen burst, with ONE FORCED DEGRADATION
+    mid-stream — serve_slow hangs engine 0's dispatch past its
+    watchdog budget, the router fails its requests over to engine 1,
+    and the row reports GOODPUT with the recovery inside the timed
+    window (the watchdog join + re-decode-from-prompt are the price
+    of losing an engine, so they belong in the number). Zero requests
+    are lost (failover bit-identity is drilled in fault_drill
+    fleet_failover; here it is load-bearing for the goodput claim).
+
+    Compile contract, fleet-wide: both engines + the router serve the
+    whole burst on (#buckets used) prefill traces + 1 decode trace
+    TOTAL (executables are shared; pool-size changes compile
+    nothing) — counted from the process-wide trace tally, since
+    per-engine stats deltas over shared executables double-count."""
+    import importlib.util
+
+    import jax
+
+    from bigdl_tpu.models.transformer import TransformerConfig, TransformerLM
+    from bigdl_tpu.serving import EngineRouter, InferenceEngine, Request
+    from bigdl_tpu.serving.engine import _TRACES
+    from bigdl_tpu.utils import faults
+
+    lg = sys.modules.get("bigdl_loadgen")   # one shared module object
+    if lg is None:
+        lg_spec = importlib.util.spec_from_file_location(
+            "bigdl_loadgen", os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "scripts", "loadgen.py"))
+        lg = importlib.util.module_from_spec(lg_spec)
+        sys.modules["bigdl_loadgen"] = lg
+        lg_spec.loader.exec_module(lg)
+
+    context = context or (512 if on_tpu else 128)
+    slots = slots or (8 if on_tpu else 4)
+    new_tokens = new_tokens or (32 if on_tpu else 16)
+    vocab, dim, layers, heads = 32000, 512, 8, 8
+    max_len = context + new_tokens + 8
+    cfg = TransformerConfig(vocab_size=vocab, max_len=max_len, dim=dim,
+                            num_heads=heads, num_layers=layers)
+    model = TransformerLM(cfg)
+    variables = model.init(jax.random.PRNGKey(0))
+    buckets = (context // 2, context)
+    traces0 = dict(_TRACES)
+    # engine 0 is watchdog-armed (the degradation target); budgets are
+    # platform-scaled so a healthy step can never trip: the tunnel
+    # adds multi-second dispatch jitter on TPU
+    e0 = InferenceEngine(model, variables, slots=slots, max_len=max_len,
+                         prefill_buckets=buckets,
+                         step_timeout_s=30.0 if on_tpu else 2.0)
+    e1 = InferenceEngine(model, variables, slots=slots, max_len=max_len,
+                         prefill_buckets=buckets)
+    router = EngineRouter([e0, e1])
+
+    def burst(seed):
+        trace = lg.make_trace(
+            4 * slots, seed=seed, arrival="bursty",
+            burst_size=4 * slots,
+            prompt_len_choices=(context, context // 2 - 3,
+                                context - 17, context // 3),
+            max_new_choices=(new_tokens,), temperature=0.0,
+            priorities=(0,), vocab=vocab)
+        return [Request(**a.spec) for a in trace["arrivals"]]
+
+    res = router.run(burst(0))                  # warmup: all compiles
+    assert all(r.status == "done" for r in res)
+
+    # forced degradation: serve_slow at engine 0's 3rd decode step of
+    # the measured wave (plans key on the engine's absolute decode
+    # step count; engine 0 consults first each round, so the armed
+    # watchdog is the one that trips)
+    faults.set_plan(faults.FaultPlan(
+        f"serve_slow@{e0.stats['decode_steps'] + 3}"))
+    try:
+        t0 = time.perf_counter()
+        res = router.run(burst(1))
+        dt = time.perf_counter() - t0
+    finally:
+        faults.set_plan(None)
+    done = [r for r in res if r.status == "done"]
+    goodput = sum(len(r.tokens) for r in done)
+    platform = "tpu" if on_tpu else "cpu"
+    print(json.dumps({
+        "metric": f"transformer_lm_43m_decode_fleet_goodput"
+                  f"_tokens_per_sec[{platform}]",
+        "value": round(goodput / dt, 2), "unit": "tokens/sec",
+        "vs_baseline": None,
+        "engines": 2, "cache_slots_per_engine": slots,
+        "requests": len(res), "requests_done": len(done),
+        "requests_lost": len(res) - len(done),
+        "tokens_goodput": goodput,
+        "forced_degradation": "serve_slow->watchdog trip on engine 0",
+        "engine0_degraded": e0.degraded is not None,
+        "failovers": router.stats["failover"],
+        "rebalanced": router.stats["rebalanced"],
+        "context": context, "new_tokens": new_tokens,
+        "prefill_compiles_poolwide":
+            _TRACES["prefill"] - traces0["prefill"],
+        "decode_compiles_poolwide":
+            _TRACES["decode"] - traces0["decode"],
+        "telemetry": _obs_provenance("router_"),
+    }), flush=True)
+
+
 def main(argv=None) -> None:
     import argparse
     import os
@@ -1001,7 +1107,7 @@ def main(argv=None) -> None:
                     help="comma-separated subset: resnet50,diskpipe,"
                          "inception_v1,vgg16,lenet,int8,bilstm,treelstm,"
                          "lm43m,lm186m,lmtiny (cpu),lmdecode,"
-                         "lmdecode_batched")
+                         "lmdecode_batched,lmdecode_fleet")
     args = ap.parse_args(argv)
 
     # bounded backend probe: the axon tunnel's init can block forever
@@ -1078,6 +1184,8 @@ def main(argv=None) -> None:
             bench_lm_decode(on_tpu)
         if sel("lmdecode_batched"):
             bench_lm_decode_batched(on_tpu)
+        if sel("lmdecode_fleet"):
+            bench_lm_decode_fleet(on_tpu)
     else:
         if want is None or want & {"lm43m", "lm186m", "lmtiny",
                                    "lmdiskpipe"}:
@@ -1091,6 +1199,10 @@ def main(argv=None) -> None:
             bench_lm_decode(on_tpu)
         if "lmdecode_batched" in (want or ()):
             bench_lm_decode_batched(on_tpu)
+        # fleet goodput row: explicit-only on CPU (two 43M engines'
+        # prefill waves would double the default run), default on TPU
+        if "lmdecode_fleet" in (want or ()):
+            bench_lm_decode_fleet(on_tpu)
 
 
 if __name__ == "__main__":

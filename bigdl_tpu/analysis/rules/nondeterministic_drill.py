@@ -9,6 +9,11 @@ jax.random.PRNGKey). A `time.time()` or bare `random.random()` on
 those paths reintroduces run-to-run drift that CPU CI can't
 distinguish from a real regression.
 
+The scope covers the whole fleet plane (ISSUE 7): serving/router.py
+and serving/autoscaler.py via the serving/ prefix, plus the loadgen
+traffic harness — its two-runs-identical-JSON acceptance dies the
+moment a wall-clock read or global RNG draw sneaks in.
+
 Allowed: *references* to clock functions (e.g. the
 `clock: Callable = time.monotonic` default — that IS the injection
 point), `time.sleep` (models injected stragglers; not a clock read),
@@ -39,7 +44,7 @@ class NondeterministicDrill(Rule):
     description = ("wall clock / unseeded RNG in drill or serving "
                    "code — use the injectable clock / seeded streams")
     scope = ("bigdl_tpu/serving/", "bigdl_tpu/utils/faults.py",
-             "scripts/fault_drill.py")
+             "scripts/fault_drill.py", "scripts/loadgen.py")
 
     def check(self, ctx):
         for node in ast.walk(ctx.tree):

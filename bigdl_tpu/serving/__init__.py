@@ -1,18 +1,26 @@
 """Inference serving plane — KV-cache incremental decode + continuous
 batching (the TPU-native analog of BigDL 2.0's Cluster Serving; see
-engine.py for the design contract)."""
+engine.py for the design contract), plus the fleet plane above it:
+EngineRouter (health-gated dispatch + failover, router.py) and the
+SLO-driven Autoscaler (autoscaler.py)."""
 
+from bigdl_tpu.serving.autoscaler import Autoscaler
 from bigdl_tpu.serving.bucketing import (bucket_for, bucket_histogram,
                                          default_buckets, pad_rows,
                                          pad_tokens)
 from bigdl_tpu.serving.engine import (STATUSES, EngineDegraded,
-                                      GenerationResult, InferenceEngine,
-                                      OverloadError, Request, StepTimeout)
+                                      EngineDraining, GenerationResult,
+                                      InferenceEngine, OverloadError,
+                                      Request, StepTimeout)
+from bigdl_tpu.serving.router import (EngineRouter, NoHealthyEngine,
+                                      ROUTER_LATENCY_BUCKETS)
 from bigdl_tpu.serving.sampler import filter_logits, sample_logits
 
 __all__ = [
     "InferenceEngine", "Request", "GenerationResult", "STATUSES",
-    "OverloadError", "StepTimeout", "EngineDegraded",
+    "OverloadError", "StepTimeout", "EngineDegraded", "EngineDraining",
+    "EngineRouter", "NoHealthyEngine", "ROUTER_LATENCY_BUCKETS",
+    "Autoscaler",
     "sample_logits", "filter_logits",
     "bucket_for", "bucket_histogram", "default_buckets", "pad_tokens",
     "pad_rows",

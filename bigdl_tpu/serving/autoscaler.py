@@ -1,0 +1,200 @@
+"""SLO-driven autoscaling for the engine pool (ISSUE 7).
+
+BigDL 2.0's Cluster Serving scales worker parallelism from observed
+queue pressure (arXiv 2204.01715); here the loop closes on our own
+telemetry plane: the Autoscaler watches REGISTRY metrics — the
+router's `router_request_latency_seconds` histogram (windowed, by
+diffing cumulative bucket counts between evaluations) and the pool's
+backlog/occupancy rollup — and
+
+* **scales up** (router.add_engine(), sharing executables → zero new
+  compiles) when the windowed p99 misses `target_p99_s` or the
+  per-engine backlog passes `backlog_high`;
+* **flips the overload policy** of every pool engine to
+  `shed-lowest-priority` when the pool is at `max_engines` and STILL
+  missing the SLO — at fixed capacity the only way to hold p99 for
+  the traffic that matters is to stop queueing the traffic that
+  doesn't — and restores each engine's original policy once the SLO
+  recovers;
+* **scales down** via drain (router.drain() → engine finishes its
+  accepted work → remove_engine()) when the pool is comfortably
+  under target and under-occupied; at most one engine drains at a
+  time, and it leaves only after health() reports 'drained' — a
+  scale-down can never lose a request. Engines drained by someone
+  else, and degraded corpses whose work already failed over, are
+  reaped on sight (min_engines permitting).
+
+Every decision is a pure function of registry state and the injected
+clock — `decisions` records them, and the fleet_autoscale drill
+(scripts/fault_drill.py) replays identical traffic twice asserting
+identical decision sequences and identical load reports.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from bigdl_tpu import obs
+from bigdl_tpu.obs.registry import quantile_from_buckets
+from bigdl_tpu.serving.router import EngineRouter
+
+logger = logging.getLogger("bigdl_tpu.serving")
+
+
+class Autoscaler:
+    """Closed-loop pool sizing against a p99 latency target.
+
+    >>> asc = Autoscaler(router, target_p99_s=6.0, max_engines=3)
+    >>> while traffic:
+    ...     router.step(); asc.observe()
+
+    `observe()` is the only entry point: call it once per scheduling
+    round; it self-rate-limits to one evaluation per
+    `evaluate_every_s` of the ROUTER clock and returns the decision
+    record (or None between evaluations). Windowed p99 comes from the
+    router latency histogram's bucket-count delta since the previous
+    evaluation — no sample retention, deterministic under the
+    injected clock."""
+
+    def __init__(self, router: EngineRouter, *, target_p99_s: float,
+                 evaluate_every_s: float = 1.0, min_engines: int = 1,
+                 max_engines: int = 4, backlog_high: float = 4.0,
+                 occupancy_low: float = 0.25,
+                 flip_overload_policy: bool = True):
+        if target_p99_s <= 0:
+            raise ValueError("target_p99_s must be > 0")
+        if not 1 <= min_engines <= max_engines:
+            raise ValueError("need 1 <= min_engines <= max_engines")
+        self.router = router
+        self.target_p99_s = target_p99_s
+        self.evaluate_every_s = evaluate_every_s
+        self.min_engines = min_engines
+        self.max_engines = max_engines
+        self.backlog_high = backlog_high
+        self.occupancy_low = occupancy_low
+        self.flip_overload_policy = flip_overload_policy
+        self._clock = router._clock
+        self._last_eval: Optional[float] = None
+        self._last_counts: Optional[List[int]] = None
+        self._saved_policies: Optional[Dict[int, str]] = None
+        self._draining = None             # the one engine mid-drain
+        self.decisions: List[dict] = []
+
+    # ------------------------------------------------------------ signals
+    def _window_p99(self) -> Optional[float]:
+        """p99 of requests completed since the last evaluation, from
+        the cumulative-bucket delta (None with no completions)."""
+        child = self.router.request_latency
+        counts = list(child.counts)
+        prev = self._last_counts or [0] * len(counts)
+        self._last_counts = counts
+        delta = [c - p for c, p in zip(counts, prev)]
+        return quantile_from_buckets(child.buckets, delta, 0.99)
+
+    # ------------------------------------------------------------ actions
+    def _scale_up(self) -> str:
+        self.router.add_engine()
+        return "scale_up"
+
+    def _shed_mode(self) -> str:
+        self._saved_policies = {
+            id(e): e.overload_policy for e in self.router.engines}
+        for e in self.router.engines:
+            e.overload_policy = "shed-lowest-priority"
+        if all(e.max_queue is None for e in self.router.engines):
+            # overload_policy is only consulted when a BOUNDED queue
+            # fills — flipping it on unbounded engines changes
+            # nothing. Say so instead of pretending to protect p99.
+            logger.warning(
+                "autoscaler flipped overload_policy to "
+                "shed-lowest-priority, but every pool engine has "
+                "max_queue=None (unbounded) — the flip cannot shed "
+                "anything; build engines with max_queue= for the "
+                "at-capacity lever to bite")
+        return "shed_mode"
+
+    def _restore_policies(self) -> str:
+        for e in self.router.engines:
+            e.overload_policy = (self._saved_policies or {}).get(
+                id(e), e.overload_policy)
+        self._saved_policies = None
+        return "restore_policy"
+
+    def _start_drain(self) -> str:
+        # drain the most-loaded-index-last healthy engine: the LAST
+        # healthy engine in pool order (newest first out — the one the
+        # autoscaler most recently added), deterministic
+        self._draining = self.router.healthy_engines()[-1]
+        self.router.drain(self._draining)
+        return "drain"
+
+    # ------------------------------------------------------------ observe
+    def observe(self) -> Optional[dict]:
+        now = self._clock()
+        if self._last_eval is not None \
+                and now - self._last_eval < self.evaluate_every_s:
+            return None
+        self._last_eval = now
+        # reap corpses first: an engine someone else drained, or one
+        # that degraded (its work already failed over), serves nothing
+        # — remove it regardless of load, min_engines permitting
+        for e in list(self.router.engines):
+            if e is self._draining:
+                continue
+            if e.health()["state"] in ("drained", "degraded") \
+                    and len(self.router.engines) > self.min_engines:
+                try:
+                    self.router.remove_engine(e)
+                except ValueError:      # still holds routed work
+                    continue
+                return self._record(now, "scale_down", None)
+        # finish a drain in progress before anything else
+        if self._draining is not None:
+            if self._draining.health()["state"] == "drained":
+                self.router.remove_engine(self._draining)
+                self._draining = None
+                return self._record(now, "scale_down", None)
+            return self._record(now, "draining", None)
+        p99 = self._window_p99()
+        healthy = self.router.healthy_engines()
+        n = len(healthy)
+        slots = sum(e.slots for e in healthy)
+        backlog = sum(e.queue_depth for e in healthy)
+        occupancy = (sum(e.slots_active for e in healthy)
+                     / max(slots, 1))
+        over = ((p99 is not None and p99 > self.target_p99_s)
+                or (n > 0 and backlog / n > self.backlog_high))
+        under = ((p99 is None or p99 <= self.target_p99_s)
+                 and backlog == 0
+                 and occupancy < self.occupancy_low)
+        if over:
+            if len(self.router.engines) < self.max_engines:
+                action = self._scale_up()
+            elif self.flip_overload_policy \
+                    and self._saved_policies is None:
+                action = self._shed_mode()
+            else:
+                action = "hold"
+        elif self._saved_policies is not None \
+                and p99 is not None and p99 <= self.target_p99_s:
+            action = self._restore_policies()
+        elif under and n > self.min_engines:
+            action = self._start_drain()
+        else:
+            action = "hold"
+        return self._record(now, action, p99, backlog=backlog,
+                            occupancy=round(occupancy, 4))
+
+    def _record(self, now: float, action: str, p99: Optional[float],
+                **extra) -> dict:
+        d = {"t": round(now, 6), "action": action,
+             "p99_s": None if p99 is None else round(p99, 6),
+             "engines": len(self.router.engines),
+             "target_p99_s": self.target_p99_s, **extra}
+        self.decisions.append(d)
+        if action in ("scale_up", "scale_down", "drain", "shed_mode",
+                      "restore_policy"):
+            obs.emit_event("autoscale_decision", plane="serving",
+                           router=self.router._obs_name, **d)
+        return d

@@ -149,6 +149,13 @@ class EngineDegraded(RuntimeError):
     replacement pays no recompile)."""
 
 
+class EngineDraining(RuntimeError):
+    """submit() on an engine in drain mode (stop-admission): already
+    accepted work runs to completion, new work must go elsewhere —
+    the EngineRouter (serving/router.py) and the autoscaler's
+    scale-down path rely on exactly this contract."""
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3,))
 def _prefill_step(model, cache_dtype, params, cache, tokens, slot):
     """Prefill ONE request (1, bucket) and splice it into slot `slot`:
@@ -218,12 +225,20 @@ class GenerationResult:
     "cancelled"), 'expired' (deadline or queue-wait TTL), 'poisoned'
     (non-finite logits row), 'failed' (engine degraded mid-request).
     Non-done results keep whatever tokens were generated before the
-    terminal event."""
+    terminal event.
+
+    `latency_s` is submit→terminal and `ttft_s` submit→first-token,
+    both on the ENGINE clock (injectable — deterministic in drills;
+    None when unknown, e.g. ttft before any token). The same numbers
+    ride on the request_terminal event, so scripts/obs_report.py can
+    compute SLO percentiles from the JSONL alone."""
     id: int
     prompt: List[int]
     tokens: List[int]
     finish_reason: str
     status: str = "done"
+    ttft_s: Optional[float] = None
+    latency_s: Optional[float] = None
 
 
 class InferenceEngine:
@@ -346,6 +361,7 @@ class InferenceEngine:
         self._topp = np.ones(slots, np.float32)
         self._meta: Dict[int, Dict[str, float]] = {}  # id → submit time
         self._degraded: Optional[str] = None
+        self._draining = False
         if step_timeout_s is not None:
             # arming the watchdog opts into a warmup decode at
             # construction: the FIRST decode call traces+compiles
@@ -373,6 +389,47 @@ class InferenceEngine:
         """None while healthy, else the degradation reason."""
         return self._degraded
 
+    @property
+    def draining(self) -> bool:
+        """True once drain() was called (stop-admission mode)."""
+        return self._draining
+
+    @property
+    def idle(self) -> bool:
+        """No queued and no in-flight requests."""
+        return not self._queue and all(r is None for r in self._req)
+
+    @property
+    def slots_active(self) -> int:
+        """Occupied cache slots (the router's load signal)."""
+        return sum(r is not None for r in self._req)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def obs_name(self) -> str:
+        """This engine's registry/event label (see `obs_label`)."""
+        return self._obs_name
+
+    def drain(self) -> None:
+        """Enter stop-admission mode: subsequent submit() raises
+        EngineDraining; already-accepted requests (queued AND
+        in-flight) keep stepping to their normal terminal status.
+        health()['state'] reports 'draining' until the engine empties,
+        then 'drained' — the autoscaler removes an engine only after
+        that transition, so scale-down never loses a request.
+        Idempotent; there is deliberately no undrain (a drained engine
+        is retired — build a fresh one, executables are shared)."""
+        if self._draining:
+            return
+        self._draining = True
+        obs.emit_event("engine_drain", plane="serving",
+                       engine=self._obs_name,
+                       queued=len(self._queue),
+                       active=sum(r is not None for r in self._req))
+
     def health(self) -> Dict[str, object]:
         """Operational snapshot: engine state, slot occupancy, queue
         depth + per-bucket composition, p50/p95 decode-step latency,
@@ -391,13 +448,19 @@ class InferenceEngine:
             v = self._m_lat.quantile(q)
             return None if v is None else round(v * 1e3, 3)
 
+        if self._degraded:
+            state = "degraded"
+        elif self._draining:
+            state = "drained" if self.idle else "draining"
+        else:
+            state = "ok"
         s = self._stats
         return {
-            "state": "degraded" if self._degraded else "ok",
+            "state": state,
             "degraded_reason": self._degraded,
             "slots": self.slots,
-            "slots_active": sum(r is not None for r in self._req),
-            "queue_depth": len(self._queue),
+            "slots_active": self.slots_active,
+            "queue_depth": self.queue_depth,
             "queue_buckets": bucket_histogram(
                 [len(r.prompt) for r in self._queue], self.buckets),
             "decode_p50_ms": pct(0.50),
@@ -429,6 +492,10 @@ class InferenceEngine:
                 f"engine degraded ({self._degraded}); build a fresh "
                 "engine — same-model executables are shared, so the "
                 "replacement pays no recompile")
+        if self._draining:
+            raise EngineDraining(
+                "engine is draining (stop-admission): route new "
+                "requests to another engine in the pool")
         if n == 0:
             raise ValueError("empty prompt")
         if request.max_new_tokens < 1:
@@ -508,6 +575,39 @@ class InferenceEngine:
                 return res
         raise KeyError(f"request {request_id} is not queued or in flight")
 
+    def steal_queued(self, k: int) -> List[Tuple[Request, float]]:
+        """Give up to `k` queued requests (with their original submit
+        stamps) to the fleet router for rebalancing — the ones THIS
+        engine's scheduler would serve last (lowest priority; youngest
+        within a priority — the exact inverse of _pop_next), so work
+        moves from the back of a long line to an engine with idle
+        capacity. A request that actually moves is restamped by the
+        receiving engine's submit (deadline TTLs restart — the
+        conservative direction); one that BOUNCES back comes home via
+        _requeue with its original stamp, so a failed move never
+        extends a TTL. Never touches in-flight slots."""
+        out: List[Tuple[Request, float]] = []
+        for _ in range(min(k, len(self._queue))):
+            best_i, best_p = 0, None
+            for i, r in enumerate(self._queue):
+                if best_p is None or r.priority <= best_p:
+                    best_i, best_p = i, r.priority
+            req = self._queue[best_i]
+            del self._queue[best_i]
+            meta = self._meta.pop(req.id, None)
+            out.append((req, meta["t"] if meta else self._clock()))
+        return out
+
+    def _requeue(self, request: Request,
+                 t: Optional[float] = None) -> None:
+        """Router-only undo of a steal that found no taker: back onto
+        the queue, bypassing the admission gates (the request was
+        already admitted once). `t` restores the original submit
+        stamp — a bounced move must not restart the TTL clock."""
+        self._meta[request.id] = {"t": self._clock() if t is None
+                                 else t}
+        self._queue.append(request)
+
     def _free_slots(self) -> List[int]:
         return [i for i, r in enumerate(self._req) if r is None]
 
@@ -531,8 +631,20 @@ class InferenceEngine:
         else:
             self._m_ops[key].inc(n)
 
+    def _lifecycle_times(self, req: Request
+                         ) -> Tuple[Optional[float], Optional[float]]:
+        """(ttft_s, latency_s) for a request reaching terminal NOW,
+        from the engine clock — read BEFORE _meta is popped."""
+        meta = self._meta.get(req.id)
+        if meta is None or "t" not in meta:
+            return None, None
+        latency = self._clock() - meta["t"]
+        tf = meta.get("t_first")
+        return (None if tf is None else tf - meta["t"]), latency
+
     def _observe_terminal(self, req: Request, reason: str, status: str,
-                          tokens: int) -> None:
+                          tokens: int, ttft_s: Optional[float],
+                          latency_s: Optional[float]) -> None:
         """Telemetry for a request's terminal transition: structured
         event + (tracer on) a whole-lifecycle span stamped with the
         ENGINE clock, so deadline drills trace deterministically."""
@@ -541,7 +653,8 @@ class InferenceEngine:
         now = self._clock()
         obs.emit_event("request_terminal", plane="serving",
                        engine=self._obs_name, request=req.id,
-                       status=status, reason=reason, tokens=tokens)
+                       status=status, reason=reason, tokens=tokens,
+                       ttft_s=ttft_s, latency_s=latency_s)
         tracer = obs.get_tracer()
         if tracer.enabled:
             t0 = self._meta.get(req.id, {}).get("t", now)
@@ -553,11 +666,12 @@ class InferenceEngine:
                   ) -> GenerationResult:
         """Terminal event for a request that never reached (or is no
         longer in) a slot — result goes straight to `completed`."""
-        self._observe_terminal(req, reason, status, 0)
+        ttft, latency = self._lifecycle_times(req)
+        self._observe_terminal(req, reason, status, 0, ttft, latency)
         self._meta.pop(req.id, None)
         self._bump(_STATUS_COUNTER[status])
         res = GenerationResult(req.id, list(req.prompt), [], reason,
-                               status)
+                               status, ttft_s=ttft, latency_s=latency)
         self.completed[req.id] = res
         return res
 
@@ -629,10 +743,12 @@ class InferenceEngine:
     def _finish(self, slot: int, reason: str,
                 status: str = "done") -> GenerationResult:
         req = self._req[slot]
+        ttft, latency = self._lifecycle_times(req)
         res = GenerationResult(req.id, list(req.prompt),
-                               self._gen[slot], reason, status)
+                               self._gen[slot], reason, status,
+                               ttft_s=ttft, latency_s=latency)
         self._observe_terminal(req, reason, status,
-                               len(self._gen[slot]))
+                               len(self._gen[slot]), ttft, latency)
         self._req[slot] = None
         self._gen[slot] = []
         self._temp[slot] = 0.0
@@ -812,6 +928,8 @@ class InferenceEngine:
                 done.append(self._finish(i, "stop_id"))
                 continue
             self._gen[i].append(tok)
+            if len(self._gen[i]) == 1 and req.id in self._meta:
+                self._meta[req.id]["t_first"] = now   # TTFT stamp
             if len(self._gen[i]) >= req.max_new_tokens:
                 done.append(self._finish(i, "max_tokens"))
             elif now >= self._deadline_at(req):

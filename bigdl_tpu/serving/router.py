@@ -1,0 +1,505 @@
+"""Fleet plane: a health-gated router fronting a pool of
+InferenceEngines (ISSUE 7 tentpole).
+
+BigDL's Cluster Serving scales by putting elasticity and recovery one
+level ABOVE the worker (arXiv 2204.01715; the Spark-era driver plays
+the same role for training, arXiv 1804.05839) — the worker stays
+simple, the layer above watches health and moves work. `EngineRouter`
+is that layer for the serving plane:
+
+* **Health-gated dispatch.** submit() ranks candidate engines by load
+  (occupied slots + queue depth, normalized by slot count; ties break
+  on pool index — fully deterministic) and skips engines that are
+  degraded or draining. The signals are the same ones
+  `engine.health()` exports; the router reads the cheap properties
+  directly so dispatch costs two ints per engine.
+* **Priority-aware spillover.** When the chosen engine's bounded
+  queue rejects (OverloadError), the request spills to the next
+  engine in load order; only when EVERY healthy engine rejects does
+  the router re-raise. Under the shed-* overload policies admission
+  happens on the least-loaded engine, whose shed-lowest-priority
+  victim selection then makes fleet admission priority-aware: a
+  high-priority arrival displaces the pool's lowest-priority queued
+  request instead of being turned away.
+* **Failover.** When an engine degrades (watchdog trip, exhausted
+  retry budget), every request it held — queued AND in-flight — is
+  resubmitted to the surviving engines and RE-DECODED FROM THE
+  PROMPT. Because per-request sampling keys are
+  fold_in(PRNGKey(seed), #generated) — independent of slot, co-batch,
+  and arrival order — the rerouted requests complete with tokens
+  BIT-IDENTICAL to an undisturbed run (drilled:
+  scripts/fault_drill.py fleet_failover). Zero requests are lost; the
+  transitional 'failed' results are superseded, not surfaced.
+* **Drain / pool mutation.** drain() flips an engine to
+  stop-admission (new traffic routes around it, accepted work
+  finishes — engine.drain()); once 'drained' (or degraded) the engine
+  can be remove_engine()'d, and add_engine() grows the pool (via the
+  `engine_factory`, the autoscaler's lever). Engines over the same
+  model object share jitted executables, so growing the pool compiles
+  NOTHING new — the #buckets+1 contract holds fleet-wide
+  (tests/test_router.py pins it).
+
+Determinism contract: the router does no wall-clock reads (clock is
+injectable, default time.monotonic as the injection point), no device
+work and no RNG — its entire state machine is a function of the
+submit/step call sequence, which is what makes the fleet drills
+bit-reproducible.
+
+Telemetry: dispatch/spillover/failover counters and the pool-size
+gauge mirror into the obs registry under this router's label;
+`router_request_latency_seconds` (submit→done on the router clock,
+surviving failover) is fed unconditionally — the Autoscaler's SLO
+input and health() percentiles are core bookkeeping, like the
+engine's decode histogram.
+
+Engines fronted by a router are driven ONLY through it (the router
+harvests `engine.completed`; a concurrent engine.run() would race the
+harvest).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from bigdl_tpu import obs
+from bigdl_tpu.serving.engine import (GenerationResult, InferenceEngine,
+                                      OverloadError, Request)
+
+# router-level latency buckets: the engine's decode histogram spans
+# 100 us..10 s, but request lifecycles under queueing (and the
+# loadgen harness's virtual seconds) reach far past that — one FIXED
+# family-wide set, because the registry (correctly) rejects two
+# routers disagreeing on a metric's buckets
+ROUTER_LATENCY_BUCKETS: Tuple[float, ...] = (
+    1e-3, 5e-3, 1e-2, 5e-2, 1e-1, 2.5e-1, 5e-1, 1.0, 2.5, 5.0,
+    10.0, 20.0, 40.0, 80.0, 160.0)
+
+_ROUTER_IDS = itertools.count()
+
+
+class NoHealthyEngine(RuntimeError):
+    """submit() with every pool engine degraded or draining."""
+
+
+@dataclass
+class _Assignment:
+    """Router bookkeeping for one in-flight request: the original
+    Request (resubmitted verbatim on failover), its current engine,
+    a monotone sequence number (failover preserves submission order),
+    and the router-clock submit time (latency survives failover)."""
+    request: Request
+    engine: InferenceEngine
+    seq: int
+    t: float
+
+
+class EngineRouter:
+    """Front a pool of engines behind the engine's own
+    submit()/run()/step() surface.
+
+    >>> router = EngineRouter([eng_a, eng_b])
+    >>> router.submit(Request(prompt=[1, 2, 3]))
+    >>> results = router.run()       # drain the whole pool
+
+    Knobs: `engine_factory` (zero-arg callable building a
+    pool-compatible engine — same model object, same clock; required
+    for add_engine()/autoscaling), `clock` (monotonic-seconds source
+    shared with the request-latency bookkeeping), `obs_label`
+    (registry label; lets a rebuilt router continue its series)."""
+
+    def __init__(self, engines: Sequence[InferenceEngine],
+                 engine_factory: Optional[
+                     Callable[[], InferenceEngine]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 obs_label: Optional[str] = None):
+        if not engines:
+            raise ValueError("EngineRouter needs at least one engine")
+        self.engines: List[InferenceEngine] = list(engines)
+        self.engine_factory = engine_factory
+        self._clock = clock
+        self.completed: Dict[int, GenerationResult] = {}
+        self._pending: Dict[int, _Assignment] = {}
+        # terminals settled OUTSIDE a step() call (submit-time shed
+        # victims, final-sweep harvests) are buffered and surfaced by
+        # the NEXT step() return — every terminal crosses step()
+        # exactly once, which is what lets a driver loop (loadgen)
+        # account for every request it submitted
+        self._settled_backlog: List[GenerationResult] = []
+        self._ids = itertools.count()
+        self._seq = itertools.count()
+        self._stats: Dict[str, int] = {
+            "dispatched": 0, "spillover": 0, "failover": 0,
+            "failover_lost": 0, "rejected": 0, "rebalanced": 0,
+            "engines_added": 0, "engines_removed": 0,
+        }
+        self._obs_name = obs_label or f"router{next(_ROUTER_IDS)}"
+        reg = obs.get_registry()
+        self._m_dispatch = reg.counter(
+            "router_dispatch_total",
+            "requests dispatched to an engine",
+            labelnames=("router", "engine"))
+        self._m_ops = {
+            key: reg.counter(f"router_{key}_total", help_,
+                             labelnames=("router",)
+                             ).labels(router=self._obs_name)
+            for key, help_ in {
+                "spillover": "dispatches that spilled past the "
+                             "first-choice engine",
+                "failover": "requests rerouted off a degraded engine",
+                "failover_lost": "degraded-engine requests with no "
+                                 "surviving engine to take them",
+                "rejected": "submissions rejected by every engine",
+                "rebalanced": "queued requests moved between engines",
+            }.items()}
+        self._m_pool = reg.gauge(
+            "router_pool_size", "engines in the pool",
+            labelnames=("router",)).labels(router=self._obs_name)
+        self._m_pool.set(len(self.engines))
+        # submit→done latency on the router clock — fed
+        # unconditionally (core bookkeeping: the Autoscaler's SLO
+        # input and health() percentiles read it; BIGDL_OBS=off gates
+        # events and counter mirrors only, exactly like the engine's
+        # decode histogram)
+        self._m_latency = reg.histogram(
+            "router_request_latency_seconds",
+            "request submit→done wall seconds (router clock, "
+            "failover included)",
+            labelnames=("router",),
+            buckets=ROUTER_LATENCY_BUCKETS).labels(
+                router=self._obs_name)
+
+    # ------------------------------------------------------------- helpers
+    def _bump(self, key: str, n: int = 1) -> None:
+        self._stats[key] += n
+        if obs.enabled() and key in self._m_ops:
+            self._m_ops[key].inc(n)
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        return dict(self._stats)
+
+    def healthy_engines(self) -> List[InferenceEngine]:
+        """Engines accepting new work (not degraded, not draining)."""
+        return [e for e in self.engines
+                if e.degraded is None and not e.draining]
+
+    def _ranked(self) -> List[InferenceEngine]:
+        """Healthy engines by load, least-loaded first; ties break on
+        pool index (deterministic dispatch)."""
+        scored = [((e.slots_active + e.queue_depth) / max(e.slots, 1),
+                   i, e)
+                  for i, e in enumerate(self.engines)
+                  if e.degraded is None and not e.draining]
+        return [e for _, _, e in sorted(scored, key=lambda s: s[:2])]
+
+    def _resolve(self, engine) -> InferenceEngine:
+        if isinstance(engine, InferenceEngine):
+            if engine not in self.engines:
+                raise ValueError("engine is not in this router's pool")
+            return engine
+        return self.engines[engine]
+
+    # -------------------------------------------------------------- submit
+    def submit(self, request: Request) -> int:
+        """Dispatch to the least-loaded healthy engine, spilling past
+        bounded queues that reject. Raises NoHealthyEngine with an
+        empty healthy set, or OverloadError when every healthy engine
+        rejects (reject overload policy pool-wide). Under shed-*
+        policies the admitting engine may shed a victim (or the
+        request itself) — the result surfaces through the router like
+        any other terminal, never a KeyError."""
+        if request.id is None:
+            rid = next(self._ids)
+            while rid in self._pending or rid in self.completed:
+                rid = next(self._ids)
+            request.id = rid
+        elif request.id in self._pending \
+                or request.id in self.completed:
+            raise ValueError(f"request id {request.id} already in "
+                             "flight or completed-unclaimed")
+        order = self._ranked()
+        if not order:
+            raise NoHealthyEngine(
+                "no healthy engine in the pool (all degraded or "
+                "draining)")
+        last_err: Optional[OverloadError] = None
+        for nth, eng in enumerate(order):
+            try:
+                eng.submit(request)
+            except OverloadError as e:
+                last_err = e
+                continue
+            self._pending[request.id] = _Assignment(
+                request, eng, next(self._seq), self._clock())
+            self._bump("dispatched")
+            if obs.enabled():
+                self._m_dispatch.labels(
+                    router=self._obs_name,
+                    engine=eng.obs_name).inc()
+            if nth > 0:
+                self._bump("spillover")
+            self._harvest(eng, None)     # shed victim / shed-self
+            return request.id
+        self._bump("rejected")
+        raise last_err if last_err is not None else OverloadError(
+            "every healthy engine rejected the request")
+
+    # ---------------------------------------------------------- settlement
+    def _settle(self, res: GenerationResult, eng: InferenceEngine,
+                out: Optional[List[GenerationResult]]) -> None:
+        asg = self._pending.get(res.id)
+        if asg is None or asg.engine is not eng:
+            return                        # stale result of a rerouted id
+        if res.status == "failed" and eng.degraded is not None \
+                and self._refer(asg):
+            return                        # superseded by the reroute
+        del self._pending[res.id]
+        # lifecycle stamps tell the whole truth at the fleet level:
+        # the engine stamped latency/ttft from its OWN submit time,
+        # which resets when a request is rebalanced or failed over —
+        # promote both to the ROUTER submit time (the clocks are the
+        # same injected source in a well-formed fleet), so SLO reports
+        # never under-count the queue time paid before a move
+        total = self._clock() - asg.t
+        if res.latency_s is None:
+            res.latency_s = total
+        elif total > res.latency_s:
+            bump = total - res.latency_s
+            res.latency_s = total
+            if res.ttft_s is not None:
+                res.ttft_s += bump
+        self.completed[res.id] = res
+        if res.status == "done":
+            self._m_latency.observe(total)
+        if out is not None:
+            out.append(res)
+        else:
+            self._settled_backlog.append(res)
+
+    def _refer(self, asg: _Assignment) -> bool:
+        """Failover one assignment off its (degraded) engine: resubmit
+        the ORIGINAL request to the least-loaded survivor. The request
+        re-decodes from its prompt there; fold_in(seed, n) sampling
+        makes the regenerated tokens bit-identical to an undisturbed
+        run. Deadline TTLs restart at resubmission (the original
+        submit time is kept for latency accounting only)."""
+        for eng in self._ranked():
+            if eng is asg.engine:
+                continue
+            try:
+                eng.submit(asg.request)
+            except OverloadError:
+                continue
+            from_label = asg.engine.obs_name
+            asg.engine = eng
+            self._bump("failover")
+            obs.emit_event(
+                "router_failover", plane="serving",
+                router=self._obs_name, request=asg.request.id,
+                source=from_label,
+                target=eng.obs_name)
+            return True
+        self._bump("failover_lost")
+        return False
+
+    def _harvest(self, eng: InferenceEngine,
+                 out: Optional[List[GenerationResult]]) -> None:
+        """Claim results the engine settled outside step() returns —
+        shed victims at submit time, queued requests failed by a
+        degradation."""
+        owned = [rid for rid, res in eng.completed.items()
+                 if rid in self._pending
+                 and self._pending[rid].engine is eng]
+        for rid in owned:
+            self._settle(eng.completed.pop(rid), eng, out)
+
+    # ----------------------------------------------------------- rebalance
+    def _rebalance(self) -> None:
+        """Move queued (never in-flight) requests from backlogged
+        engines onto engines with idle capacity, so scale-up actually
+        absorbs an existing backlog (a freshly added engine would
+        otherwise sit empty while the old one's queue serializes) and
+        draining engines hand their line to the rest of the pool.
+        Donors give up the requests they would serve LAST
+        (engine.steal_queued); receivers take only what they can admit
+        on the next round, so a moved request never waits twice."""
+        for ri, recv in sorted(
+                ((i, e) for i, e in enumerate(self.engines)
+                 if e.degraded is None and not e.draining),
+                key=lambda ie: ((ie[1].slots_active
+                                 + ie[1].queue_depth)
+                                / max(ie[1].slots, 1), ie[0])):
+            room = (recv.slots - recv.slots_active) - recv.queue_depth
+            if recv.max_queue is not None:
+                room = min(room, recv.max_queue - recv.queue_depth)
+            while room > 0:
+                donor = None
+                excess_best = 0
+                for e in self.engines:
+                    if e is recv or e.degraded is not None:
+                        continue
+                    free = e.slots - e.slots_active
+                    excess = e.queue_depth - (0 if e.draining
+                                              else free)
+                    if excess > excess_best:
+                        donor, excess_best = e, excess
+                if donor is None:
+                    break
+                moved = donor.steal_queued(min(room, excess_best))
+                if not moved:
+                    break
+                n_ok = 0
+                for mi, (req, t0) in enumerate(moved):
+                    try:
+                        recv.submit(req)
+                    except OverloadError:   # racing expiry shrank room
+                        # bounce the whole remainder home with their
+                        # ORIGINAL stamps — a failed move never resets
+                        # a TTL, and retrying the rest is pointless
+                        for r, rt in moved[mi:]:
+                            donor._requeue(r, rt)
+                        room = 0
+                        break
+                    if req.id in self._pending:
+                        self._pending[req.id].engine = recv
+                    self._bump("rebalanced")
+                    n_ok += 1
+                    room -= 1
+                if n_ok:
+                    obs.emit_event("router_rebalance", plane="serving",
+                                   router=self._obs_name,
+                                   source=donor.obs_name,
+                                   target=recv.obs_name, moved=n_ok)
+
+    # ---------------------------------------------------------------- step
+    def step(self) -> List[GenerationResult]:
+        """One scheduling round: queued work rebalances toward idle
+        capacity, then every live engine admits + decodes once;
+        terminal results are settled, and a degradation triggers
+        failover of everything the dead engine held. Returns the
+        requests that reached a FINAL terminal state this round
+        (transitional 'failed' results that were rerouted are not
+        surfaced); terminals settled between steps — submit-time shed
+        victims — ride the next return, so a driver loop sees every
+        request it submitted exactly once."""
+        self._rebalance()
+        out: List[GenerationResult] = list(self._settled_backlog)
+        self._settled_backlog.clear()
+        for eng in list(self.engines):
+            results = [] if eng.degraded is not None else eng.step()
+            # in-flight failures first (admitted earlier), then the
+            # queued ones the degradation parked in eng.completed —
+            # failover preserves original admission order
+            for res in sorted(
+                    results,
+                    key=lambda r: self._pending[r.id].seq
+                    if r.id in self._pending else -1):
+                self._settle(res, eng, out)
+            self._harvest(eng, out)
+        return out
+
+    def run(self, requests: Optional[Sequence[Request]] = None
+            ) -> List[GenerationResult]:
+        """Submit `requests` (if given), then step the pool until every
+        engine drains. Returns `requests`' results in submission order
+        (or, with no argument, everything that finished, id order) —
+        identical semantics to InferenceEngine.run, one level up."""
+        ids = [self.submit(r) for r in requests] if requests else None
+        while any(not e.idle for e in self.engines):
+            self.step()
+        for eng in self.engines:          # final sweep: late sheds
+            self._harvest(eng, None)
+        # run() delivers through its return value — don't re-surface
+        # these through a later step()
+        self._settled_backlog.clear()
+        if ids is None:
+            out = sorted(self.completed.values(), key=lambda r: r.id)
+            self.completed = {}
+            return out
+        return [self.completed.pop(i) for i in ids]
+
+    # ------------------------------------------------------- pool mutation
+    def add_engine(self, engine: Optional[InferenceEngine] = None
+                   ) -> InferenceEngine:
+        """Grow the pool (the autoscaler's scale-up lever). With no
+        argument the `engine_factory` builds the engine — over the
+        same model object, so the newcomer compiles nothing."""
+        if engine is None:
+            if self.engine_factory is None:
+                raise ValueError("add_engine() without an engine "
+                                 "needs an engine_factory")
+            engine = self.engine_factory()
+        self.engines.append(engine)
+        self._bump("engines_added")
+        self._m_pool.set(len(self.engines))
+        obs.emit_event("engine_added", plane="serving",
+                       router=self._obs_name,
+                       engine=engine.obs_name,
+                       pool_size=len(self.engines))
+        return engine
+
+    def drain(self, engine) -> InferenceEngine:
+        """Flip one engine (by index or identity) to stop-admission:
+        the router routes new traffic around it while its accepted
+        work finishes; once health() reports 'drained' it is safe to
+        remove_engine()."""
+        eng = self._resolve(engine)
+        eng.drain()
+        return eng
+
+    def remove_engine(self, engine) -> InferenceEngine:
+        """Retire an engine. Only a 'drained' or degraded engine with
+        no router-owned work still assigned may leave the pool —
+        scale-down can never lose a request."""
+        eng = self._resolve(engine)
+        state = eng.health()["state"]
+        if state not in ("drained", "degraded"):
+            raise ValueError(
+                f"engine is {state!r}; drain() it (or let failover "
+                "finish) before removing")
+        if any(a.engine is eng for a in self._pending.values()):
+            raise ValueError("engine still holds router-owned "
+                             "requests; step() the pool first")
+        self._harvest(eng, None)
+        self.engines.remove(eng)
+        self._bump("engines_removed")
+        self._m_pool.set(len(self.engines))
+        obs.emit_event("engine_removed", plane="serving",
+                       router=self._obs_name,
+                       engine=eng.obs_name,
+                       state=state, pool_size=len(self.engines))
+        return eng
+
+    # --------------------------------------------------------------- views
+    def health(self) -> Dict[str, object]:
+        """Pool snapshot: per-engine health() plus the fleet rollup
+        the autoscaler consumes (aggregate occupancy/backlog, request
+        latency percentiles from the router histogram)."""
+        per = [e.health() for e in self.engines]
+        healthy = self.healthy_engines()
+
+        def pct(q):
+            v = self._m_latency.quantile(q)
+            return None if v is None else round(v * 1e3, 3)
+
+        return {
+            "pool_size": len(self.engines),
+            "healthy": len(healthy),
+            "states": [h["state"] for h in per],
+            "slots": sum(e.slots for e in healthy),
+            "slots_active": sum(e.slots_active for e in healthy),
+            "queue_depth": sum(e.queue_depth for e in healthy),
+            "request_p50_ms": pct(0.50),
+            "request_p99_ms": pct(0.99),
+            "stats": self.stats,
+            "engines": per,
+        }
+
+    @property
+    def request_latency(self):
+        """The router's request-latency histogram child (buckets /
+        counts / quantile) — the Autoscaler's SLO input."""
+        return self._m_latency

@@ -53,6 +53,25 @@ with utils/faults serving kinds injected by DECODE step number:
                     requests fail with status 'failed', the engine
                     quiesces and health() reports the trip
 
+Fleet legs (ISSUE 7 — the router/autoscaler layer above the engines,
+bigdl_tpu/serving/router.py + autoscaler.py):
+
+    fleet_failover  serve_slow trips the watchdog on engine 0 of a
+                    2-engine router MID-DECODE: every request it held
+                    (in-flight and queued) fails over to engine 1 and
+                    completes with tokens BIT-IDENTICAL to an
+                    undisturbed single-engine run — zero requests lost
+    fleet_drain     drain one engine mid-traffic: its accepted work
+                    finishes normally ('draining'→'drained'), direct
+                    submit raises EngineDraining, new traffic routes
+                    to the survivor, and the drained engine leaves the
+                    pool without losing a request
+    fleet_autoscale the same deterministic loadgen burst against a
+                    fixed 1-engine pool (violates the p99 target) and
+                    an autoscaled pool (grows to 3, rebalances the
+                    backlog, holds the target) — decision sequence and
+                    load report bit-identical across runs
+
 Every training leg compares parameters BIT-FOR-BIT against an
 uninterrupted reference run (same init, same deterministic batch
 stream, same rng folding); every serving leg compares generated
@@ -355,6 +374,28 @@ def _plan(spec):
     return fm
 
 
+_LOADGEN = None
+
+
+def _loadgen():
+    """scripts/loadgen.py as a module (cached; registered in
+    sys.modules first — its dataclasses need that)."""
+    global _LOADGEN
+    if _LOADGEN is None:
+        _LOADGEN = sys.modules.get("bigdl_loadgen")
+    if _LOADGEN is None:
+        import importlib.util
+
+        path = os.path.join(os.path.dirname(__file__), "loadgen.py")
+        spec = importlib.util.spec_from_file_location(
+            "bigdl_loadgen", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["bigdl_loadgen"] = mod
+        spec.loader.exec_module(mod)
+        _LOADGEN = mod
+    return _LOADGEN
+
+
 def drill_serve_poison(workdir):
     """serve_nan at decode step 2 poisons slot 0 (request A) inside the
     jitted step: A evicts with status 'poisoned' after its 2 clean
@@ -577,6 +618,170 @@ def drill_serve_watchdog(workdir):
             "events": log.counts_by_kind()}
 
 
+# ------------------------------------------------------------ fleet legs
+
+def drill_fleet_failover(workdir):
+    """serve_slow@2 trips the watchdog on engine 0 of a 2-engine
+    router mid-decode: its in-flight requests (2 tokens deep) AND its
+    queued request fail over to engine 1, re-decode from their
+    prompts, and finish with tokens BIT-IDENTICAL to an undisturbed
+    single-engine run — fold_in(seed, n) sampling is slot/co-batch/
+    arrival independent, so the reroute is invisible in the output.
+    Zero requests lost; the transitional 'failed' terminals are
+    superseded, never surfaced."""
+    from bigdl_tpu.serving import EngineRouter
+
+    specs = [dict(prompt=[i + 1, i + 2, i + 3], max_new_tokens=5,
+                  temperature=0.8, seed=20 + i) for i in range(6)]
+    ref = _engine(slots=2).run([_req(**s) for s in specs])
+    fm = _plan("serve_slow@2")
+    try:
+        with _telemetry() as log:
+            e0 = _engine(step_timeout_s=0.05)   # watchdog-armed
+            e1 = _engine()
+            router = EngineRouter([e0, e1])
+            got = router.run([_req(**s) for s in specs])
+    finally:
+        fm.set_plan(None)
+    degraded_ev = log.events("engine_degraded")
+    failover_ev = log.events("router_failover")
+    failed_ev = log.events("request_terminal", status="failed")
+    done_ev = log.events("request_terminal", status="done")
+    bit_identical = [g.tokens for g in got] == [r.tokens for r in ref]
+    ok = (e0.degraded is not None and "watchdog" in e0.degraded
+          and all(g.status == "done" for g in got)
+          and bit_identical
+          and router.stats["failover"] == 3      # 2 in-flight + 1 queued
+          and router.stats["failover_lost"] == 0
+          and len(failover_ev) == 3
+          and len(degraded_ev) == 1
+          and len(failed_ev) == 3                # superseded transitions
+          and len(done_ev) == 6)                 # every request completes
+    return {"ok": bool(ok),
+            "statuses": [g.status for g in got],
+            "bit_identical_to_undisturbed": bit_identical,
+            "failovers": router.stats["failover"],
+            "degraded_engine": e0.degraded,
+            "events": log.counts_by_kind()}
+
+
+def drill_fleet_drain(workdir):
+    """Drain engine 0 of a 2-engine router mid-traffic: its accepted
+    work (in-flight + own queue) finishes normally while direct
+    submission raises EngineDraining and router traffic flows to
+    engine 1 only; the health state walks 'draining'→'drained', the
+    engine leaves the pool, and every token matches the undisturbed
+    single-engine oracle."""
+    from bigdl_tpu.serving import EngineDraining, EngineRouter
+
+    specs = [dict(prompt=[i + 2, i + 3], max_new_tokens=4,
+                  temperature=0.6, seed=40 + i) for i in range(8)]
+    ref = _engine(slots=2).run([_req(**s) for s in specs])
+    with _telemetry() as log:
+        e0, e1 = _engine(), _engine()
+        router = EngineRouter([e0, e1])
+        ids = [router.submit(_req(**s)) for s in specs[:6]]
+        router.step()                       # both engines decoding
+        router.drain(e0)
+        state_mid = e0.health()["state"]
+        gated = False
+        try:
+            e0.submit(_req(prompt=[1, 2]))
+        except EngineDraining:
+            gated = True
+        late = [router.submit(_req(**s)) for s in specs[6:]]
+        while any(not e.idle for e in router.engines):
+            router.step()
+        state_end = e0.health()["state"]
+        removed = router.remove_engine(e0)
+        res = {i: router.completed[i] for i in ids + late}
+    drain_ev = log.events("engine_drain")
+    removed_ev = log.events("engine_removed")
+    toks = [res[i].tokens for i in ids + late]
+    bit_identical = toks == [r.tokens for r in ref]
+    ok = (state_mid == "draining" and state_end == "drained"
+          and gated and removed is e0
+          and len(router.engines) == 1
+          and all(r.status == "done" for r in res.values())
+          and bit_identical
+          # the late submissions never touched the draining engine
+          and e1.stats["requests_done"] >= 2 + 3
+          and e0.stats["requests_done"] + e1.stats["requests_done"] == 8
+          and len(drain_ev) == 1 and len(removed_ev) == 1)
+    return {"ok": bool(ok), "state_mid": state_mid,
+            "state_end": state_end, "submit_gated": gated,
+            "bit_identical_to_undisturbed": bit_identical,
+            "done_split": [e0.stats["requests_done"],
+                           e1.stats["requests_done"]],
+            "rebalanced": router.stats["rebalanced"],
+            "events": log.counts_by_kind()}
+
+
+def drill_fleet_autoscale(workdir):
+    """One deterministic loadgen burst (24 requests at t=0), twice:
+    a FIXED 1-engine pool grossly violates the 10-virtual-second p99
+    target; the autoscaled pool grows to 3 engines, rebalances the
+    backlog onto them, and holds the target. The autoscaled run
+    executes twice more — decision sequence and full load report must
+    be bit-identical (the closed loop is a pure function of registry
+    state and the injected clock)."""
+    lg = _loadgen()
+
+    def burst():
+        return lg.make_trace(24, seed=3, arrival="bursty",
+                             burst_size=24,
+                             prompt_len_choices=(3, 5, 8),
+                             max_new_choices=(4,), priorities=(0,))
+
+    def run(autoscale):
+        from bigdl_tpu.serving import Autoscaler, EngineRouter
+
+        with _telemetry() as log:
+            clk = {"t": 0.0}
+
+            def factory():
+                return _engine(clock=lambda: clk["t"])
+
+            router = EngineRouter([factory()], engine_factory=factory,
+                                  clock=lambda: clk["t"])
+            asc = Autoscaler(router, target_p99_s=10.0, max_engines=3,
+                             evaluate_every_s=0.5, backlog_high=8.0) \
+                if autoscale else None
+            report = lg.replay(router, burst(), clock=clk,
+                               step_dt=0.5, autoscaler=asc)
+            counts = log.counts_by_kind()
+        return report, counts
+
+    fixed, _ = run(False)
+    auto, auto_ev = run(True)
+    auto2, _ = run(True)
+    target = 10.0
+    actions = [d["action"] for d in auto["autoscale"]["decisions"]]
+    ok = (fixed["latency_p99_s"] > target
+          and auto["latency_p99_s"] <= target
+          and fixed["by_status"] == {"done": 24}
+          and auto["by_status"] == {"done": 24}
+          and actions[:2] == ["scale_up", "scale_up"]
+          # the tail may already be scaling back down — pool peaked
+          # at max_engines either way
+          and max(d["engines"] for d in auto["autoscale"]["decisions"])
+          == 3
+          and auto["pool"]["router"]["rebalanced"] > 0
+          and auto == auto2                      # bit-deterministic
+          and auto_ev.get("autoscale_decision", 0) >= 2
+          and auto_ev.get("engine_added", 0) == 2)
+    return {"ok": bool(ok), "target_p99_s": target,
+            "fixed_p99_s": fixed["latency_p99_s"],
+            "autoscaled_p99_s": auto["latency_p99_s"],
+            "engines_peak": max(d["engines"]
+                                for d in auto["autoscale"]["decisions"]),
+            "engines_final": auto["pool"]["engines_final"],
+            "decisions": actions,
+            "rebalanced": auto["pool"]["router"]["rebalanced"],
+            "deterministic": auto == auto2,
+            "events": auto_ev}
+
+
 TRAINING_LEGS = {
     "nan_skip": drill_nan_skip,
     "nan_skip_mesh": lambda wd: drill_nan_skip(wd, mesh=True),
@@ -593,6 +798,9 @@ SERVING_LEGS = {
     "serve_deadline": drill_serve_deadline,
     "serve_retry": drill_serve_retry,
     "serve_watchdog": drill_serve_watchdog,
+    "fleet_failover": drill_fleet_failover,
+    "fleet_drain": drill_fleet_drain,
+    "fleet_autoscale": drill_fleet_autoscale,
 }
 
 LEGS = {**TRAINING_LEGS, **SERVING_LEGS}

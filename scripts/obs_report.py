@@ -8,6 +8,11 @@ Input: a file written by the structured event log
 * training summary: steps, loss first→last, throughput, anomalies
 * serving summary: requests by terminal status, tokens generated,
   degradations
+* latency-SLO section (ISSUE 7): per-engine goodput, TTFT and
+  per-token p50/p99, shed/expired/poisoned rates — computed from the
+  `ttft_s`/`latency_s` lifecycle stamps the engine puts on every
+  `request_terminal` event (engine clock, so a drill log yields
+  bit-deterministic percentiles)
 * metrics tables + latency percentiles, when the file carries a
   `metrics_snapshot` event (`obs.log_metrics_snapshot()` embeds the
   registry, making the JSONL self-contained)
@@ -27,6 +32,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import math
 import os
 import sys
 from typing import Dict, List, Optional
@@ -77,6 +83,7 @@ def summarize(events: List[dict]) -> Dict[str, object]:
             "degradations": by_kind.get("engine_degraded", 0),
             "rejected": by_kind.get("request_rejected", 0),
         }
+        out["slo"] = _slo_section(term)
     faults = [e for e in events if e.get("kind") == "fault_injected"]
     if faults:
         out["faults"] = [f'{e["fault"]}@{e["step"]}' for e in faults]
@@ -90,6 +97,72 @@ def summarize(events: List[dict]) -> Dict[str, object]:
     if snaps:
         out["metrics"] = _digest_snapshot(snaps[-1]["snapshot"])
     return out
+
+
+def _pctl(xs: List[float], q: float) -> Optional[float]:
+    """Exact nearest-rank percentile over the raw event values (the
+    terminal events carry every request's stamps, so no bucket
+    estimation is needed here)."""
+    if not xs:
+        return None
+    s = sorted(xs)
+    return round(s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))],
+                 6)
+
+
+def _slo_digest(term: List[dict]) -> dict:
+    """SLO numbers for one group of request_terminal events: goodput
+    (tokens of 'done' requests; per-second over the events' ts span
+    when it is nonzero), TTFT / end-to-end / per-token latency
+    percentiles from the engine-clock stamps, and the bad-outcome
+    rates."""
+    done = [e for e in term if e["status"] == "done"]
+    n = len(term)
+    goodput = sum(e.get("tokens", 0) for e in done)
+    ts = [e["ts"] for e in term if isinstance(e.get("ts"), (int, float))]
+    span = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+    ttft = [e["ttft_s"] for e in done
+            if e.get("ttft_s") is not None]
+    lat = [e["latency_s"] for e in done
+           if e.get("latency_s") is not None]
+    per_tok = [(e["latency_s"] - e["ttft_s"])
+               / max(e.get("tokens", 1) - 1, 1)
+               for e in done
+               if e.get("latency_s") is not None
+               and e.get("ttft_s") is not None]
+
+    def rate(status):
+        return round(sum(1 for e in term if e["status"] == status) / n,
+                     4)
+
+    return {
+        "requests": n, "done": len(done),
+        "goodput_tokens": goodput,
+        "goodput_tokens_per_s": (round(goodput / span, 3)
+                                 if span > 0 else None),
+        "ttft_p50_s": _pctl(ttft, 0.50),
+        "ttft_p99_s": _pctl(ttft, 0.99),
+        "latency_p50_s": _pctl(lat, 0.50),
+        "latency_p99_s": _pctl(lat, 0.99),
+        "per_token_p50_s": _pctl(per_tok, 0.50),
+        "per_token_p99_s": _pctl(per_tok, 0.99),
+        "shed_rate": rate("shed"),
+        "expired_rate": rate("expired"),
+        "poisoned_rate": rate("poisoned"),
+        "failed_rate": rate("failed"),
+    }
+
+
+def _slo_section(term: List[dict]) -> dict:
+    """Latency-SLO digest, fleet-wide and per engine label."""
+    engines = sorted({e.get("engine", "?") for e in term})
+    return {
+        "fleet": _slo_digest(term),
+        "per_engine": {
+            eng: _slo_digest([e for e in term
+                              if e.get("engine", "?") == eng])
+            for eng in engines},
+    }
 
 
 def _digest_snapshot(snapshot: dict) -> dict:
@@ -145,6 +218,25 @@ def render(events: List[dict], tail: int = 15) -> str:
              ("rejected", v["rejected"])]
             + [(f"status {k}", n)
                for k, n in v["by_status"].items()]))
+    if "slo" in s:
+        def fmt_slo(d):
+            def sec(v):
+                return "-" if v is None else f"{v:.4g}s"
+            gps = d["goodput_tokens_per_s"]
+            return (f"done {d['done']}/{d['requests']}"
+                    f"  goodput {d['goodput_tokens']} tok"
+                    + (f" ({gps}/s)" if gps is not None else "")
+                    + f"  ttft p50/p99 {sec(d['ttft_p50_s'])}"
+                      f"/{sec(d['ttft_p99_s'])}"
+                    + f"  per-tok {sec(d['per_token_p50_s'])}"
+                      f"/{sec(d['per_token_p99_s'])}"
+                    + f"  shed/exp/poison {d['shed_rate']}"
+                      f"/{d['expired_rate']}/{d['poisoned_rate']}")
+        lines.append("\nserving SLO:")
+        lines.append(_fmt_table(
+            [("fleet", fmt_slo(s["slo"]["fleet"]))]
+            + [(eng, fmt_slo(d))
+               for eng, d in s["slo"]["per_engine"].items()]))
     if "faults" in s:
         lines.append("\ninjected faults: " + ", ".join(s["faults"]))
     if "checkpoints" in s:

@@ -2,6 +2,7 @@
 # if at bigdl_tpu/serving/fixture.py).
 import random
 import time
+from datetime import datetime
 
 import numpy as np
 
@@ -15,3 +16,15 @@ def admit(queue):
 
 def deadline_check(req):
     return time.monotonic() > req.deadline  # BAD
+
+
+def make_trace(n):
+    # a loadgen-shaped trace from global streams: two-runs-identical
+    # JSON is impossible with either of these
+    gaps = np.random.exponential(0.25, n)  # BAD
+    t0 = time.perf_counter()  # BAD
+    return t0, gaps
+
+
+def autoscale_decision(router):
+    return {"t": datetime.now()}  # BAD

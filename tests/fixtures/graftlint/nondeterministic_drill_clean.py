@@ -22,3 +22,15 @@ class Engine:
     def straggler_model(self, slow_s):
         if slow_s:
             time.sleep(slow_s)  # injected hang model, not a clock read
+
+
+class Router:
+    def __init__(self, clock=time.monotonic):  # injection point
+        self._clock = clock
+
+    def make_trace(self, n, seed):
+        rng = np.random.RandomState(seed)   # seeded: trace is a pure
+        return rng.exponential(0.25, n)     # function of its args
+
+    def autoscale_decision(self):
+        return {"t": self._clock()}         # injected, not wall clock

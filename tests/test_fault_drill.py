@@ -54,11 +54,14 @@ def test_drill_leg(tmp_path, leg):
 
 @pytest.mark.parametrize("leg", ["serve_poison", "serve_overload",
                                  "serve_deadline", "serve_retry",
-                                 "serve_watchdog"])
+                                 "serve_watchdog", "fleet_failover",
+                                 "fleet_drain", "fleet_autoscale"])
 def test_serving_drill_leg(tmp_path, leg):
-    """ISSUE 4: the serving-plane reliability drills (poisoned
-    co-batch, overload shed, deadline expiry, retry-then-succeed,
-    watchdog trip) run bit-deterministically on every tier-1 pass."""
+    """ISSUE 4 + ISSUE 7: the serving-plane reliability drills
+    (poisoned co-batch, overload shed, deadline expiry,
+    retry-then-succeed, watchdog trip) and the fleet drills (failover
+    bit-identity, drain, SLO autoscaling) run bit-deterministically
+    on every tier-1 pass."""
     fd = _load_drill()
     result = fd.SERVING_LEGS[leg](str(tmp_path))
     assert result["ok"], result

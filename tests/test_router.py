@@ -1,0 +1,388 @@
+"""Serving fleet plane (ISSUE 7): EngineRouter dispatch/spillover/
+failover/drain/rebalance, the Autoscaler's deterministic closed loop,
+the fleet-wide compile contract, and the loadgen traffic harness.
+
+The headline guarantees — failover bit-identity and autoscaler
+determinism — are ALSO drilled end-to-end in scripts/fault_drill.py
+(fleet_* legs, tier-1 via test_fault_drill); this file covers the
+router/autoscaler machinery those drills ride on, at unit granularity.
+"""
+
+import importlib.util
+import os
+import sys
+
+import jax
+import pytest
+
+from bigdl_tpu.models.transformer import build_lm
+from bigdl_tpu.serving import (Autoscaler, EngineDraining, EngineRouter,
+                               InferenceEngine, NoHealthyEngine,
+                               OverloadError, Request)
+from bigdl_tpu.utils import faults
+
+# one module-shared model: engines over the same model object share
+# jitted executables, so this file pays the compile once (the
+# compile-count test builds its OWN fresh model to attribute traces)
+_LM = None
+
+
+def _lm():
+    global _LM
+    if _LM is None:
+        _LM = build_lm(vocab_size=50, dim=32, num_heads=2, num_layers=2,
+                       max_len=64)
+        _LM.build(jax.random.PRNGKey(0))
+    return _LM
+
+
+def _engine(**kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("prefill_buckets", (8,))
+    return InferenceEngine(_lm(), **kw)
+
+
+def _loadgen():
+    mod = sys.modules.get("bigdl_loadgen")  # one shared module object
+    if mod is not None:
+        return mod
+    path = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                        "loadgen.py")
+    spec = importlib.util.spec_from_file_location("bigdl_loadgen", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bigdl_loadgen"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    faults.set_plan(None)
+    yield
+    faults.set_plan(None)
+
+
+_SPECS = [dict(prompt=[i + 1, i + 2, i + 3], max_new_tokens=4,
+               temperature=0.8, seed=60 + i) for i in range(6)]
+
+
+def _ref_tokens():
+    """Undisturbed single-engine oracle for _SPECS (tokens are slot/
+    co-batch/arrival independent, so one engine is THE reference)."""
+    return [r.tokens for r in _engine().run([Request(**s)
+                                             for s in _SPECS])]
+
+
+class TestDispatch:
+    def test_least_loaded_dispatch_and_run_semantics(self):
+        ref = _ref_tokens()
+        e0, e1 = _engine(), _engine()
+        router = EngineRouter([e0, e1])
+        out = router.run([Request(**s) for s in _SPECS])
+        assert [r.tokens for r in out] == ref
+        assert all(r.status == "done" for r in out)
+        # load-balanced: both engines actually served traffic
+        assert e0.stats["requests_done"] == 3
+        assert e1.stats["requests_done"] == 3
+        assert router.stats["dispatched"] == 6
+
+    def test_spillover_past_full_queue(self):
+        """A bounded reject-policy queue spills to the next engine
+        instead of bouncing the caller; only a pool-wide full raises.
+        (Spillover needs a LOW-load-score engine whose queue is
+        nevertheless full: e0 has many slots but a 1-deep queue.)"""
+        e0 = _engine(slots=4, max_queue=1, overload_policy="reject")
+        e1 = _engine(slots=1, max_queue=4, overload_policy="reject")
+        router = EngineRouter([e0, e1])
+        for i in range(5):      # capacity pre-step: 1 (e0) + 4 (e1)
+            router.submit(Request(prompt=[i + 1, i + 2],
+                                  max_new_tokens=2, seed=i))
+        assert router.stats["spillover"] >= 1
+        with pytest.raises(OverloadError):
+            router.submit(Request(prompt=[8, 8], max_new_tokens=2))
+        assert router.stats["rejected"] == 1
+        out = router.run()      # drain cleanly
+        assert all(r.status == "done" for r in out)
+        assert router.completed == {}  # run() handed everything back
+
+    def test_submit_time_shed_surfaces_through_step(self):
+        """A shed-policy victim settled AT SUBMIT TIME rides the next
+        step() return — a driver loop (loadgen) accounts for every
+        request it submitted, never hanging on a silent shed."""
+        e0 = _engine(slots=1, max_queue=1,
+                     overload_policy="shed-oldest")
+        router = EngineRouter([e0])
+        a = router.submit(Request(prompt=[1, 2], max_new_tokens=2,
+                                  seed=1))
+        b = router.submit(Request(prompt=[3, 4], max_new_tokens=2,
+                                  seed=2))     # queue full: sheds a
+        out = router.step()
+        assert any(r.id == a and r.status == "shed" for r in out)
+        while any(not e.idle for e in router.engines):
+            router.step()
+        assert router.completed[b].status == "done"
+
+    def test_no_healthy_engine_raises(self):
+        e0 = _engine()
+        router = EngineRouter([e0])
+        router.drain(e0)
+        with pytest.raises(NoHealthyEngine):
+            router.submit(Request(prompt=[1, 2]))
+
+    def test_duplicate_router_id_rejected(self):
+        router = EngineRouter([_engine()])
+        router.submit(Request(prompt=[1, 2], max_new_tokens=2, id=5))
+        with pytest.raises(ValueError, match="already in flight"):
+            router.submit(Request(prompt=[3, 4], id=5))
+        router.run()
+
+    def test_rebalance_moves_backlog_to_idle_engine(self):
+        """Queued work migrates to an engine with free capacity — the
+        mechanism that makes scale-up absorb an existing backlog."""
+        e0 = _engine()
+        router = EngineRouter([e0])
+        for s in _SPECS:
+            router.submit(Request(**s))     # 2 in-flight + 4 queued
+        router.step()
+        e1 = router.add_engine(_engine())
+        router.step()                       # rebalance, then decode
+        assert router.stats["rebalanced"] >= 2
+        assert e1.slots_active == 2
+        out = router.run()
+        assert [r.tokens for r in sorted(out, key=lambda r: r.id)] \
+            == _ref_tokens()
+
+
+class TestFailover:
+    def test_failover_bit_identity_mid_decode(self):
+        """Kill engine 0 (watchdog trip via serve_slow) mid-decode:
+        every request it held completes on engine 1 with tokens
+        bit-identical to the undisturbed run — the satellite
+        acceptance, also drilled as fleet_failover."""
+        ref = _ref_tokens()
+        e0 = _engine(step_timeout_s=0.05)
+        e1 = _engine()
+        router = EngineRouter([e0, e1])
+        faults.set_plan(faults.FaultPlan("serve_slow@1"))
+        try:
+            out = router.run([Request(**s) for s in _SPECS])
+        finally:
+            faults.set_plan(None)
+        assert e0.degraded is not None and "watchdog" in e0.degraded
+        assert all(r.status == "done" for r in out)
+        assert [r.tokens for r in out] == ref
+        assert router.stats["failover"] == 3
+        assert router.stats["failover_lost"] == 0
+        # the dead engine can now leave the pool
+        router.remove_engine(e0)
+        assert len(router.engines) == 1
+
+    def test_failover_with_no_survivor_fails_requests(self):
+        e0 = _engine(step_timeout_s=0.05)
+        router = EngineRouter([e0])
+        faults.set_plan(faults.FaultPlan("serve_slow@1"))
+        try:
+            out = router.run([Request(prompt=[1, 2, 3],
+                                      max_new_tokens=4, seed=1)])
+        finally:
+            faults.set_plan(None)
+        assert [r.status for r in out] == ["failed"]
+        assert router.stats["failover_lost"] == 1
+
+
+class TestDrain:
+    def test_drain_states_and_gating(self):
+        e0, e1 = _engine(), _engine()
+        router = EngineRouter([e0, e1])
+        ids = [router.submit(Request(**s)) for s in _SPECS[:4]]
+        router.step()
+        router.drain(e0)
+        assert e0.health()["state"] == "draining"
+        with pytest.raises(EngineDraining):
+            e0.submit(Request(prompt=[1, 2]))
+        # a premature removal is refused
+        with pytest.raises(ValueError, match="drain"):
+            router.remove_engine(e0)
+        late = router.submit(Request(**_SPECS[4]))
+        while any(not e.idle for e in router.engines):
+            router.step()
+        assert e0.health()["state"] == "drained"
+        assert e0.stats["rejected"] == 0
+        router.remove_engine(e0)
+        assert len(router.engines) == 1
+        results = {i: router.completed[i] for i in ids + [late]}
+        assert all(r.status == "done" for r in results.values())
+        # the late request never touched the draining engine
+        assert e1.stats["requests_done"] == 3
+
+    @pytest.mark.slow
+    def test_draining_engine_donates_queue_when_room_exists(self):
+        """A draining engine hands its queue to the pool as capacity
+        frees up elsewhere — drain completes without serializing the
+        backlog behind the drained slots. (Tier-2: the core drain
+        contract is tier-1 above and in the fleet_drain drill; this
+        pins the donation optimization.)"""
+        # even ids (dispatched to e0) decode long, odd ids (e1) short:
+        # e1 frees capacity while e0 still holds a queued request
+        specs = [dict(prompt=[i + 1, i + 2, i + 3],
+                      max_new_tokens=6 if i % 2 == 0 else 2,
+                      temperature=0.8, seed=80 + i) for i in range(6)]
+        ref = [r.tokens for r in _engine().run([Request(**s)
+                                                for s in specs])]
+        e0, e1 = _engine(), _engine()
+        router = EngineRouter([e0, e1])
+        for s in specs:         # e0: {0,2,4}, e1: {1,3,5}
+            router.submit(Request(**s))
+        router.step()
+        router.drain(e0)        # 2 in-flight + 1 queued on e0
+        out = router.run()
+        assert router.stats["rebalanced"] >= 1
+        assert e0.stats["requests_done"] == 2   # queued one migrated
+        assert [r.tokens for r in sorted(out, key=lambda r: r.id)] \
+            == ref
+
+
+class TestCompileContract:
+    def test_pool_compiles_buckets_plus_one_total(self):
+        """Fleet-wide zero-recompile contract: a 2-engine pool over
+        one (fresh) model compiles #buckets prefills + 1 decode IN
+        TOTAL — the second engine (and a mid-run add_engine) report
+        zero new traces, because executables are shared."""
+        fresh = build_lm(vocab_size=50, dim=16, num_heads=2,
+                         num_layers=1, max_len=32)
+        fresh.build(jax.random.PRNGKey(1))
+
+        def eng():
+            return InferenceEngine(fresh, slots=2,
+                                   prefill_buckets=(8, 16))
+        e0, e1 = eng(), eng()
+        router = EngineRouter([e0, e1], engine_factory=eng)
+        import numpy as np
+
+        from bigdl_tpu.serving.engine import _TRACES
+
+        traces0 = dict(_TRACES)         # pool-wide, not per-engine:
+        # each engine's stats delta counts the SHARED executables'
+        # traces since ITS construction, so summing them double-counts
+        rng = np.random.RandomState(0)
+        reqs = [Request(prompt=list(rng.randint(1, 50, n)),
+                        max_new_tokens=3, seed=i)
+                for i, n in enumerate((3, 10, 6, 12, 5, 9))]
+        out = router.run(reqs)
+        assert all(r.status == "done" for r in out)
+        assert _TRACES["prefill"] - traces0["prefill"] == 2
+        assert _TRACES["decode"] - traces0["decode"] == 1
+        # scale-up compiles nothing
+        e2 = router.add_engine()
+        out2 = router.run([Request(prompt=[1, 2, 3], max_new_tokens=2,
+                                   seed=99)])
+        assert out2[0].status == "done"
+        assert e2.stats["prefill_traces"] == 0
+        assert e2.stats["decode_traces"] == 0
+
+
+class TestLifecycleStamps:
+    def test_ttft_and_latency_deterministic_under_injected_clock(self):
+        clk = {"t": 0.0}
+
+        def eng():
+            return _engine(clock=lambda: clk["t"])
+        router = EngineRouter([eng()], clock=lambda: clk["t"])
+        rid = router.submit(Request(prompt=[1, 2, 3], max_new_tokens=3,
+                                    seed=1))
+        while any(not e.idle for e in router.engines):
+            clk["t"] += 0.5
+            router.step()
+        res = router.completed[rid]
+        assert res.ttft_s == 0.5            # first decode round
+        assert res.latency_s == 1.5         # 3 tokens, 0.5 s/round
+        h = router.health()
+        assert h["request_p50_ms"] is not None
+        assert h["pool_size"] == 1 and h["healthy"] == 1
+
+
+class TestAutoscaler:
+    def _run_burst(self, autoscale, lg):
+        from bigdl_tpu import obs
+
+        obs.reset_all()         # fresh registry per run (labels etc.)
+        clk = {"t": 0.0}
+
+        def factory():
+            return _engine(clock=lambda: clk["t"])
+        router = EngineRouter([factory()], engine_factory=factory,
+                              clock=lambda: clk["t"])
+        asc = Autoscaler(router, target_p99_s=10.0, max_engines=3,
+                         evaluate_every_s=0.5, backlog_high=8.0) \
+            if autoscale else None
+        trace = lg.make_trace(12, seed=3, arrival="bursty",
+                              burst_size=12,
+                              prompt_len_choices=(3, 5, 8),
+                              max_new_choices=(4,), priorities=(0,))
+        report = lg.replay(router, trace, clock=clk, step_dt=0.5,
+                           autoscaler=asc)
+        decisions = [] if asc is None else list(asc.decisions)
+        return report, decisions
+
+    def test_decisions_and_report_deterministic(self):
+        lg = _loadgen()
+        rep1, dec1 = self._run_burst(True, lg)
+        rep2, dec2 = self._run_burst(True, lg)
+        assert dec1 == dec2                 # the satellite acceptance
+        assert rep1 == rep2
+        assert [d["action"] for d in dec1
+                if d["action"] != "hold"][:1] == ["scale_up"]
+        assert rep1["by_status"] == {"done": 12}
+
+    @pytest.mark.slow
+    def test_autoscaled_pool_beats_fixed_pool(self):
+        """Tier-2: the held-vs-violated p99 acceptance runs tier-1 as
+        the fleet_autoscale drill; this is the unit-level replica."""
+        lg = _loadgen()
+        fixed, _ = self._run_burst(False, lg)
+        auto, dec = self._run_burst(True, lg)
+        assert auto["latency_p99_s"] < fixed["latency_p99_s"]
+        assert auto["pool"]["engines_final"] >= 2
+
+    def test_knob_validation(self):
+        router = EngineRouter([_engine()])
+        with pytest.raises(ValueError, match="target_p99_s"):
+            Autoscaler(router, target_p99_s=0.0)
+        with pytest.raises(ValueError, match="min_engines"):
+            Autoscaler(router, target_p99_s=1.0, min_engines=3,
+                       max_engines=2)
+
+
+class TestLoadgen:
+    def test_trace_is_pure_function_of_args(self):
+        lg = _loadgen()
+        t1 = lg.make_trace(8, seed=5, sessions=2)
+        t2 = lg.make_trace(8, seed=5, sessions=2)
+        assert [(a.t, a.spec, a.session) for a in t1["arrivals"]] \
+            == [(a.t, a.spec, a.session) for a in t2["arrivals"]]
+        assert t1["sessions"]["continuations"] \
+            == t2["sessions"]["continuations"]
+        t3 = lg.make_trace(8, seed=6, sessions=2)
+        assert [a.spec for a in t1["arrivals"]] \
+            != [a.spec for a in t3["arrivals"]]
+
+    @pytest.mark.slow
+    def test_multi_turn_sessions_resubmit_history(self):
+        """Tier-2 (tier-1 budget): session mechanics are deterministic
+        plumbing over the tier-1-covered replay loop."""
+        lg = _loadgen()
+        clk = {"t": 0.0}
+
+        def eng():
+            return InferenceEngine(_lm(), slots=2,
+                                   prefill_buckets=(8, 16, 32),
+                                   clock=lambda: clk["t"])
+        router = EngineRouter([eng()], clock=lambda: clk["t"])
+        trace = lg.make_trace(2, seed=1, sessions=1, session_turns=3,
+                              prompt_len_choices=(3,),
+                              max_new_choices=(2,))
+        report = lg.replay(router, trace, clock=clk, step_dt=0.5)
+        # 2 single-shot + 3 session turns
+        assert report["requests"] == 5
+        assert report["by_status"] == {"done": 5}
+        assert report["goodput_tokens"] == 10
