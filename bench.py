@@ -48,6 +48,24 @@ REF_THROUGHPUT = 10.0  # images/sec — reference CPU-node ballpark (BASELINE.md
 PEAK_BF16 = 197e12     # TPU v5e peak bf16 FLOP/s
 
 
+def _load_loadgen():
+    """scripts/loadgen.py as the shared `bigdl_loadgen` module object
+    (registered in sys.modules so bench rows, fault_drill and tests
+    all see ONE module — duplicate loads would duplicate its
+    dataclasses)."""
+    import importlib.util
+
+    lg = sys.modules.get("bigdl_loadgen")
+    if lg is None:
+        spec = importlib.util.spec_from_file_location(
+            "bigdl_loadgen", os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "scripts", "loadgen.py"))
+        lg = importlib.util.module_from_spec(spec)
+        sys.modules["bigdl_loadgen"] = lg
+        spec.loader.exec_module(lg)
+    return lg
+
+
 def _obs_provenance(prefix=None):
     """Registry snapshot attached to every row (ISSUE 5): a perf claim
     carries the telemetry that produced it — counters, gauges, and
@@ -881,6 +899,7 @@ def bench_lm_decode_batched(on_tpu, context=512, new_tokens=None,
     new_tokens = new_tokens or (64 if on_tpu else 32)
     vocab, dim, layers, heads = 32000, 512, 8, 8
     max_len = context + new_tokens + 8
+    max_len += (-max_len) % 16          # paged cache: block multiple
     cfg = TransformerConfig(vocab_size=vocab, max_len=max_len, dim=dim,
                             num_heads=heads, num_layers=layers)
     model = TransformerLM(cfg)
@@ -985,6 +1004,109 @@ def bench_lm_decode_batched(on_tpu, context=512, new_tokens=None,
     }), flush=True)
 
 
+def bench_lm_decode_prefix(on_tpu, context=None, new_tokens=None,
+                           slots=None, n_requests=None):
+    """Prefix-reuse row (ISSUE 8): a shared-prompt burst on the 43M —
+    every request's prompt is 90% one common prefix + a unique tail —
+    served twice from the SAME trace: once with the radix prefix cache
+    on (the first admission prefills cold and seeds the tree; the
+    rest prefill only their suffix bucket) and once with it off (every
+    admission pays the full-context prefill). The row reports both
+    goodputs, the prefill-tokens-saved fraction and the hit rate from
+    the engine's host counters, with block_size / pool blocks / the
+    serving_prefix_* registry snapshot as provenance.
+
+    Acceptance: >= 70% of prefill tokens saved and warm goodput
+    strictly above the cold run of the identical trace."""
+    import jax
+
+    from bigdl_tpu.models.transformer import TransformerConfig, TransformerLM
+    from bigdl_tpu.serving import InferenceEngine, Request
+
+    lg = _load_loadgen()
+
+    context = context or (512 if on_tpu else 256)
+    slots = slots or (8 if on_tpu else 4)
+    new_tokens = new_tokens or (16 if on_tpu else 8)
+    n_requests = n_requests or (64 if on_tpu else 32)
+    block_size = 16
+    tail = 26 if context >= 256 else max(context // 10, 4)
+    shared_len = context - tail              # 90% of the prompt shared
+    vocab, dim, layers, heads = 32000, 512, 8, 8
+    max_len = context + new_tokens + 8
+    max_len += (-max_len) % block_size
+    # suffix after a hit buckets small; cold first request needs the
+    # full-context bucket
+    buckets = (2 * block_size, context)
+    cfg = TransformerConfig(vocab_size=vocab, max_len=max_len, dim=dim,
+                            num_heads=heads, num_layers=layers)
+    model = TransformerLM(cfg)
+    variables = model.init(jax.random.PRNGKey(0))
+
+    def engine(prefix_cache):
+        return InferenceEngine(model, variables, slots=slots,
+                               max_len=max_len,
+                               prefill_buckets=buckets,
+                               block_size=block_size,
+                               prefix_cache=prefix_cache)
+
+    def burst(seed):
+        trace = lg.make_trace(
+            n_requests, seed=seed, arrival="bursty",
+            burst_size=n_requests, shared_prefix_len=shared_len,
+            shared_frac=1.0, prompt_len_choices=(tail,),
+            max_new_choices=(new_tokens,), temperature=0.0,
+            priorities=(0,), vocab=vocab)
+        return [Request(**a.spec) for a in trace["arrivals"]]
+
+    # warmup on a DIFFERENT trace seed (different shared prefix):
+    # compiles both prefill buckets + decode before anything is timed;
+    # the measured engines are built fresh over the same model — zero
+    # new compiles, empty radix trees
+    warm_up = engine(True)
+    warm_up.run(burst(99)[:slots + 1])
+
+    def timed(eng, seed):
+        reqs = burst(seed)
+        t0 = time.perf_counter()
+        res = eng.run(reqs)
+        dt = time.perf_counter() - t0
+        done = [r for r in res if r.status == "done"]
+        return sum(len(r.tokens) for r in done) / dt, res
+
+    warm_eng = engine(True)
+    warm_gps, warm_res = timed(warm_eng, 1)
+    cold_eng = engine(False)
+    cold_gps, cold_res = timed(cold_eng, 1)
+    # identical trace, prefix cache is decode-invisible: bit-identity
+    assert [r.tokens for r in warm_res] == [r.tokens for r in cold_res]
+    s = warm_eng.stats
+    prompt_tokens = n_requests * context
+    saved_frac = s["prefix_tokens_saved"] / prompt_tokens
+    platform = "tpu" if on_tpu else "cpu"
+    print(json.dumps({
+        "metric": f"transformer_lm_43m_decode_prefix_goodput"
+                  f"_tokens_per_sec[{platform}]",
+        "value": round(warm_gps, 2), "unit": "tokens/sec",
+        "vs_baseline": None,
+        "cold_cache_tokens_per_sec": round(cold_gps, 2),
+        "speedup_vs_cold": round(warm_gps / cold_gps, 2),
+        "requests": n_requests, "context": context,
+        "shared_prompt_frac": round(shared_len / context, 3),
+        "prefill_tokens_saved_frac": round(saved_frac, 4),
+        "prefix_hit_rate": round(s["prefix_hits"] / n_requests, 4),
+        "blocks_reused": s["prefix_blocks_reused"],
+        "bytes_saved": s["prefix_bytes_saved"],
+        "tokens_bit_identical_to_cold": True,
+        "block_size": block_size,
+        "pool_blocks": warm_eng.pool_blocks,
+        "cache_slots": slots, "cache_dtype": "fp32",
+        "prefill_compiles": warm_eng.stats["prefill_traces"],
+        "decode_compiles": warm_eng.stats["decode_traces"],
+        "telemetry": _obs_provenance("serving_"),
+    }), flush=True)
+
+
 def bench_lm_decode_fleet(on_tpu, context=None, new_tokens=None,
                           slots=None):
     """Fleet row (ISSUE 7): a 2-engine routed pool on the 43M LM
@@ -1002,8 +1124,6 @@ def bench_lm_decode_fleet(on_tpu, context=None, new_tokens=None,
     TOTAL (executables are shared; pool-size changes compile
     nothing) — counted from the process-wide trace tally, since
     per-engine stats deltas over shared executables double-count."""
-    import importlib.util
-
     import jax
 
     from bigdl_tpu.models.transformer import TransformerConfig, TransformerLM
@@ -1011,20 +1131,14 @@ def bench_lm_decode_fleet(on_tpu, context=None, new_tokens=None,
     from bigdl_tpu.serving.engine import _TRACES
     from bigdl_tpu.utils import faults
 
-    lg = sys.modules.get("bigdl_loadgen")   # one shared module object
-    if lg is None:
-        lg_spec = importlib.util.spec_from_file_location(
-            "bigdl_loadgen", os.path.join(os.path.dirname(
-                os.path.abspath(__file__)), "scripts", "loadgen.py"))
-        lg = importlib.util.module_from_spec(lg_spec)
-        sys.modules["bigdl_loadgen"] = lg
-        lg_spec.loader.exec_module(lg)
+    lg = _load_loadgen()
 
     context = context or (512 if on_tpu else 128)
     slots = slots or (8 if on_tpu else 4)
     new_tokens = new_tokens or (32 if on_tpu else 16)
     vocab, dim, layers, heads = 32000, 512, 8, 8
     max_len = context + new_tokens + 8
+    max_len += (-max_len) % 16          # paged cache: block multiple
     cfg = TransformerConfig(vocab_size=vocab, max_len=max_len, dim=dim,
                             num_heads=heads, num_layers=layers)
     model = TransformerLM(cfg)
@@ -1107,7 +1221,8 @@ def main(argv=None) -> None:
                     help="comma-separated subset: resnet50,diskpipe,"
                          "inception_v1,vgg16,lenet,int8,bilstm,treelstm,"
                          "lm43m,lm186m,lmtiny (cpu),lmdecode,"
-                         "lmdecode_batched,lmdecode_fleet")
+                         "lmdecode_batched,lmdecode_prefix,"
+                         "lmdecode_fleet")
     args = ap.parse_args(argv)
 
     # bounded backend probe: the axon tunnel's init can block forever
@@ -1184,6 +1299,8 @@ def main(argv=None) -> None:
             bench_lm_decode(on_tpu)
         if sel("lmdecode_batched"):
             bench_lm_decode_batched(on_tpu)
+        if sel("lmdecode_prefix"):
+            bench_lm_decode_prefix(on_tpu)
         if sel("lmdecode_fleet"):
             bench_lm_decode_fleet(on_tpu)
     else:
@@ -1199,6 +1316,10 @@ def main(argv=None) -> None:
             bench_lm_decode(on_tpu)
         if "lmdecode_batched" in (want or ()):
             bench_lm_decode_batched(on_tpu)
+        # prefix-reuse row: explicit-only on CPU (the cold-cache
+        # column is a full 32-request 43M prefill wave), default on TPU
+        if "lmdecode_prefix" in (want or ()):
+            bench_lm_decode_prefix(on_tpu)
         # fleet goodput row: explicit-only on CPU (two 43M engines'
         # prefill waves would double the default run), default on TPU
         if "lmdecode_fleet" in (want or ()):

@@ -14,7 +14,10 @@ The deliberate fetch carries an inline
 `# graftlint: disable=hidden-device-sync` with its justification;
 everything else is a finding. Scope: all of `bigdl_tpu/obs/`, plus
 hot-path functions (decode/prefill/step/dispatch/sample/work/emit/
-observe) in `serving/`, `ops/kv_cache.py` and `models/transformer.py`.
+observe, and the paged-cache lookup/insert/evict/alloc paths —
+ISSUE 8: block-table and radix-tree surgery runs between EVERY decode
+step, so a sync there stalls the whole batch once per admission) in
+`serving/`, `ops/kv_cache.py` and `models/transformer.py`.
 """
 
 from __future__ import annotations
@@ -30,7 +33,8 @@ _SYNC_CALLS = {"np.asarray", "numpy.asarray", "np.array",
                "jax.block_until_ready"}
 _SYNC_METHODS = {"item", "block_until_ready", "tolist", "__array__"}
 _HOT_FN = re.compile(
-    r"(decode|prefill|dispatch|step|sample|work|emit|observe)")
+    r"(decode|prefill|dispatch|step|sample|work|emit|observe"
+    r"|lookup|insert|evict|alloc)")
 
 
 @register

@@ -552,6 +552,138 @@ class TransformerLM(Module):
             h, (lengths - 1)[:, None, None], axis=1)[:, 0]
         return last @ self.head({"params": p}), tuple(new_cache)
 
+    # ------------------------------------------------- paged KV (ISSUE 8)
+    # The serving engine's cache spine: per-layer block POOLS plus a
+    # per-slot block TABLE instead of contiguous per-slot buffers
+    # (ops/kv_cache.py paged primitives; allocator in
+    # serving/kv_pool.py, radix prefix reuse in serving/prefix_cache
+    # .py). Same compile contract as the dense path — one suffix
+    # prefill executable per bucket + one decode executable — and the
+    # full-table attention extent makes every KV row's value bitwise
+    # independent of which bucket (or which request) computed it.
+
+    def init_block_pool(self, num_blocks: int, block_size: int,
+                        dtype=jnp.float32):
+        """Per-layer paged KV pools: a TUPLE of L dicts {'k','v'},
+        each (num_blocks, H, block_size, D). Per-layer (not stacked)
+        for the same reason as init_cache; block 0 is the reserved
+        scratch block (ops/kv_cache.py)."""
+        from bigdl_tpu.ops.kv_cache import init_block_pool
+
+        self._serving_guard()
+        c = self.cfg
+        return tuple(
+            dict(zip(("k", "v"), init_block_pool(
+                num_blocks, c.num_heads, block_size, self.head_dim,
+                dtype)))
+            for _ in range(c.num_layers))
+
+    def prefill_paged(self, variables, tokens, pools, table, block_ids,
+                      start):
+        """Prefill ONE request's SUFFIX into the paged pools: tokens
+        (1, bucket) right-padded suffix tokens at global positions
+        [start, start+bucket); `table` (1, max_blocks) the slot's full
+        block table (reused prefix blocks + the fresh `block_ids`
+        (nb,) this call writes); `start` a traced int32 scalar — the
+        block-aligned cached-prefix length (0 = cold prefill, the same
+        executable). Returns the updated pools; the engine takes its
+        first token by re-decoding the last prompt token, so no logits
+        head runs here.
+
+        Suffix queries attend through the gathered table — prefix keys
+        included — over the FULL table extent with mask j <= start+i,
+        which is what makes the written KV bitwise identical whether a
+        position is computed cold (start=0, one big bucket) or warm
+        (nonzero start, a small suffix bucket): all reductions keep
+        the same shape (ops/kv_cache.py module docstring)."""
+        from bigdl_tpu.ops.kv_cache import (block_attention,
+                                            gather_block_cache,
+                                            write_prompt_blocks)
+
+        self._serving_guard()
+        c = self.cfg
+        p = variables["params"] if "params" in variables else variables
+        bsz, s = tokens.shape
+        if bsz != 1:
+            raise ValueError("prefill_paged fills one request (batch "
+                             f"1), got batch {bsz}")
+        d = self.head_dim
+        start = jnp.asarray(start, jnp.int32)
+        x = p["embed"][tokens] \
+            + lax.dynamic_slice_in_dim(p["pos"], start, s, axis=0)
+
+        new_pools = []
+        visible = valid = None
+        for bp, pl in zip(self._layer_blocks(p), pools):
+            y = self._ln(x, bp["ln1_g"], bp["ln1_b"])
+            q = (y @ bp["wq"] + bp["bq"]).reshape(
+                bsz, s, c.num_heads, d).transpose(0, 2, 1, 3)
+            k = (y @ bp["wk"] + bp["bk"]).reshape(
+                bsz, s, c.num_heads, d).transpose(0, 2, 1, 3)
+            v = (y @ bp["wv"] + bp["bv"]).reshape(
+                bsz, s, c.num_heads, d).transpose(0, 2, 1, 3)
+            kp, vp = write_prompt_blocks(pl["k"], pl["v"], k, v,
+                                         block_ids)
+            new_pools.append({"k": kp, "v": vp})
+            kc = gather_block_cache(kp, table)      # (1, H, S_tab, D)
+            vc = gather_block_cache(vp, table)
+            if visible is None:                     # same every layer
+                jpos = jnp.arange(kc.shape[-2])
+                ipos = start + jnp.arange(s)
+                visible = (jpos[None, None, :]
+                           <= ipos[None, :, None])  # (1, s, S_tab)
+                valid = (jpos[None, :] < start + s)  # (1, S_tab)
+            a = block_attention(q, kc, vc, visible, valid)
+            a = a.transpose(0, 2, 1, 3).reshape(bsz, s, c.num_heads * d)
+            x = x + a @ bp["wo"] + bp["bo"]
+            x = x + self._dense_ffn(
+                self._ln(x, bp["ln2_g"], bp["ln2_b"]), bp)
+        return tuple(new_pools)
+
+    def decode_step_paged(self, variables, tokens, pos, pools, table):
+        """One incremental step over the paged pools: tokens/pos (B,)
+        as decode_step, `table` (B, max_blocks) int32 block tables.
+        Writes each row's k/v at (table[pos // bs], pos % bs) — always
+        an exclusive block (copy-on-write: the engine never points a
+        row's write position at a shared block) — then attends through
+        the gathered table. Same per-ROW isolation contract as
+        decode_step: a non-finite row contaminates only its own logits
+        and its own exclusive blocks."""
+        from bigdl_tpu.ops.kv_cache import (paged_attention,
+                                            write_decode_blocks)
+
+        self._serving_guard()
+        c = self.cfg
+        p = variables["params"] if "params" in variables else variables
+        bsz = tokens.shape[0]
+        d = self.head_dim
+        bs = pools[0]["k"].shape[2]
+        rows = jnp.arange(bsz)
+        block_ids = table[rows, pos // bs]          # (B,)
+        offsets = pos % bs
+        x = p["embed"][tokens] + p["pos"][pos]      # (B, E)
+
+        new_pools = []
+        for bp, pl in zip(self._layer_blocks(p), pools):
+            y = self._ln(x, bp["ln1_g"], bp["ln1_b"])
+            q = (y @ bp["wq"] + bp["bq"]).reshape(
+                bsz, 1, c.num_heads, d).transpose(0, 2, 1, 3)
+            k = (y @ bp["wk"] + bp["bk"]).reshape(
+                bsz, 1, c.num_heads, d).transpose(0, 2, 1, 3)
+            v = (y @ bp["wv"] + bp["bv"]).reshape(
+                bsz, 1, c.num_heads, d).transpose(0, 2, 1, 3)
+            kp, vp = write_decode_blocks(pl["k"], pl["v"], k, v,
+                                         block_ids, offsets)
+            new_pools.append({"k": kp, "v": vp})
+            a = paged_attention(q, kp, vp, table, pos)  # (B, H, 1, D)
+            a = a.transpose(0, 2, 1, 3).reshape(bsz, c.num_heads * d)
+            x = x + a @ bp["wo"] + bp["bo"]
+            x = x + self._dense_ffn(
+                self._ln(x, bp["ln2_g"], bp["ln2_b"]), bp)
+
+        h = self._ln(x, p["lnf_g"], p["lnf_b"])
+        return h @ self.head({"params": p}), tuple(new_pools)
+
     def decode_step(self, variables, tokens, pos, cache):
         """One incremental step: tokens (B,) int32 — the current token
         per row — written at per-row clock `pos` (B,) int32, attended

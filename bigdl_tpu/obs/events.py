@@ -21,6 +21,10 @@ fine; these are the wired ones):
     fault_injected      every utils/faults shot that fires: fault, step
     request_submit / request_terminal   serving lifecycle endpoints
     engine_degraded     watchdog trip / retry exhaustion
+    prefix_hit          paged-KV prefix reuse at admission: request,
+                        matched_tokens, blocks (ISSUE 8)
+    prefix_evict        LRU prefix blocks evicted under pool
+                        pressure: blocks
     metrics_snapshot    a full registry snapshot embedded as an event
                         (obs.log_metrics_snapshot) — gives a JSONL file
                         self-contained percentiles for obs_report

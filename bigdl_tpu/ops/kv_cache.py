@@ -19,6 +19,33 @@ Numerics match bigdl_tpu/ops/flash_attention: fp32 score accumulation,
 masked logits at -1e30 (never -inf), softmax in fp32, output cast back
 to the value dtype. The cache may be held in bf16 (`dtype=` at
 creation) — scores still accumulate in fp32.
+
+Paged layout (ISSUE 8): the second cache family here pages the
+per-layer cache into fixed-size blocks held in ONE preallocated
+`(num_blocks, H, block_size, D)` pool per layer. A sequence's cache is
+then a BLOCK TABLE — a static `(max_blocks,)` int32 row of pool
+indices — instead of a contiguous `(S, ...)` buffer: eviction, slot
+elasticity and prefix sharing become integer surgery on the table plus
+host-side ref-counts (serving/kv_pool.py, serving/prefix_cache.py),
+never a cache copy. Block 0 is RESERVED as a scratch block: unused
+table entries point at it, inactive batch rows write their garbage
+into it, and no reader ever sees it unmasked.
+
+Bit-identity contract (the load-bearing bar of the prefix cache):
+every attention read — multi-row suffix prefill and one-row decode —
+spans the FULL gathered table extent with per-query masking, so the
+reduction shapes (and therefore the fp32 accumulation order) are
+independent of WHERE a position was computed: a KV row produced by a
+cold bucket-64 prefill, a warm bucket-16 suffix prefill after a prefix
+hit, or a donor request's earlier prefill is bitwise the same array,
+and cached-prefix decode emits tokens bit-identical to cold decode
+(pinned by tests/test_kv_pool.py and the serve_prefix drill). The one
+deliberate asymmetry: Q=1 decode gemms lower to different kernels
+than Q>=2 prefill gemms (measured on CPU XLA), so positions a decode
+step wrote are NEVER shared — the serving engine caps reuse and tree
+insertion at `(len(prompt) - 1) // block_size` full blocks, keeping
+the re-decoded last prompt token (and everything generated) out of
+shared blocks.
 """
 
 from __future__ import annotations
@@ -105,3 +132,124 @@ def cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                    v_cache.astype(jnp.float32), 0.0)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vf)
     return out.astype(q.dtype)
+
+
+# --------------------------------------------------------------- paged
+
+def init_block_pool(num_blocks: int, num_heads: int, block_size: int,
+                    head_dim: int, dtype=jnp.float32
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """One layer's paged (k, v) pool, each (num_blocks, H, block_size,
+    D), zero-filled. Block 0 is the scratch block by convention (see
+    module docstring); the host allocator (serving/kv_pool.py) never
+    hands it out."""
+    shape = (num_blocks, num_heads, block_size, head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def write_prompt_blocks(k_pool: jax.Array, v_pool: jax.Array,
+                        k_new: jax.Array, v_new: jax.Array,
+                        block_ids: jax.Array
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """Bulk-write one request's prefill keys/values (1, H, S, D) into
+    the blocks `block_ids` (nb,) int32, nb = ceil(S / block_size).
+    S pads up to nb*block_size with zeros inside the op (the pad
+    positions sit beyond the row's clock, masked like any garbage).
+    Shape-static per (S, nb): one executable per prefill bucket.
+    `block_ids` must be distinct (the allocator guarantees it) — the
+    scatter is then order-independent and deterministic."""
+    if k_new.shape[0] != 1:
+        raise ValueError("write_prompt_blocks writes one request "
+                         f"(batch 1), got batch {k_new.shape[0]}")
+    nb = block_ids.shape[0]
+    _, h, s, d = k_new.shape
+    bs = k_pool.shape[2]
+    pad = nb * bs - s
+    if pad < 0:
+        raise ValueError(f"{nb} blocks of {bs} cannot hold {s} tokens")
+
+    def blocked(x, pool):
+        x = x[0].astype(pool.dtype)                 # (H, S, D)
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        # (H, nb*bs, D) → (nb, H, bs, D): one row per destination block
+        return x.reshape(h, nb, bs, d).transpose(1, 0, 2, 3)
+
+    return (k_pool.at[block_ids].set(blocked(k_new, k_pool)),
+            v_pool.at[block_ids].set(blocked(v_new, v_pool)))
+
+
+def write_decode_blocks(k_pool: jax.Array, v_pool: jax.Array,
+                        k_new: jax.Array, v_new: jax.Array,
+                        block_ids: jax.Array, offsets: jax.Array
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """Write one decode step's (B, H, 1, D) keys/values at per-row
+    (block, offset) destinations — block_ids/offsets (B,) int32,
+    derived from the block table and the row clocks. Active rows
+    target distinct exclusive blocks (copy-on-write: shared blocks are
+    read-only, the engine never routes a write at one); inactive rows
+    all target the scratch block, whose content no reader ever sees
+    unmasked, so colliding garbage writes there are harmless."""
+    kv = k_new[:, :, 0, :].astype(k_pool.dtype)     # (B, H, D)
+    vv = v_new[:, :, 0, :].astype(v_pool.dtype)
+    return (k_pool.at[block_ids, :, offsets, :].set(kv),
+            v_pool.at[block_ids, :, offsets, :].set(vv))
+
+
+def gather_block_cache(pool: jax.Array, table: jax.Array) -> jax.Array:
+    """Materialize each row's logical cache through its block table:
+    pool (N, H, bs, D) gathered by table (B, nb) → (B, H, nb*bs, D).
+    A pure gather — values pass through bitwise, so attention over the
+    gathered array equals attention over an equivalent contiguous
+    cache bit-for-bit (tests/test_kv_pool.py pins it)."""
+    g = pool[table]                                 # (B, nb, H, bs, D)
+    b, nb, h, bs, d = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(b, h, nb * bs, d)
+
+
+def block_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    visible: jax.Array, valid: jax.Array,
+                    sm_scale: Optional[float] = None) -> jax.Array:
+    """Masked attention over a gathered block cache — the shared core
+    of paged decode AND paged suffix prefill. q (B, H, Q, D), k/v
+    (B, H, S, D), `visible` (B, Q, S) bool — per-query causal
+    visibility; `valid` (B, S) bool — the union of visibility (the
+    row's written region): value rows outside it are zeroed exactly,
+    so garbage beyond the clock (scratch blocks, recycled content,
+    a poisoned former occupant's NaN) can never ride a 0-probability
+    into the weighted sum (0.0 * NaN = NaN — same hygiene as
+    cached_attention). Same fp32 conventions as above."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    kf = k.astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk",
+                   q.astype(jnp.float32), kf) * sm_scale
+    # the where AFTER the matmul launders NaN scores a non-finite
+    # masked KEY row would produce
+    s = jnp.where(visible[:, None, :, :], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    probs = p / jnp.sum(p, axis=-1, keepdims=True)
+    vf = jnp.where(valid[:, None, :, None], v.astype(jnp.float32), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vf)
+    return out.astype(q.dtype)
+
+
+def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                    table: jax.Array, pos: jax.Array,
+                    sm_scale: Optional[float] = None) -> jax.Array:
+    """One query row per sequence against the paged pool: q
+    (B, H, 1, D), pools (N, H, bs, D), table (B, nb), pos (B,) — the
+    row clock, exactly as cached_attention. Gathers each row's blocks
+    and attends positions <= pos over the FULL table extent (nb*bs),
+    so the math is the dense cached_attention bit-for-bit when the
+    visible content matches. Returns (B, H, 1, D)."""
+    if q.shape[-2] != 1:
+        raise ValueError(f"paged_attention decodes one row, got q "
+                         f"length {q.shape[-2]}")
+    kc = gather_block_cache(k_pool, table)
+    vc = gather_block_cache(v_pool, table)
+    seq = kc.shape[-2]
+    visible = (jnp.arange(seq)[None, :] <= pos[:, None])    # (B, S)
+    return block_attention(q, kc, vc, visible[:, None, :], visible,
+                           sm_scale)

@@ -12,6 +12,8 @@ from bigdl_tpu.serving.engine import (STATUSES, EngineDegraded,
                                       EngineDraining, GenerationResult,
                                       InferenceEngine, OverloadError,
                                       Request, StepTimeout)
+from bigdl_tpu.serving.kv_pool import BlockPool
+from bigdl_tpu.serving.prefix_cache import RadixPrefixCache
 from bigdl_tpu.serving.router import (EngineRouter, NoHealthyEngine,
                                       ROUTER_LATENCY_BUCKETS)
 from bigdl_tpu.serving.sampler import filter_logits, sample_logits
@@ -20,7 +22,7 @@ __all__ = [
     "InferenceEngine", "Request", "GenerationResult", "STATUSES",
     "OverloadError", "StepTimeout", "EngineDegraded", "EngineDraining",
     "EngineRouter", "NoHealthyEngine", "ROUTER_LATENCY_BUCKETS",
-    "Autoscaler",
+    "Autoscaler", "BlockPool", "RadixPrefixCache",
     "sample_logits", "filter_logits",
     "bucket_for", "bucket_histogram", "default_buckets", "pad_tokens",
     "pad_rows",

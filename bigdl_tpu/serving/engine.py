@@ -5,11 +5,32 @@ prefill/decode_step, ops/kv_cache.py).
 
 Design
 ------
-* **Fixed B cache slots.** The engine owns one KV cache — a per-layer
-  pytree of (B, H, max_len, D) leaves. A request occupies one slot from
-  prefill to finish; finished sequences are evicted and queued
-  requests spliced into free slots BETWEEN decode steps — admission
-  never changes any jitted shape.
+* **Paged KV pool + block tables (ISSUE 8).** The engine owns one
+  PAGED KV cache: per-layer `(num_blocks, H, block_size, D)` pools
+  (ops/kv_cache.py) plus a host `(slots, max_blocks)` int32 block
+  TABLE — a slot is a row of pool indices, not a contiguous buffer.
+  A request occupies one slot from prefill to finish; eviction and
+  admission are block-table surgery plus ref-count updates
+  (serving/kv_pool.py) BETWEEN decode steps — never a cache copy, and
+  never a jitted-shape change (the table rides into the decode step as
+  a (B, max_blocks) operand). Block 0 is reserved scratch: inactive
+  rows point at it and write their garbage there.
+* **Radix prefix reuse.** Admission looks the prompt up in a
+  content-hashed radix tree over block-aligned token chunks
+  (serving/prefix_cache.py): the longest cached prefix's blocks are
+  ref-counted into the slot's table (copy-on-write — shared blocks
+  are read-only; every write position is in an exclusive block) and
+  only the SUFFIX is prefilled, at most `(len(prompt)-1)//block_size`
+  blocks reused so the re-decoded last prompt token never touches a
+  shared block. Freshly prefilled full prompt blocks are inserted
+  into the tree immediately, so a burst of shared-prompt requests
+  amortizes its prefill after the first admission; refcount-0 blocks
+  stay cached and are LRU-evicted only under pool pressure. The
+  load-bearing bar: cached-prefix decode is BIT-IDENTICAL to cold
+  decode — in co-batch, across eviction/reuse cycles, and through
+  fleet failover (ops/kv_cache.py explains the full-table-extent
+  construction; tests/test_kv_pool.py and the serve_prefix drill pin
+  it).
 * **One decode executable, ever.** The decode step is a single jitted
   function over all B slots; per-slot position, current token, PRNG
   stream, sampling knobs (temperature/top-k/top-p) and the poison
@@ -18,21 +39,24 @@ Design
   are per-row). Ragged traffic therefore triggers exactly
   (#prefill buckets used) + 1 compilations — the compile-count guard
   test pins this (tests/test_serving.py).
-* **Prefill buckets.** Prompts pad right to the nearest bucket
-  (serving/bucketing.py); causal attention makes real positions
-  independent of the pad, and the pad's cache garbage is never read
-  (decode masks beyond the row clock, then overwrites in place).
-  Prefill for ONE request compiles per bucket and splices its
-  batch-1 cache into the big cache with one batch-axis
-  dynamic_update_slice per leaf — admissions don't depend on how many
-  requests arrive together.
+* **Prefill buckets.** The SUFFIX (whole prompt on a miss) pads right
+  to the nearest bucket (serving/bucketing.py); causal attention makes
+  real positions independent of the pad, and the pad's garbage lands
+  beyond the row clock in the request's exclusive blocks — masked on
+  read, overwritten in place by decode. Prefill for ONE request
+  compiles per bucket (cold and warm share the executable — the
+  prefix length is an operand) and scatters its k/v straight into the
+  fresh pool blocks — admissions don't depend on how many requests
+  arrive together.
 * **First token via re-decode.** Prefill only fills the cache (its
   head projection is dead code XLA eliminates). The slot then enters
   the decode loop with current-token = last prompt token and clock =
-  len-1: the first decode step rewrites that position's k/v with
-  identical values and samples the first new token — every generated
-  token comes from the same executable, and no separate
-  sample-from-prefill path exists to compile or to drift.
+  len-1: the first decode step rewrites that position's k/v and
+  samples the first new token — every generated token comes from the
+  same executable, and no separate sample-from-prefill path exists to
+  compile or to drift. The rewrite is why prefix reuse caps at the
+  blocks STRICTLY before this position: it always lands in an
+  exclusive block, never a shared one.
 * **Per-request determinism.** Sampling keys are
   fold_in(PRNGKey(request.seed), #generated) — a request's output is
   bit-independent of its slot, its co-batch, and arrival order (the
@@ -79,10 +103,12 @@ serving):
   decode latency, deadline misses, sheds, retries, watchdog trips.
 
 The engine is model-agnostic over anything exposing
-`init_cache(batch, max_len, dtype)` / `prefill(variables, tokens,
-cache, lengths)` / `decode_step(variables, tokens, pos, cache)` whose
-cache is a pytree of batch-leading leaves (and, optionally,
-`serving_params(variables)` for a fast weight layout).
+`init_block_pool(num_blocks, block_size, dtype)` /
+`prefill_paged(variables, tokens, pools, table, block_ids, start)` /
+`decode_step_paged(variables, tokens, pos, pools, table)` whose pools
+are a pytree of block-leading leaves (and, optionally,
+`serving_params(variables)` for a fast weight layout) — the paged
+trio models/transformer.py implements.
 """
 
 from __future__ import annotations
@@ -101,11 +127,12 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
 from bigdl_tpu import obs
 from bigdl_tpu.serving.bucketing import (bucket_for, bucket_histogram,
                                          default_buckets, pad_tokens)
+from bigdl_tpu.serving.kv_pool import BlockPool
+from bigdl_tpu.serving.prefix_cache import RadixPrefixCache
 from bigdl_tpu.serving.sampler import sample_logits
 from bigdl_tpu.utils import faults
 from bigdl_tpu.utils.anomaly import rows_finite
@@ -156,40 +183,42 @@ class EngineDraining(RuntimeError):
     scale-down path rely on exactly this contract."""
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(3,))
-def _prefill_step(model, cache_dtype, params, cache, tokens, slot):
-    """Prefill ONE request (1, bucket) and splice it into slot `slot`:
-    one batch-axis dynamic_update_slice per cache leaf (the cache is
-    opaque — any per-layer pytree of batch-leading leaves works).
-    `model` is a static argument, so every engine over the same model
-    object shares one executable per bucket shape."""
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _prefill_step(model, params, pools, tokens, start, block_ids,
+                  table_row):
+    """Prefill ONE request's suffix (1, bucket) into the paged pools:
+    k/v scatter into the fresh `block_ids`, attention gathered through
+    the slot's full `table_row` (cached prefix blocks included) from
+    position `start` — a traced operand, so cold (start=0) and warm
+    prefills share ONE executable per bucket. `model` is a static
+    argument, so every engine over the same model object shares it
+    too."""
     _TRACES["prefill"] += 1               # runs at trace time only
-    small = model.init_cache(1, tokens.shape[1], cache_dtype)
-    _, small = model.prefill({"params": params}, tokens, small)
-    return jax.tree_util.tree_map(
-        lambda big, sm: lax.dynamic_update_slice(
-            big, sm, (slot,) + (0,) * (big.ndim - 1)),
-        cache, small)
+    return model.prefill_paged({"params": params}, tokens, pools,
+                               table_row, block_ids, start)
 
 
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-def _decode_step(model, params, cache, tok, pos, seed, nout, temp,
-                 topk, topp, poison):
+def _decode_step(model, params, pools, tok, pos, seed, nout, temp,
+                 topk, topp, poison, table):
     """One decode step over all slots + per-row sampling + per-row
     finite-logits health. Shared across engines of the same model
-    (static arg) — ONE executable ever. `poison` (B,) bool is the
-    serve_nan injection operand: a True row's logits are forced to NaN
-    INSIDE the jitted step, so the drill exercises the same health
-    reduction and eviction path a genuinely non-finite request would —
-    and, being a (B,) operand, arming it never retraces."""
+    (static arg) — ONE executable ever. `table` (B, max_blocks) int32
+    is each slot's block-table row (an operand: block surgery never
+    retraces). `poison` (B,) bool is the serve_nan injection operand:
+    a True row's logits are forced to NaN INSIDE the jitted step, so
+    the drill exercises the same health reduction and eviction path a
+    genuinely non-finite request would — and, being a (B,) operand,
+    arming it never retraces."""
     _TRACES["decode"] += 1                # runs at trace time only
-    logits, cache = model.decode_step({"params": params}, tok, pos, cache)
+    logits, pools = model.decode_step_paged({"params": params}, tok,
+                                            pos, pools, table)
     logits = jnp.where(poison[:, None], jnp.float32(jnp.nan), logits)
     finite = rows_finite(logits)
     keys = jax.vmap(lambda s, t: jax.random.fold_in(
         jax.random.PRNGKey(s), t))(seed, nout)
     nxt = sample_logits(logits, keys, temp, topk, topp)
-    return nxt, finite, cache
+    return nxt, finite, pools
 
 
 @dataclass
@@ -257,12 +286,23 @@ class InferenceEngine:
     control), `step_timeout_s` (watchdog over dispatch+fetch),
     `step_retries`/`retry_backoff_s` (transient step failures),
     `clock` (monotonic-seconds source for deadlines — injectable so
-    expiry drills are bit-deterministic)."""
+    expiry drills are bit-deterministic).
+
+    Paged-cache knobs (constructor args, never env — graftlint
+    trace-env-read): `block_size` (tokens per KV block; cache length
+    must divide by it; >= 2), `pool_blocks` (total pool blocks incl.
+    the reserved scratch block 0; default slots * cache_len //
+    block_size + 1 — dense-capacity parity), `prefix_cache` (False
+    disables radix reuse — every admission prefills cold; the bench's
+    cold-baseline column)."""
 
     def __init__(self, model, variables=None, slots: int = 4,
                  max_len: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  cache_dtype=jnp.float32,
+                 block_size: int = 16,
+                 pool_blocks: Optional[int] = None,
+                 prefix_cache: bool = True,
                  max_queue: Optional[int] = None,
                  overload_policy: str = "reject",
                  step_timeout_s: Optional[float] = None,
@@ -282,7 +322,38 @@ class InferenceEngine:
         self.cache_len = max_len if max_len is not None \
             else model.cfg.max_len
         self.cache_dtype = cache_dtype
-        self.cache = model.init_cache(slots, self.cache_len, cache_dtype)
+        if block_size < 2:
+            raise ValueError("block_size must be >= 2 (a 1-token "
+                             "suffix prefill would break the paged "
+                             "bit-identity contract, ops/kv_cache.py)")
+        if self.cache_len % block_size:
+            raise ValueError(f"cache length {self.cache_len} must be "
+                             f"a multiple of block_size {block_size}")
+        self.block_size = block_size
+        self.blocks_per_slot = self.cache_len // block_size
+        if pool_blocks is None:
+            # capacity parity with the old dense cache: every slot can
+            # hold a full-length sequence with zero sharing (+1 for
+            # the reserved scratch block 0); sharing then turns spare
+            # blocks into cached prefixes instead of requiring them
+            pool_blocks = slots * self.blocks_per_slot + 1
+        if pool_blocks < self.blocks_per_slot + 1:
+            raise ValueError(
+                f"pool_blocks {pool_blocks} cannot hold even one "
+                f"full-length sequence ({self.blocks_per_slot} blocks "
+                "+ scratch)")
+        self.pool_blocks = pool_blocks
+        self.prefix_cache_enabled = bool(prefix_cache)
+        self.pool = model.init_block_pool(pool_blocks, block_size,
+                                          cache_dtype)
+        self._pool_mgr = BlockPool(pool_blocks, block_size)
+        self._prefix = RadixPrefixCache(self._pool_mgr)
+        # KV bytes one token occupies across all layers (the
+        # bytes-saved counter's unit), from the pool leaves themselves
+        # — model-agnostic
+        self._kv_bytes_per_token = int(sum(
+            leaf.dtype.itemsize * leaf.shape[1] * leaf.shape[3]
+            for leaf in jax.tree_util.tree_leaves(self.pool)))
         self.buckets = tuple(sorted(
             prefill_buckets if prefill_buckets is not None
             else default_buckets(self.cache_len)))
@@ -307,6 +378,9 @@ class InferenceEngine:
             "shed": 0, "rejected": 0, "deadline_misses": 0,
             "poisoned": 0, "failed": 0, "retries": 0,
             "watchdog_trips": 0, "cancelled": 0,
+            "prefix_hits": 0, "prefix_blocks_reused": 0,
+            "prefix_tokens_saved": 0, "prefix_bytes_saved": 0,
+            "pool_evictions": 0,
         }
         # ---- telemetry plane (ISSUE 5): every _stats increment also
         # mirrors into the process-wide registry under this engine's
@@ -334,6 +408,15 @@ class InferenceEngine:
             "watchdog_trips": "step-watchdog trips",
             "rejected": "submissions rejected under overload",
             "cancelled": "host-side cancellations",
+            "prefix_hits": "admissions that reused a cached prefix",
+            "prefix_blocks_reused": "KV blocks reused from the "
+                                    "prefix cache",
+            "prefix_tokens_saved": "prompt tokens whose prefill was "
+                                   "skipped by a prefix hit",
+            "prefix_bytes_saved": "KV bytes not recomputed thanks to "
+                                  "prefix hits",
+            "pool_evictions": "LRU prefix blocks evicted under pool "
+                              "pressure",
         }
         self._m_ops = {
             key: reg.counter(f"serving_{key}_total", help_,
@@ -344,6 +427,10 @@ class InferenceEngine:
             "serving_decode_step_seconds",
             "decode dispatch+fetch wall seconds",
             labelnames=("engine",)).labels(engine=self._obs_name)
+        self._m_pool_gauge = reg.gauge(
+            "serving_kv_pool_blocks_in_use",
+            "KV pool blocks held by live requests or cached prefixes",
+            labelnames=("engine",)).labels(engine=self._obs_name)
         self._trace0 = dict(_TRACES)
         # finished results not yet handed back by a run(requests=...)
         # call — retrievable here (results are never silently dropped)
@@ -352,6 +439,13 @@ class InferenceEngine:
         self._ids = itertools.count()
         self._req: List[Optional[Request]] = [None] * slots
         self._gen: List[List[int]] = [[] for _ in range(slots)]
+        # block table: row per slot, entry 0 = unassigned (scratch) —
+        # the decode step's (B, max_blocks) operand
+        self._table = np.zeros((slots, self.blocks_per_slot), np.int32)
+        # per-slot (hit_blocks, own_blocks): shared prefix refs vs
+        # exclusively owned blocks, for release at eviction
+        self._slot_blocks: List[List[List[int]]] = [
+            [[], []] for _ in range(slots)]
         self._pos = np.zeros(slots, np.int32)
         self._tok = np.zeros(slots, np.int32)
         self._nout = np.zeros(slots, np.int32)   # sampling-stream clock
@@ -472,6 +566,16 @@ class InferenceEngine:
             "failed": s["failed"], "cancelled": s["cancelled"],
             "requests_done": s["requests_done"],
             "decode_steps": s["decode_steps"],
+            "prefix": {
+                "enabled": self.prefix_cache_enabled,
+                "hits": s["prefix_hits"],
+                "blocks_reused": s["prefix_blocks_reused"],
+                "tokens_saved": s["prefix_tokens_saved"],
+                "bytes_saved": s["prefix_bytes_saved"],
+                "evictions": s["pool_evictions"],
+                "tree_blocks": self._prefix.num_blocks,
+                "pool": self._pool_mgr.stats(),
+            },
             "metrics": {
                 "engine": self._obs_name,
                 "decode_step_seconds": {
@@ -700,45 +804,133 @@ class InferenceEngine:
         del self._queue[best_i]
         return req
 
+    def _alloc_blocks(self, n: int) -> Optional[List[int]]:
+        """Take `n` fresh blocks, LRU-evicting cached (refcount-0)
+        prefix blocks under pressure; None when even eviction cannot
+        free enough (every block pinned by live requests)."""
+        evicted = 0
+        while self._pool_mgr.free_count < n:
+            b = self._prefix.evict_one()
+            if b is None:
+                break
+            evicted += 1
+        if evicted:
+            self._bump("pool_evictions", evicted)
+            obs.emit_event("prefix_evict", plane="serving",
+                           engine=self._obs_name, blocks=evicted)
+        return self._pool_mgr.alloc(n)
+
+    def _update_pool_gauge(self) -> None:
+        if obs.enabled():
+            self._m_pool_gauge.set(self._pool_mgr.capacity
+                                   - self._pool_mgr.free_count)
+
     def _admit(self):
         self._expire_queued(self._clock())
         for slot in self._free_slots():
             if not self._queue:
                 return
             req = self._pop_next()
-            prompt = list(req.prompt)
-            b = bucket_for(len(prompt), self.buckets)
-            toks = pad_tokens(prompt, b)[None, :]          # (1, bucket)
-            tracer = obs.get_tracer()
-            t_admit = self._clock()
-            if tracer.enabled:
-                # the queued phase closes when the slot is granted
-                t_sub = self._meta.get(req.id, {}).get("t", t_admit)
-                tracer.complete("queued", "serving", t_sub, t_admit,
-                                args={"request": req.id, "slot": slot})
-            with warnings.catch_warnings():
-                # donation is a per-call no-op warning on CPU backends;
-                # on TPU it aliases the cache update in place
-                warnings.filterwarnings(
-                    "ignore", message=".*[Dd]onat", category=UserWarning)
-                self.cache = _prefill_step(
-                    self.model, self.cache_dtype, self._params,
-                    self.cache, jnp.asarray(toks), np.int32(slot))
-            if tracer.enabled:
-                tracer.complete("prefill", "serving", t_admit,
-                                self._clock(),
-                                args={"request": req.id, "slot": slot,
-                                      "bucket": int(b)})
-            self._bump("prefill_calls")
-            self._req[slot] = req
-            self._gen[slot] = []
-            self._pos[slot] = len(prompt) - 1   # re-decode last prompt tok
-            self._tok[slot] = prompt[-1]
-            self._nout[slot] = 0
-            self._seed[slot] = req.seed
-            self._temp[slot] = req.temperature
-            self._topk[slot] = req.top_k
-            self._topp[slot] = req.top_p
+            if not self._admit_into(slot, req):
+                # pool pressure: every evictable prefix block is gone
+                # and the free list still cannot cover the suffix —
+                # park the request at the FRONT of the line (its
+                # precedence is preserved) and stop admitting; blocks
+                # free as in-flight requests finish
+                self._queue.appendleft(req)
+                return
+
+    def _admit_into(self, slot: int, req: Request) -> bool:
+        """Prefix lookup + block allocation + suffix prefill into
+        `slot`. False = insufficient pool blocks (caller requeues)."""
+        prompt = list(req.prompt)
+        n = len(prompt)
+        bs = self.block_size
+        hit: List[int] = []
+        start = 0
+        if self.prefix_cache_enabled:
+            # COW cap: reuse at most the full blocks strictly before
+            # the re-decoded last prompt token (ops/kv_cache.py)
+            hit = self._prefix.lookup(prompt, (n - 1) // bs)
+            start = len(hit) * bs
+            # feasibility trim: the suffix bucket must fit the table
+            while hit and start + bucket_for(n - start,
+                                             self.buckets) \
+                    > self.cache_len:
+                hit.pop()
+                start -= bs
+        suffix = prompt[start:]
+        b = bucket_for(len(suffix), self.buckets)
+        nb_new = -(-b // bs)                  # blocks the suffix covers
+        # pin the hit chain BEFORE allocating: the allocator's LRU
+        # eviction must never reclaim the very blocks this admission
+        # just matched (a refcount-0 cached block is fair game to it)
+        self._pool_mgr.ref(hit)
+        new = self._alloc_blocks(nb_new)
+        if new is None:
+            self._pool_mgr.unref(hit)         # back to cached parking
+            return False
+        row = self._table[slot]
+        row[:] = 0
+        row[:len(hit)] = hit
+        row[len(hit):len(hit) + nb_new] = new
+        toks = pad_tokens(suffix, b)[None, :]          # (1, bucket)
+        tracer = obs.get_tracer()
+        t_admit = self._clock()
+        if tracer.enabled:
+            # the queued phase closes when the slot is granted
+            t_sub = self._meta.get(req.id, {}).get("t", t_admit)
+            tracer.complete("queued", "serving", t_sub, t_admit,
+                            args={"request": req.id, "slot": slot})
+        with warnings.catch_warnings():
+            # donation is a per-call no-op warning on CPU backends;
+            # on TPU it aliases the pool update in place
+            warnings.filterwarnings(
+                "ignore", message=".*[Dd]onat", category=UserWarning)
+            self.pool = _prefill_step(
+                self.model, self._params, self.pool,
+                jnp.asarray(toks), np.int32(start),
+                jnp.asarray(new, dtype=jnp.int32),
+                jnp.asarray(row[None, :]))
+        if tracer.enabled:
+            tracer.complete("prefill", "serving", t_admit,
+                            self._clock(),
+                            args={"request": req.id, "slot": slot,
+                                  "bucket": int(b),
+                                  "prefix_tokens": int(start)})
+        self._bump("prefill_calls")
+        if self.prefix_cache_enabled:
+            # register the prompt's full pre-COW-cap blocks (their
+            # content is valid the moment the prefill above lands);
+            # the hit chain already exists in the tree and is skipped
+            cap_blocks = (n - 1) // bs
+            if cap_blocks:
+                owned = self._prefix.insert(
+                    prompt, [int(x) for x in row[:cap_blocks]])
+                for bid in owned:
+                    self._pool_mgr.mark_cached(bid)
+        if start:
+            self._bump("prefix_hits")
+            self._bump("prefix_blocks_reused", len(hit))
+            self._bump("prefix_tokens_saved", start)
+            self._bump("prefix_bytes_saved",
+                       start * self._kv_bytes_per_token)
+            obs.emit_event("prefix_hit", plane="serving",
+                           engine=self._obs_name, request=req.id,
+                           matched_tokens=start, blocks=len(hit),
+                           prompt_len=n)
+        self._update_pool_gauge()
+        self._req[slot] = req
+        self._gen[slot] = []
+        self._slot_blocks[slot] = [list(hit), list(new)]
+        self._pos[slot] = n - 1         # re-decode last prompt token
+        self._tok[slot] = prompt[-1]
+        self._nout[slot] = 0
+        self._seed[slot] = req.seed
+        self._temp[slot] = req.temperature
+        self._topk[slot] = req.top_k
+        self._topp[slot] = req.top_p
+        return True
 
     def _finish(self, slot: int, reason: str,
                 status: str = "done") -> GenerationResult:
@@ -753,27 +945,57 @@ class InferenceEngine:
         self._gen[slot] = []
         self._temp[slot] = 0.0
         self._meta.pop(req.id, None)
+        self._release_slot(slot, poisoned=(status == "poisoned"))
         self._bump(_STATUS_COUNTER[status])
         return res
 
-    def _scrub_slot(self, slot: int) -> None:
-        """Zero a poisoned slot's cache rows before reuse. A genuinely
-        non-finite request wrote NaN k/v at its positions; the next
-        occupant overwrites every position it can see, and
-        cached_attention nan-scrubs masked value rows — this scrub is
+    def _release_slot(self, slot: int, poisoned: bool = False) -> None:
+        """Return a finished slot's blocks: shared prefix refs drop
+        (refcount-0 tree blocks park as cached, reusable); exclusive
+        blocks free. A POISONED request's freed exclusive blocks are
+        scrubbed to zero on device — and its exclusive tree leaves
+        forgotten first — but a SHARED (refcount > 1) block is never
+        scrubbed or forgotten: live co-users hold content that is
+        bit-identical to what they would have computed cold (the
+        serve_prefix drill pins exactly this)."""
+        hit, own = self._slot_blocks[slot]
+        pool = self._pool_mgr
+        freed = pool.unref(hit)
+        # deep-to-shallow: forget_block removes LEAVES only, so the
+        # exclusive chain must be forgotten from its deepest block up
+        # (each removal turns the parent into a leaf) — shallow-first
+        # would strand every interior block as reusable cached content
+        # a later same-prefix request could hit
+        for b in reversed(own):
+            if poisoned and pool.in_tree(b) and pool.refcount(b) == 1:
+                self._prefix.forget_block(b)
+            freed += pool.unref([b])
+        if poisoned and freed:
+            self._scrub_blocks(freed)
+        self._slot_blocks[slot] = [[], []]
+        self._table[slot, :] = 0
+        self._update_pool_gauge()
+
+    def _scrub_blocks(self, blocks: List[int]) -> None:
+        """Zero freed pool blocks a poisoned request wrote. The next
+        occupant overwrites every position it can see and
+        block_attention zeroes invisible value rows — this scrub is
         the belt to that suspenders, keeping the invariant local:
-        nothing a poisoned request wrote survives its eviction."""
-        self.cache = jax.tree_util.tree_map(
-            lambda leaf: leaf.at[slot].set(
-                jnp.zeros((), leaf.dtype)), self.cache)
+        nothing a poisoned request wrote survives its eviction (except
+        inside a shared block, whose content is by construction the
+        same bits a healthy cold run computes)."""
+        idx = jnp.asarray(blocks, jnp.int32)
+        self.pool = jax.tree_util.tree_map(
+            lambda leaf: leaf.at[idx].set(jnp.zeros((), leaf.dtype)),
+            self.pool)
 
     def _cache_consumed(self) -> bool:
-        """True if any cache leaf's buffer was donated/deleted by a
+        """True if any pool leaf's buffer was donated/deleted by a
         failed dispatch — such a step is NOT retryable (the input no
         longer exists); only failures raised before execution
         consumed the buffers are."""
         return any(getattr(leaf, "is_deleted", lambda: False)()
-                   for leaf in jax.tree_util.tree_leaves(self.cache))
+                   for leaf in jax.tree_util.tree_leaves(self.pool))
 
     def _degrade(self, reason: str) -> List[GenerationResult]:
         """Quiesce: fail every in-flight and queued request, refuse new
@@ -805,22 +1027,33 @@ class InferenceEngine:
         def work():
             if slow_s:
                 time.sleep(slow_s)    # injected straggler/hang model
+            if self._degraded is not None:
+                # the watchdog already tripped while this (now
+                # abandoned) thread was stuck pre-dispatch: do NOT
+                # launch device work nobody will consume — a late
+                # dispatch can still be executing at interpreter
+                # shutdown and aborts the process (observed with the
+                # paged decode's pool gather). A hang INSIDE the real
+                # dispatch is beyond this guard — that is the tunnel
+                # failure mode the watchdog exists to convert.
+                return None
             with warnings.catch_warnings():
                 warnings.filterwarnings(
                     "ignore", message=".*[Dd]onat", category=UserWarning)
-                nxt, finite, cache = _decode_step(
-                    self.model, self._params, self.cache,
+                nxt, finite, pools = _decode_step(
+                    self.model, self._params, self.pool,
                     jnp.asarray(self._tok), jnp.asarray(self._pos),
                     jnp.asarray(self._seed), jnp.asarray(self._nout),
                     jnp.asarray(self._temp), jnp.asarray(self._topk),
-                    jnp.asarray(self._topp), jnp.asarray(poison))
+                    jnp.asarray(self._topp), jnp.asarray(poison),
+                    jnp.asarray(self._table))
             # THE one deliberate per-step device→host fetch: it fences
             # the decode dispatch (block_until_ready lies through the
             # tunnel) and runs inside the watchdog budget above
-            return np.asarray(nxt), np.asarray(finite), cache  # graftlint: disable=hidden-device-sync
+            return np.asarray(nxt), np.asarray(finite), pools  # graftlint: disable=hidden-device-sync
 
         if self.step_timeout_s is None or not watchdog:
-            nxt, finite, cache = work()
+            nxt, finite, pools = work()
         else:
             box: Dict[str, object] = {}
 
@@ -840,9 +1073,33 @@ class InferenceEngine:
                     f"{self.step_timeout_s} s watchdog budget")
             if "e" in box:
                 raise box["e"]                  # type: ignore[misc]
-            nxt, finite, cache = box["r"]       # type: ignore[misc]
-        self.cache = cache
+            nxt, finite, pools = box["r"]       # type: ignore[misc]
+        self.pool = pools
         return nxt, finite
+
+    def _ensure_blocks(self) -> List[GenerationResult]:
+        """Pre-dispatch block growth: a row whose next write position
+        crossed into an uncovered block gets a fresh one appended to
+        its table (copy-on-write — generated tokens never extend into
+        a shared block). If the pool cannot supply one even after LRU
+        eviction, the request finishes 'pool_exhausted' (status done,
+        partial tokens kept — the block-pool sibling of cache_full).
+        With the default pool sizing this cannot happen: worst-case
+        zero-sharing demand is exactly slots * blocks_per_slot."""
+        done: List[GenerationResult] = []
+        for i, req in enumerate(self._req):
+            if req is None:
+                continue
+            bi = int(self._pos[i]) // self.block_size
+            if self._table[i, bi] != 0:
+                continue
+            new = self._alloc_blocks(1)
+            if new is None:
+                done.append(self._finish(i, "pool_exhausted"))
+                continue
+            self._table[i, bi] = new[0]
+            self._slot_blocks[i][1].append(new[0])
+        return done
 
     def step(self) -> List[GenerationResult]:
         """Admit queued requests into free slots, run ONE decode step
@@ -853,8 +1110,9 @@ class InferenceEngine:
         if self._degraded:
             return []
         self._admit()
+        done = self._ensure_blocks()
         if all(r is None for r in self._req):
-            return []
+            return done
         plan = faults.get_plan()
         stepno = self._stats["decode_steps"]
         poison = np.zeros(self.slots, bool)
@@ -914,13 +1172,13 @@ class InferenceEngine:
                     time.sleep(self.retry_backoff_s * (2 ** attempt))
         self._bump("decode_steps")
         now = self._clock()
-        done = []
         for i, req in enumerate(self._req):
             if req is None:
                 continue
             self._nout[i] += 1
             if not bool(finite[i]):
-                self._scrub_slot(i)
+                # eviction scrubs the poisoned request's freed
+                # exclusive blocks (never a shared one) — _release_slot
                 done.append(self._finish(i, "poisoned", "poisoned"))
                 continue
             tok = int(nxt[i])

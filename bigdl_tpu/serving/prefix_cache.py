@@ -1,0 +1,189 @@
+"""Content-hashed radix prefix cache over paged KV blocks (ISSUE 8).
+
+No reference counterpart: BigDL 2.0's Cluster Serving (arXiv
+2204.01715) argues the serving win at scale comes from reusing work
+across the request stream; the original paper's "data stays put,
+compute moves" principle (arXiv 1804.05839) maps onto KV blocks —
+keep computed KV resident, route new requests to it. This module is
+the routing table: a radix tree whose edges are BLOCK-ALIGNED token
+chunks (`block_size` tokens each, addressed by a rolling content hash
+with exact-token verification, so hash collisions cannot alias two
+prompts) and whose nodes each own one pool block of already-computed
+KV.
+
+Contracts (the engine relies on all three):
+
+* **Match is capped by the caller** at `(len(prompt) - 1) //
+  block_size` full blocks — the re-decoded last prompt token, and
+  everything generated after it, must land in an exclusive block
+  (copy-on-write; see ops/kv_cache.py on why decode-written positions
+  are never shareable bitwise).
+* **Insert happens at prefill time** with the prefiller still holding
+  a ref on every inserted block, so a tree node's block can never be
+  on the free list; the tree marks them `cached` in the BlockPool and
+  from then on owns their refcount-0 parking.
+* **Eviction is LRU over refcount-0 LEAVES only** — interior nodes
+  wait for their subtree, so a cached chain never dangles. Order is a
+  logical clock (no wall time), making eviction bit-deterministic
+  (graftlint nondeterministic-drill clean by construction).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from bigdl_tpu.serving.kv_pool import BlockPool
+
+# rolling polynomial hash over a block's token ids — cheap, stable
+# across processes (no PYTHONHASHSEED dependence), collision-checked
+# against the stored tokens on every hit
+_HASH_BASE = 1_000_003
+_HASH_MOD = (1 << 61) - 1
+
+
+def chunk_hash(tokens: Sequence[int], prev: int = 0) -> int:
+    """Rolling content hash of one block-aligned chunk, chained on the
+    parent's hash so equal chunks under different prefixes never
+    collide structurally."""
+    h = prev
+    for t in tokens:
+        h = (h * _HASH_BASE + int(t) + 1) % _HASH_MOD
+    return h
+
+
+class _Node:
+    __slots__ = ("tokens", "hash", "block", "parent", "children",
+                 "stamp")
+
+    def __init__(self, tokens: Tuple[int, ...], h: int, block: int,
+                 parent: Optional["_Node"]):
+        self.tokens = tokens
+        self.hash = h
+        self.block = block
+        self.parent = parent
+        self.children: Dict[int, "_Node"] = {}
+        self.stamp = 0
+
+
+class RadixPrefixCache:
+    """Radix tree over block-aligned token prefixes → pool blocks.
+
+    All methods are pure host bookkeeping — no device work, no wall
+    clock, no RNG (hot-path names lookup/insert/evict are pinned
+    sync-free by graftlint hidden-device-sync)."""
+
+    def __init__(self, pool: BlockPool):
+        self.pool = pool
+        self.block_size = pool.block_size
+        self._root = _Node((), 0, 0, None)
+        self._clock = itertools.count(1)
+        self._by_block: Dict[int, _Node] = {}
+
+    # ------------------------------------------------------------ views
+    @property
+    def num_blocks(self) -> int:
+        """Blocks currently addressable through the tree."""
+        return len(self._by_block)
+
+    # ----------------------------------------------------------- lookup
+    def lookup(self, tokens: Sequence[int], max_blocks: int
+               ) -> List[int]:
+        """Longest cached block-aligned prefix of `tokens`, at most
+        `max_blocks` blocks (the caller's COW cap). Returns the block
+        ids root-first and LRU-touches the matched chain. Does NOT
+        take refs — the engine refs exactly the blocks it commits to
+        (after its bucket/table feasibility trim)."""
+        bs = self.block_size
+        out: List[int] = []
+        node, h = self._root, 0
+        for i in range(max_blocks):
+            chunk = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+            if len(chunk) < bs:
+                break
+            h = chunk_hash(chunk, node.hash)
+            child = node.children.get(h)
+            if child is None or child.tokens != chunk:
+                break                      # miss (or hash collision)
+            out.append(child.block)
+            node = child
+        stamp = next(self._clock)
+        n = node
+        while n is not self._root:          # touch leaf→root; one
+            n.stamp = stamp                 # stamp per lookup keeps
+            n = n.parent                    # eviction order stable
+        return out
+
+    # ----------------------------------------------------------- insert
+    def insert(self, tokens: Sequence[int], blocks: Sequence[int]
+               ) -> List[int]:
+        """Register a just-prefilled prompt's full blocks: `tokens`
+        truncated to len(blocks) * block_size, `blocks` the slot's
+        block-table prefix in position order (shared hit blocks first
+        — those nodes already exist and are skipped — then the fresh
+        ones this prefill wrote). Returns the block ids that became
+        tree-owned NOW (the engine marks them cached in the pool).
+        Idempotent: re-inserting an existing chain is a no-op."""
+        bs = self.block_size
+        owned: List[int] = []
+        node = self._root
+        stamp = next(self._clock)
+        for i, block in enumerate(blocks):
+            chunk = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+            if len(chunk) < bs:
+                break
+            h = chunk_hash(chunk, node.hash)
+            child = node.children.get(h)
+            if child is not None and child.tokens == chunk:
+                # already cached (our own hit blocks, or a racing
+                # identical prompt) — keep the existing owner
+                child.stamp = stamp
+                node = child
+                continue
+            if child is not None:
+                # true hash collision: keep the incumbent, don't
+                # register ours (it stays a plain exclusive block)
+                break
+            child = _Node(chunk, h, int(block), node)
+            child.stamp = stamp
+            node.children[h] = child
+            self._by_block[int(block)] = child
+            owned.append(int(block))
+            node = child
+        return owned
+
+    # ---------------------------------------------------------- evict
+    def evict_one(self) -> Optional[int]:
+        """Evict the least-recently-used refcount-0 LEAF back to the
+        free list; returns its block id (for the caller's counters) or
+        None when nothing is evictable. O(nodes) scan — pools are
+        hundreds of blocks, and eviction only runs under pressure."""
+        best: Optional[_Node] = None
+        for node in self._by_block.values():
+            if node.children or self.pool.refcount(node.block) > 0:
+                continue
+            if best is None or node.stamp < best.stamp:
+                best = node
+        if best is None:
+            return None
+        self._detach(best)
+        self.pool.release_cached(best.block)
+        return best.block
+
+    def forget_block(self, block: int) -> bool:
+        """Drop one block's node from the tree if it is a LEAF (the
+        poisoned-eviction hygiene path: the engine forgets a poisoned
+        request's exclusive tree nodes before scrubbing them — and,
+        per the drill contract, never touches a shared refcount>1
+        block, which by definition has live users and simply keeps
+        its node). Returns True if the node was removed."""
+        node = self._by_block.get(block)
+        if node is None or node.children:
+            return False
+        self._detach(node)
+        self.pool.release_cached(block)
+        return True
+
+    def _detach(self, node: _Node) -> None:
+        del node.parent.children[node.hash]
+        del self._by_block[node.block]

@@ -52,6 +52,14 @@ with utils/faults serving kinds injected by DECODE step number:
                     step_timeout_s: the watchdog trips, in-flight
                     requests fail with status 'failed', the engine
                     quiesces and health() reports the trip
+    serve_prefix    paged KV prefix reuse (ISSUE 8): a cached-prefix
+                    admission decodes BIT-IDENTICAL to its cold run
+                    (in co-batch with a stranger); LRU eviction under
+                    pool pressure then re-prefill stays bit-identical;
+                    and a poisoned request's eviction scrubs only its
+                    exclusive blocks — never a shared (refcount>1)
+                    prefix block, whose live co-user finishes
+                    bit-identical and whose content keeps serving hits
 
 Fleet legs (ISSUE 7 — the router/autoscaler layer above the engines,
 bigdl_tpu/serving/router.py + autoscaler.py):
@@ -618,6 +626,131 @@ def drill_serve_watchdog(workdir):
             "events": log.counts_by_kind()}
 
 
+def drill_serve_prefix(workdir):
+    """Paged KV cache + radix prefix reuse (ISSUE 8), three checks on
+    block_size=4 engines under an injected clock, all asserted from
+    obs events/counters:
+
+    (1) warm-vs-cold bit-identity: the same prompt resubmitted hits
+        the radix cache (prefix_hit event, serving_prefix_* counters)
+        and — co-batched with a stranger — decodes tokens
+        bit-identical to the cold run;
+    (2) eviction-then-reuse: a deliberately tiny pool forces LRU
+        eviction of the cached prefix (prefix_evict event,
+        pool_evictions counter); resubmitting re-prefills cold and is
+        STILL bit-identical;
+    (3) poisoned-request hygiene: a serve_nan-poisoned request sharing
+        a refcount-2 prefix with a live co-batched request evicts with
+        its exclusive blocks scrubbed, but the SHARED blocks survive —
+        the co-user finishes bit-identical to running alone and a
+        follow-up request still hits the intact prefix."""
+    from bigdl_tpu import obs
+    from bigdl_tpu.serving import InferenceEngine
+
+    clk = {"t": 0.0}
+
+    def eng(**kw):
+        kw.setdefault("slots", 2)
+        kw.setdefault("prefill_buckets", (8, 16))
+        kw.setdefault("block_size", 4)
+        kw.setdefault("max_len", 32)
+        kw.setdefault("clock", lambda: clk["t"])
+        return InferenceEngine(_serve_lm(), **kw)
+
+    P = dict(prompt=[5, 9, 3, 7, 2, 8, 4, 6, 1, 3, 9, 2, 7],
+             max_new_tokens=5, temperature=0.8, seed=11)
+    S = dict(prompt=[30, 31, 32], max_new_tokens=5, temperature=0.9,
+             seed=4)
+    cold = eng().run([_req(**P)])[0]
+    alone_s = eng().run([_req(**S)])[0]
+
+    # --- (1) warm vs cold, in co-batch
+    with _telemetry() as log1:
+        e1 = eng()
+        e1.run([_req(**P)])                      # cold: seeds the tree
+        warm, stranger = e1.run([_req(**P), _req(**S)])
+        snap = obs.get_registry().snapshot()["metrics"]
+    hits_ev = log1.events("prefix_hit")
+
+    def counter(name, metrics):
+        fam = metrics.get(name, {"series": []})
+        return sum(s["value"] for s in fam["series"])
+
+    warm_ok = (warm.tokens == cold.tokens
+               and stranger.tokens == alone_s.tokens
+               and len(hits_ev) == 1
+               and hits_ev[0]["matched_tokens"] == 12
+               and counter("serving_prefix_hits_total", snap) == 1
+               and counter("serving_prefix_tokens_saved_total",
+                           snap) == 12
+               and counter("serving_kv_pool_blocks_in_use", snap) > 0)
+
+    # --- (2) eviction under pool pressure, then reuse
+    with _telemetry() as log2:
+        e2 = eng(slots=1, pool_blocks=9)         # 8 usable blocks
+        e2.run([_req(**P)])                      # caches 3 blocks
+        for i in range(3):                       # churn: distinct 9-tok
+            e2.run([_req(prompt=[10 + i, 20 + i, 30 + i, 40 + i,
+                                 11 + i, 21 + i, 31 + i, 41 + i, 2],
+                         max_new_tokens=3, seed=i)])
+        rerun = e2.run([_req(**P)])[0]
+    evict_ev = log2.events("prefix_evict")
+    evict_ok = (e2.stats["pool_evictions"] > 0 and len(evict_ev) > 0
+                and rerun.tokens == cold.tokens)
+
+    # --- (3) poisoned eviction never scrubs a shared block
+    shared = [7, 3, 9, 1, 4, 8, 2, 6]
+    V = dict(prompt=shared + [11, 12], max_new_tokens=6,
+             temperature=0.8, seed=5)
+    H = dict(prompt=shared + [13, 14, 15], max_new_tokens=6,
+             temperature=0.9, seed=9)
+    F = dict(prompt=shared + [16], max_new_tokens=4, temperature=0.6,
+             seed=2)
+    alone_h = eng().run([_req(**H)])[0]
+    alone_f = eng().run([_req(**F)])[0]
+    fm = _plan("serve_nan@2")
+    try:
+        with _telemetry() as log3:
+            e3 = eng()
+            # V admits first (cold, inserts the shared prefix), H
+            # admits beside it and hits → the 2 shared blocks are
+            # refcount-2 when V is poisoned at decode step 2
+            got_v, got_h = e3.run([_req(**V), _req(**H)])
+            follow = e3.run([_req(**F)])[0]
+    finally:
+        fm.set_plan(None)
+    poisoned_ev = log3.events("request_terminal", status="poisoned")
+    hit3_ev = log3.events("prefix_hit")
+    poison_ok = (got_v.status == "poisoned"
+                 and got_h.status == "done"
+                 and got_h.tokens == alone_h.tokens
+                 # the shared prefix SURVIVED the poisoned eviction:
+                 # the follow-up still hits it and stays bit-identical
+                 and follow.tokens == alone_f.tokens
+                 and len(hit3_ev) == 2           # H + the follow-up
+                 and all(e["matched_tokens"] == 8 for e in hit3_ev)
+                 and len(poisoned_ev) == 1)
+    ok = warm_ok and evict_ok and poison_ok
+    return {"ok": bool(ok),
+            "warm_bit_identical": warm.tokens == cold.tokens,
+            "cobatch_stranger_bit_identical":
+                stranger.tokens == alone_s.tokens,
+            "prefix_hits_counter": counter(
+                "serving_prefix_hits_total", snap),
+            "tokens_saved_counter": counter(
+                "serving_prefix_tokens_saved_total", snap),
+            "evictions": e2.stats["pool_evictions"],
+            "post_evict_bit_identical": rerun.tokens == cold.tokens,
+            "poisoned_status": got_v.status,
+            "shared_survivor_bit_identical":
+                got_h.tokens == alone_h.tokens,
+            "shared_block_reuse_after_poison":
+                follow.tokens == alone_f.tokens,
+            "events": {"warm": log1.counts_by_kind(),
+                       "evict": log2.counts_by_kind(),
+                       "poison": log3.counts_by_kind()}}
+
+
 # ------------------------------------------------------------ fleet legs
 
 def drill_fleet_failover(workdir):
@@ -798,6 +931,7 @@ SERVING_LEGS = {
     "serve_deadline": drill_serve_deadline,
     "serve_retry": drill_serve_retry,
     "serve_watchdog": drill_serve_watchdog,
+    "serve_prefix": drill_serve_prefix,
     "fleet_failover": drill_fleet_failover,
     "fleet_drain": drill_fleet_drain,
     "fleet_autoscale": drill_fleet_autoscale,
@@ -826,6 +960,17 @@ def main():
         ok = ok and r["ok"]
         print(json.dumps({"leg": name, **r}))
     print(json.dumps({"ok": ok, "legs": list(results)}))
+    # watchdog legs abandon their tripped step threads (by design —
+    # the thread models a hung device call); give them a bounded
+    # window to wind down so interpreter teardown never races a live
+    # XLA dispatch (observed as an exit-time abort). A thread stuck in
+    # a REAL hang is a daemon — the join times out and exit proceeds.
+    import threading
+
+    for th in threading.enumerate():
+        if th is not threading.current_thread() and th.daemon \
+                and th.name.startswith("bigdl-serving-step"):
+            th.join(timeout=2.0)
     sys.exit(0 if ok else 1)
 
 

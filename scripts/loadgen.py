@@ -73,17 +73,31 @@ def make_trace(n_requests: int = 32, *, seed: int = 0,
                priorities=(0, 0, 0, 5),
                deadline_frac: float = 0.0, deadline_s: float = 30.0,
                sessions: int = 0, session_turns: int = 3,
-               think_s: float = 1.0, vocab: int = 50) -> dict:
+               think_s: float = 1.0, vocab: int = 50,
+               shared_prefix_len: int = 0,
+               shared_frac: float = 0.9) -> dict:
     """Build a deterministic trace: `n_requests` single-shot requests
     plus `sessions` multi-turn sessions (their heads arrive through
     the same arrival process; later turns are scheduled at replay
     time). Everything — gaps, prompts, sampling seeds, priorities,
     deadline draws, continuation blocks — comes from ONE
     RandomState(seed), so the trace is a pure function of its
-    arguments."""
+    arguments.
+
+    `shared_prefix_len` > 0 switches on the ISSUE 8 shared-prompt
+    workload: one common prefix of that many tokens is drawn once from
+    the trace seed, and each request prepends it with probability
+    `shared_frac` (its unique tail still comes from
+    prompt_len_choices) — the traffic shape whose prefill the paged
+    prefix cache amortizes away. Non-shared requests draw a fully
+    unique prompt of the same total length, keeping the two
+    populations comparable."""
     if arrival not in ("poisson", "bursty"):
         raise ValueError(f"arrival {arrival!r}: expected poisson|bursty")
     rng = np.random.RandomState(seed)
+    shared_prefix = [int(x) for x in rng.randint(1, vocab,
+                                                 shared_prefix_len)] \
+        if shared_prefix_len else []
     arrivals: List[Arrival] = []
     t = 0.0
     for i in range(n_requests + sessions):
@@ -92,8 +106,16 @@ def make_trace(n_requests: int = 32, *, seed: int = 0,
         elif i and i % burst_size == 0:          # bursty: waves
             t += burst_gap_s
         n = int(rng.choice(prompt_len_choices))
+        if shared_prefix_len:
+            tail = [int(x) for x in rng.randint(1, vocab, n)]
+            prompt = (shared_prefix + tail
+                      if float(rng.rand()) < shared_frac
+                      else [int(x) for x in rng.randint(
+                          1, vocab, shared_prefix_len)] + tail)
+        else:
+            prompt = [int(x) for x in rng.randint(1, vocab, n)]
         spec = dict(
-            prompt=[int(x) for x in rng.randint(1, vocab, n)],
+            prompt=prompt,
             max_new_tokens=int(rng.choice(max_new_choices)),
             temperature=temperature,
             seed=int(rng.randint(0, 2 ** 31 - 1)),
@@ -209,9 +231,29 @@ def _report(results, makespan, router, rejected, autoscaler,
                for r in done
                if r.latency_s is not None and r.ttft_s is not None]
     goodput = sum(len(r.tokens) for r in done)
+    # prefix-cache rollup (ISSUE 8): reuse counters straight from the
+    # engines' host-side stats — deterministic, so shared-prefix and
+    # multi-turn runs show their reuse in the byte-identical report
+    prompt_tokens = sum(len(r.prompt) for r in results.values())
+    saved = blocks = hits = evictions = 0
+    for e in router.engines:
+        s = e.stats
+        hits += s.get("prefix_hits", 0)
+        saved += s.get("prefix_tokens_saved", 0)
+        blocks += s.get("prefix_blocks_reused", 0)
+        evictions += s.get("pool_evictions", 0)
     report = {
         "requests": len(results) + rejected,
         "rejected": rejected,
+        "prefix": {
+            "hits": hits,
+            "blocks_reused": blocks,
+            "prefill_tokens_saved": saved,
+            "prompt_tokens": prompt_tokens,
+            "saved_frac": (round(saved / prompt_tokens, 4)
+                           if prompt_tokens else 0.0),
+            "pool_evictions": evictions,
+        },
         "by_status": dict(sorted(by_status.items())),
         "makespan_s": round(makespan, 6),
         "step_dt_s": step_dt,
@@ -238,6 +280,7 @@ def _report(results, makespan, router, rejected, autoscaler,
 
 def build_fleet(engines: int = 1, *, slots: int = 4,
                 prefill_buckets=(8, 16, 32), max_len: int = 96,
+                block_size: int = 16,
                 max_queue: Optional[int] = None,
                 overload_policy: str = "reject",
                 clock: Optional[Dict[str, float]] = None,
@@ -261,6 +304,7 @@ def build_fleet(engines: int = 1, *, slots: int = 4,
     def factory():
         return InferenceEngine(model, slots=slots,
                                prefill_buckets=prefill_buckets,
+                               block_size=block_size,
                                max_queue=max_queue,
                                overload_policy=overload_policy,
                                clock=lambda: clk["t"])
@@ -290,6 +334,15 @@ def main(argv=None) -> int:
     ap.add_argument("--sessions", type=int, default=0,
                     help="multi-turn sessions (3 turns each)")
     ap.add_argument("--turns", type=int, default=3)
+    ap.add_argument("--shared-prefix", type=int, default=0,
+                    help="shared-prompt workload (ISSUE 8): prepend a "
+                         "common prefix of this many tokens to "
+                         "--shared-frac of the requests; the report's "
+                         "prefix section shows the prefill amortized "
+                         "away by the paged radix cache")
+    ap.add_argument("--shared-frac", type=float, default=0.9)
+    ap.add_argument("--block-size", type=int, default=16,
+                    help="KV block size (engine constructor knob)")
     ap.add_argument("--deadline-frac", type=float, default=0.0)
     ap.add_argument("--deadline", type=float, default=30.0)
     ap.add_argument("--step-dt", type=float, default=0.25,
@@ -316,10 +369,25 @@ def main(argv=None) -> int:
                        deadline_frac=args.deadline_frac,
                        deadline_s=args.deadline,
                        sessions=args.sessions,
-                       session_turns=args.turns)
+                       session_turns=args.turns,
+                       shared_prefix_len=args.shared_prefix,
+                       shared_frac=args.shared_frac)
+    # shared-prefix prompts are prefix + tail long: grow the bucket
+    # ladder (and keep max_len a block multiple) so the COLD first
+    # request of each prefix still fits one prefill bucket
+    buckets = (8, 16, 32)
+    max_len = 96
+    if args.shared_prefix:
+        need = args.shared_prefix + 8
+        while max(buckets) < need:
+            buckets = buckets + (2 * max(buckets),)
+        max_len = max(max_len, max(buckets) + 32)
+        max_len += (-max_len) % args.block_size
     router, asc, clk = build_fleet(
         args.engines, slots=args.slots, max_queue=args.max_queue,
         overload_policy=args.overload_policy,
+        prefill_buckets=buckets, max_len=max_len,
+        block_size=args.block_size,
         autoscale=args.autoscale,
         target_p99_s=args.target_p99, max_engines=args.max_engines)
     report = replay(router, trace, clock=clk, step_dt=args.step_dt,

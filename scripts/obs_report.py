@@ -84,6 +84,9 @@ def summarize(events: List[dict]) -> Dict[str, object]:
             "rejected": by_kind.get("request_rejected", 0),
         }
         out["slo"] = _slo_section(term)
+    prefix = _prefix_section(events)
+    if prefix:
+        out["prefix"] = prefix
     faults = [e for e in events if e.get("kind") == "fault_injected"]
     if faults:
         out["faults"] = [f'{e["fault"]}@{e["step"]}' for e in faults]
@@ -165,6 +168,56 @@ def _slo_section(term: List[dict]) -> dict:
     }
 
 
+def _prefix_section(events: List[dict]) -> Optional[dict]:
+    """Prefix-cache digest (ISSUE 8): hit rate / tokens and bytes
+    saved / pool occupancy, from the serving_prefix_* counters and the
+    serving_kv_pool_blocks_in_use gauge of the last embedded
+    metrics_snapshot, cross-checked against the raw prefix_hit /
+    prefix_evict events (which carry per-hit matched token counts even
+    when no snapshot was logged)."""
+    hits_ev = [e for e in events if e.get("kind") == "prefix_hit"]
+    evict_ev = [e for e in events if e.get("kind") == "prefix_evict"]
+    snaps = [e for e in events if e.get("kind") == "metrics_snapshot"]
+    out: dict = {}
+    if hits_ev:
+        out["hits"] = len(hits_ev)
+        out["tokens_saved"] = sum(e.get("matched_tokens", 0)
+                                  for e in hits_ev)
+        out["blocks_reused"] = sum(e.get("blocks", 0) for e in hits_ev)
+    if evict_ev:
+        out["blocks_evicted"] = sum(e.get("blocks", 0)
+                                    for e in evict_ev)
+    if snaps:
+        metrics = snaps[-1]["snapshot"].get("metrics", {})
+
+        def total(name):
+            fam = metrics.get(name)
+            if fam is None:
+                return None
+            return sum(s["value"] for s in fam["series"])
+
+        hits = total("serving_prefix_hits_total")
+        prefills = total("serving_prefill_calls_total")
+        if hits is not None:
+            out.setdefault("hits", hits)
+            out["hit_rate"] = (round(hits / prefills, 4)
+                               if prefills else None)
+        for key, name in (
+                ("tokens_saved", "serving_prefix_tokens_saved_total"),
+                ("bytes_saved", "serving_prefix_bytes_saved_total"),
+                ("blocks_reused",
+                 "serving_prefix_blocks_reused_total")):
+            v = total(name)
+            if v is not None:
+                out.setdefault(key, v)
+        occ = metrics.get("serving_kv_pool_blocks_in_use")
+        if occ is not None:
+            out["pool_blocks_in_use"] = {
+                s["labels"].get("engine", "?"): s["value"]
+                for s in occ["series"]}
+    return out or None
+
+
 def _digest_snapshot(snapshot: dict) -> dict:
     """Counters/gauges verbatim; histograms → count/sum/p50/p95/p99."""
     out = {}
@@ -237,6 +290,15 @@ def render(events: List[dict], tail: int = 15) -> str:
             [("fleet", fmt_slo(s["slo"]["fleet"]))]
             + [(eng, fmt_slo(d))
                for eng, d in s["slo"]["per_engine"].items()]))
+    if "prefix" in s:
+        p = s["prefix"]
+        lines.append("\nprefix cache:")
+        rows = [(k, v) for k, v in p.items()
+                if k != "pool_blocks_in_use"]
+        if "pool_blocks_in_use" in p:
+            rows += [(f"pool in use [{eng}]", v)
+                     for eng, v in p["pool_blocks_in_use"].items()]
+        lines.append(_fmt_table(rows))
     if "faults" in s:
         lines.append("\ninjected faults: " + ", ".join(s["faults"]))
     if "checkpoints" in s:

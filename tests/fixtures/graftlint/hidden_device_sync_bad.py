@@ -14,3 +14,23 @@ def decode_step(logits, cache):
 
 def observe_latency(registry, value):
     registry.observe(float(np.asarray(value)))  # BAD
+
+
+# ISSUE 8: the paged-cache lookup/insert/evict/alloc paths are hot —
+# block-table surgery runs between every decode step
+def lookup_prefix(tree, tokens):
+    return tree.walk(np.asarray(tokens))  # BAD
+
+
+def evict_lru_block(pool, stamp_leaf):
+    return stamp_leaf.item()  # BAD
+
+
+def alloc_blocks(pool, n, stats):
+    jax.device_get(stats)  # BAD
+    return pool[:n]
+
+
+def insert_chain(tree, blocks):
+    blocks.block_until_ready()  # BAD
+    return tree

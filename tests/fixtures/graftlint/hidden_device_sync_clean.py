@@ -19,3 +19,26 @@ def dispatch_and_fetch(step_fn, operands):
     nxt = step_fn(*operands)
     # the one deliberate fence, justified + suppressed:
     return np.asarray(nxt)  # graftlint: disable=hidden-device-sync
+
+
+# ISSUE 8 paged-cache paths: pure host bookkeeping is fine
+def lookup_prefix(tree, tokens, block_size):
+    # radix walk over python ints/dicts — no device work
+    out = []
+    for i in range(len(tokens) // block_size):
+        node = tree.get(tuple(tokens[i * block_size:(i + 1)
+                                     * block_size]))
+        if node is None:
+            break
+        out.append(node)
+    return out
+
+
+def evict_lru_leaf(cached):
+    # min over logical-clock stamps: deterministic, host-only
+    return min(cached, key=lambda b: b[1])[0] if cached else None
+
+
+def alloc_blocks(free_list, n):
+    return [free_list.pop() for _ in range(n)] \
+        if len(free_list) >= n else None

@@ -54,8 +54,9 @@ def test_drill_leg(tmp_path, leg):
 
 @pytest.mark.parametrize("leg", ["serve_poison", "serve_overload",
                                  "serve_deadline", "serve_retry",
-                                 "serve_watchdog", "fleet_failover",
-                                 "fleet_drain", "fleet_autoscale"])
+                                 "serve_watchdog", "serve_prefix",
+                                 "fleet_failover", "fleet_drain",
+                                 "fleet_autoscale"])
 def test_serving_drill_leg(tmp_path, leg):
     """ISSUE 4 + ISSUE 7: the serving-plane reliability drills
     (poisoned co-batch, overload shed, deadline expiry,

@@ -192,10 +192,14 @@ class TestChunkedSoftmaxCECriterion:
         assert any(sh[-1] == v and len(sh) >= 3 and sh[-2] == 16
                    for sh in shapes), shapes
 
+    @pytest.mark.slow
     def test_distri_optimizer_mesh_fused(self):
         """The fused criterion also drives the DP/ZeRO-1 mesh path
         (DistriOptimizer): loss finite and falling over 2 epochs on the
-        8-device CPU mesh."""
+        8-device CPU mesh. Tier-2: fused==unfused is pinned by
+        test_fused_matches_unfused_through_model and the mesh step by
+        test_distributed — this 11 s integration rerun keeps tier-1
+        margin (ISSUE 8 budget satellite)."""
         from bigdl_tpu.dataset import DataSet
         from bigdl_tpu.dataset.text import synthetic_next_token
         from bigdl_tpu.optim import Adam, Loss, Optimizer, Trigger

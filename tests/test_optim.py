@@ -42,7 +42,11 @@ class TestMethodsConverge:
         assert converges(SGD(learningrate=0.05, momentum=0.9, dampening=0.0,
                              nesterov=True))
 
+    @pytest.mark.slow
     def test_adam(self):
+        # 20+ s toy-convergence run; Adam's update math is pinned
+        # exactly by TestSGDvsTorch::test_adam_trajectory_matches_torch
+        # (per-step oracle) — tier-2 keeps the redundant slow check
         assert converges(Adam(learningrate=0.1))
 
     def test_adagrad(self):

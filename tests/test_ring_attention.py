@@ -36,7 +36,12 @@ def test_matches_full_attention(mesh, mode, causal):
                                atol=2e-5, rtol=2e-5)
 
 
-@pytest.mark.parametrize("mode", ["ring", "ulysses", "zigzag"])
+@pytest.mark.parametrize("mode", [
+    "ring", "ulysses",
+    # zigzag grads also ride the end-to-end step-parity check in
+    # test_tensor_parallel[zigzag] every tier-1 run — this focused
+    # 16 s oracle is tier-2 (ISSUE 8 budget satellite)
+    pytest.param("zigzag", marks=pytest.mark.slow)])
 def test_grads_match_full_attention(mesh, mode):
     q, k, v = _qkv(jax.random.PRNGKey(1), s=32)
     fn = make_ring_attention(mesh, causal=True, mode=mode)

@@ -234,8 +234,8 @@ class TestDegradation:
         eng = _engine(step_retries=3, retry_backoff_s=0.0)
         eng.submit(Request(prompt=[1, 2, 3], max_new_tokens=6, seed=1))
         eng.step()                           # healthy step first
-        for leaf in jax.tree_util.tree_leaves(eng.cache):
-            leaf.delete()                    # model the donated cache
+        for leaf in jax.tree_util.tree_leaves(eng.pool):
+            leaf.delete()                    # model the donated pool
         faults.set_plan(faults.FaultPlan("serve_err@1"))
         try:
             out = eng.step()

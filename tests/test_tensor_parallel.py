@@ -39,7 +39,12 @@ def _single_device_step(params, slots, toks, tgts, method, lr):
     return new_p, new_s, loss
 
 
-@pytest.mark.parametrize("sp_mode", ["ring", "zigzag"])
+@pytest.mark.parametrize("sp_mode", [
+    # ring-mode gradients keep their focused tier-1 oracle in
+    # test_ring_attention[ring]; this 10 s end-to-end variant is
+    # tier-2 — zigzag (the mode with no other step-level coverage)
+    # stays tier-1 (ISSUE 8 budget satellite)
+    pytest.param("ring", marks=pytest.mark.slow), "zigzag"])
 def test_dp_tp_sp_step_matches_single_device(sp_mode):
     """dp x tp x sp step == single-device oracle at loss AND parameter
     level; zigzag (balanced causal ring + permuted feed) must agree
