@@ -12,7 +12,15 @@ distinguish from a real regression.
 The scope covers the whole fleet plane (ISSUE 7): serving/router.py
 and serving/autoscaler.py via the serving/ prefix, plus the loadgen
 traffic harness — its two-runs-identical-JSON acceptance dies the
-moment a wall-clock read or global RNG draw sneaks in.
+moment a wall-clock read or global RNG draw sneaks in. The ISSUE-9
+elastic-training legs (preempt_resume / ckpt_async_torn / torn_shard
+/ worldsize_resume) are covered by the scripts/fault_drill.py entry:
+their kill/torn-save steps must come from a FaultPlan schedule
+("preempt@5"), never an unseeded draw — the fixtures pin both sides.
+scripts/multihost_smoke.py stays OUT of scope deliberately: its
+launcher polls real subprocesses on the wall clock (kill timing), and
+its determinism claim is about the sha256 of the TRAINED PARAMETERS
+across runs, not about the polling timeline.
 
 Allowed: *references* to clock functions (e.g. the
 `clock: Callable = time.monotonic` default — that IS the injection

@@ -18,6 +18,13 @@ fine; these are the wired ones):
                         throughput, and (guard armed) gnorm/guard
     anomaly             guard observation: step, action, gnorm
     checkpoint_save / checkpoint_load / checkpoint_corrupt_skipped
+                        checkpoint_save carries async/duration_s/
+                        nshards (+ shard on per-unit records of a
+                        sharded save — the whole-checkpoint publish
+                        record is the one WITHOUT a shard field);
+                        checkpoint_load carries sharded/nshards for
+                        sharded dirs (ISSUE 9; obs_report's checkpoint
+                        section digests these)
     fault_injected      every utils/faults shot that fires: fault, step
     request_submit / request_terminal   serving lifecycle endpoints
     engine_degraded     watchdog trip / retry exhaustion

@@ -25,6 +25,20 @@ wire as bf16 via all_to_all and are summed locally in f32 — the exact
 compress-on-wire / f32-accumulate split of the reference's
 putGradients/aggregateGradientPartition, at half the wire cost and with
 accumulation error independent of the axis size.
+
+ZeRO-2 (`zero=2`; ISSUE 9, arXiv 2004.13336 cross-replica weight-update
+sharding): the master fp32 flat weight vector ALSO lives sharded on the
+data axis — each device persists only its (shard_size,) slice between
+steps, and the step opens with one all_gather to rebuild the full
+vector for the forward/backward. The collective volume per step is
+identical to ZeRO-1 (one all-gather either way: ZeRO-1 gathers the
+updated shards at the END of step k, ZeRO-2 gathers the same bytes at
+the START of step k+1), but per-device weight residency drops from
+`padded` to `padded / n` floats. Because `all_gather` of the disjoint
+slices reconstructs the exact concatenation, the ZeRO-2 step is
+BIT-IDENTICAL to the ZeRO-1 step in fp32 (tests/test_zero2.py pins
+this; the zero2 dryrun leg in __graft_entry__.py asserts it on the
+8-device virtual mesh).
 """
 
 from __future__ import annotations
@@ -181,6 +195,7 @@ def make_dp_train_step(
     clip_norm: Optional[float] = None,
     precision=None,
     health: bool = False,
+    zero: int = 1,
 ) -> Callable:
     """Build the jitted SPMD train step.
 
@@ -197,17 +212,29 @@ def make_dp_train_step(
     policy. Costs two scalar collectives; `health=False` builds exactly
     the historical step.
 
-    Shardings: flat_w replicated; slots sharded on `axis` (ZeRO-1);
-    mod_state replicated; batch sharded on `axis`. `precision` is a
-    utils.precision.Policy for bf16-compute mixed precision (master
-    weights stay fp32 in flat_w).
+    Shardings: slots sharded on `axis`; mod_state replicated; batch
+    sharded on `axis`. `zero=1` keeps flat_w replicated (historical
+    ZeRO-1 step); `zero=2` shards flat_w on `axis` too — the step then
+    opens with an all_gather of the weight shards and returns the
+    updated SHARDED vector (see the module docstring: same collective
+    volume, 1/n weight residency, bit-identical fp32 results).
+    `precision` is a utils.precision.Policy for bf16-compute mixed
+    precision (master weights stay fp32 in flat_w).
     """
+    if zero not in (1, 2):
+        raise ValueError(f"zero must be 1 or 2, got {zero!r}")
     other_axes = [a for a in mesh.axis_names if a != axis]
     scattered_grads = _make_scattered_grads(model, criterion, spec, axis,
                                             grad_dtype, precision)
 
     def body(flat_w, slots, mod_state, bx, by, lr, stepno, rng,
              max_gnorm=None):
+        if zero == 2:
+            # flat_w arrives as this device's (shard_size,) slice;
+            # all_gather of the disjoint slices rebuilds the exact full
+            # vector the ZeRO-1 step would have held replicated
+            w_my = flat_w
+            flat_w = lax.all_gather(w_my, axis, axis=0, tiled=True)
         g_my, new_state, loss = scattered_grads(flat_w, mod_state, bx, by,
                                                 rng)
         mean_loss = lax.pmean(loss, axis)
@@ -221,22 +248,28 @@ def make_dp_train_step(
             ok = health_ok(mean_loss, gnorm, max_gnorm)
         g_my = _clip_shard(g_my, clip_const, clip_norm, axis)
 
-        my_index = lax.axis_index(axis)
-        w_my = lax.dynamic_slice(flat_w, (my_index * spec.shard_size,),
-                                 (spec.shard_size,))
+        if zero == 1:
+            my_index = lax.axis_index(axis)
+            w_my = lax.dynamic_slice(flat_w, (my_index * spec.shard_size,),
+                                     (spec.shard_size,))
         new_w_my, new_slots = method.update(g_my, w_my, slots, lr, stepno)
-        new_flat_w = lax.all_gather(new_w_my, axis, axis=0, tiled=True)
+        if zero == 2:
+            new_flat_w, prev_w = new_w_my, w_my  # stays sharded
+        else:
+            new_flat_w = lax.all_gather(new_w_my, axis, axis=0, tiled=True)
+            prev_w = flat_w
 
         if health:
-            new_flat_w = _select_update(ok, new_flat_w, flat_w)
+            new_flat_w = _select_update(ok, new_flat_w, prev_w)
             new_slots = _select_update(ok, new_slots, slots)
             new_state = _select_update(ok, new_state, mod_state)
             return new_flat_w, new_slots, new_state, mean_loss, ok, gnorm
         return new_flat_w, new_slots, new_state, mean_loss
 
     batch_spec = P(axis)
-    in_specs = (P(), P(axis), P(), batch_spec, batch_spec, P(), P(), P())
-    out_specs = (P(), P(axis), P(), P())
+    w_spec = P(axis) if zero == 2 else P()
+    in_specs = (w_spec, P(axis), P(), batch_spec, batch_spec, P(), P(), P())
+    out_specs = (w_spec, P(axis), P(), P())
     if health:
         in_specs += (P(),)
         out_specs += (P(), P())
@@ -261,6 +294,7 @@ def make_dp_accum_steps(
     clip_norm: Optional[float] = None,
     precision=None,
     health: bool = False,
+    zero: int = 1,
 ) -> Tuple[Callable, Callable]:
     """Gradient accumulation on the mesh: the accumulator lives SHARDED
     (shard_size,) per device — micro-steps reduce-scatter then add, so
@@ -283,12 +317,21 @@ def make_dp_accum_steps(
     the guard screens each micro-batch before it can poison the cycle —
     the host skips its micro_n increment, extending the cycle by one
     batch. apply_fn is unchanged: it only ever sees screened gradients.
+
+    `zero=2`: flat_w is sharded on `axis` in BOTH functions — micro_fn
+    all_gathers the weight shards for the forward/backward (the
+    ZeRO-2 residency/volume trade, see make_dp_train_step), apply_fn
+    updates the local shard directly and returns it sharded.
     """
+    if zero not in (1, 2):
+        raise ValueError(f"zero must be 1 or 2, got {zero!r}")
     other_axes = [a for a in mesh.axis_names if a != axis]
     scattered_grads = _make_scattered_grads(model, criterion, spec, axis,
                                             grad_dtype, precision)
 
     def micro_body(flat_w, g_acc, mod_state, bx, by, rng, max_gnorm=None):
+        if zero == 2:
+            flat_w = lax.all_gather(flat_w, axis, axis=0, tiled=True)
         g_my, new_state, loss = scattered_grads(flat_w, mod_state, bx, by,
                                                 rng)
         mean_loss = lax.pmean(loss, axis)
@@ -308,15 +351,22 @@ def make_dp_accum_steps(
 
     def apply_body(flat_w, slots, g_acc, lr, stepno, n_micro):
         g_my = _clip_shard(g_acc / n_micro, clip_const, clip_norm, axis)
-        my_index = lax.axis_index(axis)
-        w_my = lax.dynamic_slice(flat_w, (my_index * spec.shard_size,),
-                                 (spec.shard_size,))
+        if zero == 2:
+            w_my = flat_w
+        else:
+            my_index = lax.axis_index(axis)
+            w_my = lax.dynamic_slice(flat_w, (my_index * spec.shard_size,),
+                                     (spec.shard_size,))
         new_w_my, new_slots = method.update(g_my, w_my, slots, lr, stepno)
-        new_flat_w = lax.all_gather(new_w_my, axis, axis=0, tiled=True)
+        if zero == 2:
+            new_flat_w = new_w_my
+        else:
+            new_flat_w = lax.all_gather(new_w_my, axis, axis=0, tiled=True)
         return new_flat_w, new_slots, jnp.zeros_like(g_acc)
 
     batch_spec = P(axis)
-    micro_in = (P(), P(axis), P(), batch_spec, batch_spec, P())
+    w_spec = P(axis) if zero == 2 else P()
+    micro_in = (w_spec, P(axis), P(), batch_spec, batch_spec, P())
     micro_out = (P(axis), P(), P())
     if health:
         micro_in += (P(),)
@@ -329,8 +379,8 @@ def make_dp_accum_steps(
     ), donate_argnums=(1,))
     apply_fn = jax.jit(shard_map(
         apply_body, mesh=mesh,
-        in_specs=(P(), P(axis), P(axis), P(), P(), P()),
-        out_specs=(P(), P(axis), P(axis)),
+        in_specs=(w_spec, P(axis), P(axis), P(), P(), P()),
+        out_specs=(w_spec, P(axis), P(axis)),
         check_vma=False,
     ), donate_argnums=(0, 1, 2))
     return micro_fn, apply_fn

@@ -52,12 +52,16 @@ class DistriOptimizer(LocalOptimizer):
     """Mesh data-parallel optimizer (reference: optim/DistriOptimizer.scala)."""
 
     def __init__(self, opt: Optimizer, mesh: Mesh, axis: str = "data",
-                 grad_dtype: Optional[str] = "bfloat16", max_retries: int = 3):
+                 grad_dtype: Optional[str] = "bfloat16", max_retries: int = 3,
+                 zero: int = 1):
         super().__init__(opt)
+        if zero not in (1, 2):
+            raise ValueError(f"zero must be 1 or 2, got {zero!r}")
         self.mesh = mesh
         self.axis = axis
         self.grad_dtype = grad_dtype
         self.max_retries = max_retries
+        self.zero = zero
         self._gather_fn = None
 
     # ------------------------------------------------------------- helpers
@@ -95,17 +99,55 @@ class DistriOptimizer(LocalOptimizer):
         return jax.device_get(self._gather_fn(tree))
 
     @staticmethod
+    def _local_shard_slices(tree, spec, mesh=None, axis="data"):
+        """{shard index: host tree of that shard's slot slices} for the
+        shards whose devices are addressable from THIS process — the
+        "each host saves only its shards" half of the async sharded
+        checkpoint (ISSUE 9). Slot leaves are global (padded,) vectors
+        sharded P(axis), so each addressable device shard IS one ZeRO
+        shard; its global offset // shard_size is the shard index.
+        (static: scripts/scaling_bench.py reuses it to feed the
+        checkpoint-overlap row the exact shard trees the real save
+        path writes)."""
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if not leaves:
+            # slot-less method (plain SGD): no sharded array to read
+            # ownership from, so derive it from the mesh — shard i
+            # belongs to the process owning the i-th device on the
+            # data axis. Without a mesh (single-process callers) every
+            # shard is this host's.
+            if mesh is None:
+                return {i: tree for i in range(spec.num_shards)}
+            me = jax.process_index()
+            axes = list(mesh.axis_names)
+            dev = np.moveaxis(np.asarray(mesh.devices),
+                              axes.index(axis), 0).reshape(
+                                  mesh.shape[axis], -1)
+            return {i: tree for i in range(spec.num_shards)
+                    if dev[i, 0].process_index == me}
+        per_shard: Dict[int, list] = {}
+        for li, leaf in enumerate(leaves):
+            for sh in leaf.addressable_shards:
+                start = sh.index[0].start or 0
+                sidx = start // spec.shard_size
+                per_shard.setdefault(
+                    sidx, [None] * len(leaves))[li] = np.asarray(sh.data)
+        return {s: jax.tree_util.tree_unflatten(treedef, lv)
+                for s, lv in sorted(per_shard.items())}
+
+    @staticmethod
     def _adapt_slots(saved_slots, optim_meta, spec):
-        """Convert checkpointed slots to this run's ZeRO-1 flat layout.
+        """Convert checkpointed slots to this run's ZeRO flat layout.
 
         Three cases (see the `optim_meta` written at save time):
         - same `padded` → use directly
-        - zero1_flat from a different mesh size → strip padding, re-pad
+        - zero{1,2}_flat from a different mesh size → strip padding,
+          re-pad (the elastic-resume reshard)
         - pytree slots from a LocalOptimizer checkpoint → flatten each
           top-level slot branch with this spec
         """
         layout = (optim_meta or {}).get("layout")
-        if layout == "zero1_flat":
+        if layout in ("zero1_flat", "zero2_flat"):
             if optim_meta["padded"] == spec.padded:
                 return saved_slots
             total = optim_meta["total"]
@@ -151,9 +193,13 @@ class DistriOptimizer(LocalOptimizer):
         variables = dict(o.model.variables)
         spec = FlatParamSpec(variables["params"], n)
         self._unflatten = jax.jit(spec.unflatten)
-        logger.info("DistriOptimizer: %d devices on axis %r, %d params "
-                    "(padded %d, %d per shard)", n, self.axis, spec.total,
-                    spec.padded, spec.shard_size)
+        logger.info("DistriOptimizer: %d devices on axis %r (ZeRO-%d), "
+                    "%d params (padded %d, %d per shard)", n, self.axis,
+                    self.zero, spec.total, spec.padded, spec.shard_size)
+
+        # ZeRO-2: the master fp32 flat weights persist SHARDED on the
+        # data axis between steps (the step all_gathers on entry)
+        w_spec = P(self.axis) if self.zero == 2 else P()
 
         guard = o.anomaly_guard
         accum = o.grad_accum
@@ -162,18 +208,21 @@ class DistriOptimizer(LocalOptimizer):
                 o.model, o.criterion, o.optim_method, self.mesh, spec,
                 axis=self.axis, grad_dtype=self.grad_dtype,
                 clip_const=o.grad_clip_const, clip_norm=o.grad_clip_norm,
-                precision=o.precision, health=guard is not None)
+                precision=o.precision, health=guard is not None,
+                zero=self.zero)
         else:
             micro_fn, apply_fn = make_dp_accum_steps(
                 o.model, o.criterion, o.optim_method, self.mesh, spec,
                 axis=self.axis, grad_dtype=self.grad_dtype,
                 clip_const=o.grad_clip_const, clip_norm=o.grad_clip_norm,
-                precision=o.precision, health=guard is not None)
+                precision=o.precision, health=guard is not None,
+                zero=self.zero)
         if o.validation_methods:
             eval_fn = make_dp_eval_step(o.model, o.validation_methods,
                                         self.mesh, self.axis)
 
-        flat_w = place_global(self.mesh, P(), spec.flatten(variables["params"]))
+        flat_w = place_global(self.mesh, w_spec,
+                              spec.flatten(variables["params"]))
         mod_state = place_global(self.mesh, P(), variables["state"])
         # slot arrays are GLOBAL (padded,) shapes, device-placed sharded on
         # the data axis — each device materializes only its (shard_size,)
@@ -246,9 +295,10 @@ class DistriOptimizer(LocalOptimizer):
             policy (the reference's reload-last-checkpoint recovery,
             SURVEY.md §5.3)."""
             nonlocal flat_w, mod_state, slots, batches
+            o.checkpoint.wait()  # surface pending async-save errors
             saved_vars, saved_slots, saved_ts, om = o.checkpoint.load(
                 with_optim_meta=True)
-            flat_w = place_global(self.mesh, P(),
+            flat_w = place_global(self.mesh, w_spec,
                                   spec.flatten(saved_vars["params"]))
             mod_state = place_global(self.mesh, P(), saved_vars["state"])
             slots = self._place_sharded_slots(
@@ -261,7 +311,7 @@ class DistriOptimizer(LocalOptimizer):
         if o._resume and o.checkpoint is not None and o.checkpoint.latest():
             saved_vars, saved_slots, saved_ts, optim_meta = o.checkpoint.load(
                 with_optim_meta=True)
-            flat_w = place_global(self.mesh, P(),
+            flat_w = place_global(self.mesh, w_spec,
                                   spec.flatten(saved_vars["params"]))
             mod_state = place_global(self.mesh, P(), saved_vars["state"])
             slots = self._place_sharded_slots(
@@ -283,6 +333,9 @@ class DistriOptimizer(LocalOptimizer):
         retries = 0
 
         while not o.end_when(train_state):
+            # outside the retry try — the retry budget must never
+            # absorb a preemption (faults.FaultPlan.maybe_preempt)
+            plan.maybe_preempt(train_state["neval"])
             try:
                 plan.maybe_raise("step", train_state["neval"])
                 with Timer(self.metrics, "data_fetch_s"):
@@ -427,29 +480,73 @@ class DistriOptimizer(LocalOptimizer):
 
             if (o.checkpoint is not None and o.checkpoint_trigger is not None
                     and o.checkpoint_trigger(train_state)):
-                saved_variables = {
-                    "params": jax.device_get(self._unflatten(flat_w)),
-                    "state": jax.device_get(mod_state),
-                }
+                # zero2 keeps flat_w sharded across processes: gather
+                # before unflattening the model tree for the save.
+                # The gather is a COLLECTIVE (every host participates)
+                # but the full-model host tree is materialized only
+                # where it will be written — secondaries' sharded
+                # saves ignore model_variables, so they must not pay a
+                # whole-model device->host fetch on the step path.
+                # Sharded zero1 saves need the host copy too: the
+                # primary-only _unflatten below must never be handed a
+                # device-global array (a jit entered by one controller
+                # of a multi-process run is a launch mismatch)
+                flat_for_save = self._gather(flat_w) \
+                    if (nproc > 1 and (self.zero == 2
+                                       or o.checkpoint.sharded)) \
+                    else flat_w
+                primary = jax.process_index() == 0
+                if primary or not o.checkpoint.sharded:
+                    saved_variables = {
+                        "params": jax.device_get(
+                            self._unflatten(flat_for_save)),
+                        "state": jax.device_get(mod_state),
+                    }
+                else:
+                    saved_variables = None
                 accum_state = None
                 if micro_n:  # mid-cycle: persist the partial accumulator
                     accum_state = {"g_acc": self._gather(g_acc),
                                    "micro_n": micro_n}
+                train_meta = {k: train_state[k] for k in
+                              ("epoch", "neval", "nupdates", "records")}
+                optim_meta = {"layout": f"zero{self.zero}_flat",
+                              "num_shards": n,
+                              "total": spec.total,
+                              "padded": spec.padded}
                 with Timer(self.metrics, "checkpoint_s"):
-                    path = o.checkpoint.save(
-                        train_state["neval"], saved_variables,
-                        self._gather(slots),
-                        {k: train_state[k] for k in
-                         ("epoch", "neval", "nupdates", "records")},
-                        optim_meta={"layout": "zero1_flat",
-                                    "num_shards": n,
-                                    "total": spec.total,
-                                    "padded": spec.padded},
-                        accum_state=accum_state)
+                    # with async_save this times only the host snapshot
+                    # + enqueue; the disk write overlaps the next steps
+                    # (scaling_bench's checkpoint-overlap row measures
+                    # the on-vs-off per-step cost)
+                    if o.checkpoint.sharded:
+                        # each host hands over exactly the shard slices
+                        # its devices own — no slot gather, no
+                        # full-state replica on any single host
+                        path = o.checkpoint.save_sharded(
+                            train_state["neval"], saved_variables,
+                            self._local_shard_slices(
+                                slots, spec, mesh=self.mesh,
+                                axis=self.axis),
+                            nshards=n, train_state=train_meta,
+                            optim_meta=optim_meta,
+                            accum_state=accum_state)
+                    else:
+                        path = o.checkpoint.save(
+                            train_state["neval"], saved_variables,
+                            self._gather(slots),
+                            train_meta,
+                            optim_meta=optim_meta,
+                            accum_state=accum_state)
                 if nproc > 1:
                     # barrier: no host may run ahead (and potentially
-                    # recover from this checkpoint) until host 0 has
-                    # finished writing it
+                    # recover from this checkpoint) until the write is
+                    # complete everywhere. Async saves drain first —
+                    # cross-host overlap would need a coordination
+                    # service; the async win is measured per-host
+                    # (single-process) where steps genuinely never
+                    # stall on I/O
+                    o.checkpoint.wait()
                     from jax.experimental import multihost_utils
 
                     multihost_utils.sync_global_devices(
@@ -469,8 +566,14 @@ class DistriOptimizer(LocalOptimizer):
                 jnp.asarray(micro_n, jnp.float32))
             micro_n = 0
 
+        if o.checkpoint is not None:
+            # drain the background writer: a failed async save (incl.
+            # an injected ckpt_async_torn kill) must fail the run
+            o.checkpoint.wait()
+        flat_final = self._gather(flat_w) \
+            if (self.zero == 2 and jax.process_count() > 1) else flat_w
         o.model.variables = {
-            "params": jax.device_get(self._unflatten(flat_w)),
+            "params": jax.device_get(self._unflatten(flat_final)),
             "state": jax.device_get(mod_state),
         }
         return o.model

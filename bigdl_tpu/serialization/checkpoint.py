@@ -24,9 +24,37 @@ never take down recovery while an older valid checkpoint exists.
 Checkpoints from the pre-checksum format (no "checksums" key) load
 with structural checks only.
 
-Multi-host: each host saves only under `host{process_index}` when the
-tree is process-local; for fully-replicated trees host 0 writes
-(`save_pytree(..., only_host0=True)`).
+Sharded checkpoints (ISSUE 9): a ZeRO-sharded run saves the flat
+optimizer-state vectors as PER-SHARD save units
+(`optim-shard<i>of<n>.{npz,json}`), each with its own integrity
+manifest, plus a checkpoint-level `MANIFEST.json` written LAST. All
+units build up in a `<dir>.inprogress` staging dir (invisible to
+`latest()` by name), the MANIFEST lands in staging via atomic
+tmp+rename, and only then does the staging dir swap over the final
+`checkpoint-N` name — so a crash/kill at ANY point mid-save (including
+a kill of the background writer thread) strands only the staging dir
+and never an existing complete checkpoint, and `load()` falls back to
+the newest checkpoint that does verify. A published shard whose bytes were damaged after the fact is
+caught by the per-shard crc32s and falls back the same way. On load
+the shard slices are re-concatenated into the full padded flat vector,
+so a checkpoint written at one world size reshards onto any other
+(DistriOptimizer._adapt_slots strips the old padding and re-pads) —
+the elastic-resume path.
+
+Async saves (`Checkpoint(path, async_save=True)`): `save`/
+`save_sharded` snapshot every tree to host numpy up front and hand
+the pure-I/O write to one background thread — training steps never
+stall on disk. The snapshot is double-buffered: at most two host
+copies exist (the one being written, the one just taken); a new save
+first drains the previous write, which also makes writer errors
+(including injected `ckpt_async_torn` kills) surface at the next
+`save`/`wait()` in deterministic order.
+
+Multi-host: fully-replicated save units are written by host 0; in a
+sharded save every host writes exactly the shard units it owns
+(`save_sharded(shards={index: tree})`) into the shared checkpoint
+directory, and host 0 publishes the MANIFEST only after every shard's
+unit manifest is on disk.
 """
 
 from __future__ import annotations
@@ -34,7 +62,9 @@ from __future__ import annotations
 import json
 import logging
 import os
+import queue
 import re
+import threading
 import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
@@ -126,11 +156,16 @@ def save_pytree(directory: str, name: str, tree: Any,
     npz_path = os.path.join(directory, f"{name}.npz")
     json_path = os.path.join(directory, f"{name}.json")
     np.savez(npz_path, **leaves)
-    with open(json_path, "w") as f:
+    # the .json is the unit's completion marker (sharded saves _await
+    # its existence across hosts before publishing), so it must appear
+    # atomically — a bare open('w') would be visible while still empty
+    tmp_path = json_path + ".tmp"
+    with open(tmp_path, "w") as f:
         json.dump({"structure": structure, "metadata": metadata or {},
                    "format": 2,
                    "checksums": {k: _crc(v) for k, v in leaves.items()},
                    "saved_at": time.time()}, f)
+    os.rename(tmp_path, json_path)
     return os.path.join(directory, name)
 
 
@@ -198,24 +233,147 @@ def verify_pytree(directory: str, name: str) -> None:
     load_pytree(directory, name, as_jax=False, verify=True)
 
 
+def shard_unit_name(index: int, nshards: int) -> str:
+    """Save-unit name of shard `index` of `nshards`
+    (`optim-shard003of008`)."""
+    return f"optim-shard{index:03d}of{nshards:03d}"
+
+
+class _AsyncSaver:
+    """One daemon writer thread, one write in flight: `submit` first
+    DRAINS the previous write (at checkpoint cadence k steps and write
+    time < k·step that drain is ~free — the I/O overlapped the
+    intervening steps), then hands over the new snapshot. Exactly two
+    host snapshots can be alive (the one just written, the one just
+    taken) — the double buffer. Draining at submit also makes error
+    surfacing DETERMINISTIC: a failed background save (including an
+    injected `ckpt_async_torn` kill) is re-raised at the NEXT
+    `submit()`/`wait()`, never reordered behind a later write — the
+    drill legs depend on that ordering being bit-reproducible."""
+
+    def __init__(self):
+        self._queue: "queue.Queue" = queue.Queue(maxsize=1)
+        self._errors: List[BaseException] = []
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    def _loop(self):
+        while True:
+            fn = self._queue.get()
+            try:
+                fn()
+            except BaseException as e:  # surfaced at submit()/wait()
+                with self._lock:
+                    self._errors.append(e)
+            finally:
+                # drop the closure BEFORE signalling completion: it
+                # holds the full host snapshot (model + optimizer
+                # state), which must not stay pinned while the thread
+                # parks on the next get()
+                fn = None
+                self._queue.task_done()
+
+    def raise_pending(self) -> None:
+        with self._lock:
+            if self._errors:
+                raise self._errors.pop(0)
+
+    def submit(self, fn) -> None:
+        self._queue.join()  # drain the in-flight write (see docstring)
+        self.raise_pending()
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="bigdl-ckpt-writer")
+            self._thread.start()
+        self._queue.put(fn)
+
+    def wait(self) -> None:
+        self._queue.join()
+        self.raise_pending()
+
+
 class Checkpoint:
     """Numbered training checkpoints with latest-discovery
-    (reference: DistriOptimizer's checkpointPath + getLatestFile)."""
+    (reference: DistriOptimizer's checkpointPath + getLatestFile).
+
+    `sharded` marks the intent to save per-shard units (the training
+    loops consult it to route through `save_sharded`); `async_save`
+    moves the disk writes of BOTH formats onto a background thread
+    (the caller-visible snapshot happens synchronously, the I/O does
+    not). Either way `load()` reads both formats transparently."""
 
     MODEL = "model"
     OPTIM = "optim"
     ACCUM = "accum"
     MARKER = "COMPLETE"
+    MANIFEST = "MANIFEST.json"
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, sharded: bool = False,
+                 async_save: bool = False):
         self.path = path
+        self.sharded = sharded
+        self.async_save = async_save
         os.makedirs(path, exist_ok=True)
         # last directory load() actually used — keeps load_accum() on
         # the same checkpoint when load() fell back past a corrupt one
         self._last_loaded: Optional[str] = None
         # observability for drills/tests: dirs skipped as corrupt
         self.corrupt_skipped: List[str] = []
+        self._saver: Optional[_AsyncSaver] = None
 
+    # ------------------------------------------------------------- async
+    def wait(self) -> None:
+        """Block until every pending background save has landed;
+        re-raises the first stored writer error (a failed async save —
+        including an injected ckpt_async_torn kill — surfaces HERE,
+        never silently). The training loops call this at end of run
+        and before any checkpoint load."""
+        if self._saver is not None:
+            self._saver.wait()
+
+    def _dispatch(self, write_fn) -> None:
+        if self.async_save:
+            if self._saver is None:
+                self._saver = _AsyncSaver()
+            self._saver.submit(write_fn)
+        else:
+            write_fn()
+
+    @staticmethod
+    def _host_snapshot(tree):
+        """Host-numpy copy taken on the CALLER's thread, before the
+        write is queued: the live device buffers may be donated to the
+        next step the moment save() returns."""
+        import jax
+
+        if tree is None:
+            return None
+        return jax.tree_util.tree_map(np.asarray, tree)
+
+    def _observe_save(self, step: int, path: str, duration_s: float,
+                      nshards: int, mid_cycle: bool,
+                      shard: Optional[int] = None) -> None:
+        from bigdl_tpu import obs
+
+        fields = {"step": int(step), "path": path,
+                  "async": bool(self.async_save),
+                  "duration_s": round(duration_s, 6),
+                  "nshards": int(nshards)}
+        if shard is not None:
+            fields["shard"] = int(shard)
+        else:
+            fields["mid_cycle"] = mid_cycle
+            if obs.enabled():
+                obs.get_registry().histogram(
+                    "training_checkpoint_seconds",
+                    "wall seconds to write one training checkpoint "
+                    "(shard events excluded)",
+                    labelnames=("mode",),
+                ).labels(mode="async" if self.async_save else "sync") \
+                    .observe(duration_s)
+        obs.emit_event("checkpoint_save", **fields)
+
+    # -------------------------------------------------------------- save
     def save(self, step: int, model_variables: Any, optim_state: Any,
              train_state: Optional[Dict] = None,
              optim_meta: Optional[Dict] = None,
@@ -226,7 +384,6 @@ class Checkpoint:
         (reference divergence: the reference has no grad-accum at all;
         this keeps resume bit-for-bit faithful)."""
         import jax
-        import shutil
 
         d = os.path.join(self.path, f"checkpoint-{step}")
         if jax.process_index() != 0:
@@ -235,6 +392,18 @@ class Checkpoint:
             # everyone — the reference's driver-writes-checkpoint
             # layout (SURVEY.md §5.4)
             return d
+        model_h = self._host_snapshot(model_variables)
+        optim_h = self._host_snapshot(optim_state)
+        accum_h = self._host_snapshot(accum_state)
+
+        self._dispatch(lambda: self._write_full(
+            d, step, model_h, optim_h, train_state, optim_meta, accum_h))
+        return d
+
+    def _write_full(self, d: str, step: int, model_h, optim_h,
+                    train_state, optim_meta, accum_h) -> None:
+        import shutil
+
         # Atomic publish: write everything into a .inprogress staging
         # dir, then rename over the final name. A crash at ANY point
         # leaves either the previous complete checkpoint untouched or
@@ -245,12 +414,13 @@ class Checkpoint:
         from bigdl_tpu.utils import faults
 
         plan = faults.get_plan()
+        t0 = time.perf_counter()
         tmp = d + ".inprogress"
         old = d + ".old"
         for leftover in (tmp, old):
             if os.path.isdir(leftover):
                 shutil.rmtree(leftover)
-        save_pytree(tmp, self.MODEL, model_variables,
+        save_pytree(tmp, self.MODEL, model_h,
                     metadata={"train_state": train_state or {}})
         if plan.fires("ckpt_torn", step):
             # crash-mid-write model: the staging dir stays behind with
@@ -262,9 +432,9 @@ class Checkpoint:
             raise faults.FaultInjected(
                 f"injected fault ckpt_torn@{step}: save aborted "
                 f"mid-write, staging left at {tmp}")
-        save_pytree(tmp, self.OPTIM, optim_state, metadata=optim_meta)
-        if accum_state is not None:
-            save_pytree(tmp, self.ACCUM, accum_state)
+        save_pytree(tmp, self.OPTIM, optim_h, metadata=optim_meta)
+        if accum_h is not None:
+            save_pytree(tmp, self.ACCUM, accum_h)
         # completion marker still written (helps tooling; load-bearing
         # only for checkpoints from pre-rename versions of this code)
         with open(os.path.join(tmp, self.MARKER), "w") as f:
@@ -279,15 +449,140 @@ class Checkpoint:
         os.rename(tmp, d)
         if os.path.isdir(old):
             shutil.rmtree(old)
-        from bigdl_tpu import obs
-
-        obs.emit_event("checkpoint_save", step=int(step), path=d,
-                       mid_cycle=accum_state is not None)
+        self._observe_save(step, d, time.perf_counter() - t0, nshards=1,
+                           mid_cycle=accum_h is not None)
         if plan.fires("ckpt_corrupt", step):
             # bit-rot model: the publish succeeded, the bytes did not
             # survive — load() must detect this and fall back
             faults.corrupt_file(os.path.join(d, f"{self.MODEL}.npz"))
+
+    # ------------------------------------------------------ sharded save
+    def save_sharded(self, step: int, model_variables: Any,
+                     shards: Dict[int, Any], nshards: int,
+                     train_state: Optional[Dict] = None,
+                     optim_meta: Optional[Dict] = None,
+                     accum_state: Optional[Any] = None) -> str:
+        """Save a ZeRO-sharded checkpoint: per-shard optimizer-state
+        units + checkpoint-level MANIFEST published last (atomic
+        tmp+rename — the module docstring's torn-save contract).
+
+        `shards` maps shard index -> that shard's slot tree; a
+        multi-host caller passes only the shards IT owns (each host
+        writes its own units; host 0 additionally writes the model/
+        accum units and, after all shard manifests exist on the shared
+        filesystem, the MANIFEST). `model_variables` is the full
+        (gathered) model tree; `optim_meta` must carry the flat-layout
+        fields (layout/num_shards/total/padded) that make elastic
+        restore possible."""
+        import jax
+
+        d = os.path.join(self.path, f"checkpoint-{step}")
+        primary = jax.process_index() == 0
+        model_h = self._host_snapshot(model_variables) if primary else None
+        accum_h = self._host_snapshot(accum_state) if primary else None
+        shards_h = {int(i): self._host_snapshot(t)
+                    for i, t in sorted(shards.items())}
+
+        self._dispatch(lambda: self._write_sharded(
+            d, step, model_h, shards_h, int(nshards), train_state,
+            optim_meta, accum_h, primary))
         return d
+
+    @staticmethod
+    def _await(predicate, timeout_s: float = 120.0, what: str = "") -> None:
+        deadline = time.monotonic() + timeout_s
+        while not predicate():
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"sharded checkpoint coordination timed out: {what}")
+            time.sleep(0.02)
+
+    def _write_sharded(self, d: str, step: int, model_h, shards_h,
+                       nshards: int, train_state, optim_meta, accum_h,
+                       primary: bool) -> None:
+        import shutil
+
+        from bigdl_tpu.utils import faults
+
+        plan = faults.get_plan()
+        t0 = time.perf_counter()
+        staging = d + ".inprogress"
+        old = d + ".old"
+        if primary:
+            # staging-then-swap, like _write_full: all units build up
+            # in `<d>.inprogress` (its name never matches the
+            # checkpoint-N pattern, so a torn save is invisible to
+            # latest() by construction) while any previous COMPLETE
+            # checkpoint-N stays valid at `d` until the post-MANIFEST
+            # swap — a writer death at any point before the swap
+            # strands only the staging dir, never an existing good
+            # checkpoint. A leftover same-step staging from a crashed
+            # run is ADOPTED (makedirs exist_ok), not deleted: a
+            # secondary host that raced ahead of this open may already
+            # be writing its shard units into it, and deterministic
+            # replay makes a stale same-step unit bit-identical to the
+            # fresh one anyway.
+            if os.path.isdir(old):
+                shutil.rmtree(old)
+            os.makedirs(staging, exist_ok=True)
+            save_pytree(staging, self.MODEL, model_h,
+                        metadata={"train_state": train_state or {}})
+            if accum_h is not None:
+                save_pytree(staging, self.ACCUM, accum_h)
+        else:
+            # secondaries wait for the primary to open the staging dir
+            self._await(
+                lambda: os.path.isdir(staging),
+                what=f"host waiting for {staging} to open for writing")
+        for i, tree in shards_h.items():
+            u0 = time.perf_counter()
+            save_pytree(staging, shard_unit_name(i, nshards), tree,
+                        metadata={"shard": i, "nshards": nshards,
+                                  **(optim_meta or {})})
+            self._observe_save(step, d, time.perf_counter() - u0,
+                               nshards=nshards, mid_cycle=False, shard=i)
+            if plan.fires("ckpt_async_torn", step):
+                # kill-during-background-save model: the writer dies
+                # with units in staging and no published dir — latest()
+                # can never surface it, and the error surfaces at the
+                # next save()/wait() (drill ckpt_async_torn)
+                raise faults.FaultInjected(
+                    f"injected fault ckpt_async_torn@{step}: writer "
+                    f"killed mid-save, torn units left in {staging}")
+        if primary:
+            self._await(
+                lambda: all(os.path.exists(os.path.join(
+                    staging, shard_unit_name(i, nshards) + ".json"))
+                    for i in range(nshards)),
+                what=f"waiting for all {nshards} shard units in "
+                     f"{staging}")
+            tmp = os.path.join(staging, self.MANIFEST + ".tmp")
+            with open(tmp, "w") as f:
+                json.dump({"format": 3, "step": int(step),
+                           "nshards": nshards,
+                           "optim_meta": optim_meta or {},
+                           "units": [shard_unit_name(i, nshards)
+                                     for i in range(nshards)],
+                           "has_accum": accum_h is not None,
+                           "saved_at": time.time()}, f)
+            os.rename(tmp, os.path.join(staging, self.MANIFEST))
+            # THE publish: swap staging over the final name. The only
+            # window where neither dir serves step N is between the
+            # two renames (same two-rename window _write_full has).
+            if os.path.isdir(d):
+                os.rename(d, old)
+            os.rename(staging, d)
+            if os.path.isdir(old):
+                shutil.rmtree(old)
+            self._observe_save(step, d, time.perf_counter() - t0,
+                               nshards=nshards,
+                               mid_cycle=accum_h is not None)
+            if plan.fires("ckpt_corrupt", step):
+                # bit-rot one PUBLISHED shard: load() must catch the
+                # crc mismatch and fall back to the newest valid
+                # checkpoint (drill torn_shard)
+                faults.corrupt_file(os.path.join(
+                    d, shard_unit_name(nshards // 2, nshards) + ".npz"))
 
     def load_accum(self, directory: Optional[str] = None):
         """The pending accumulation cycle saved alongside a checkpoint,
@@ -311,8 +606,13 @@ class Checkpoint:
 
     def candidates(self, allow_unmarked: bool = True) -> List[str]:
         """Complete checkpoint dirs, newest step first. Completeness is
-        the cheap structural check only (marker / both manifests);
-        content integrity is verified by load()."""
+        the cheap structural check only (marker / both manifests /
+        sharded MANIFEST); content integrity is verified by load().
+        A sharded save whose background writer died mid-write leaves
+        only a `checkpoint-N.inprogress` staging dir — its name never
+        matches, so it is never a candidate (the torn-save contract;
+        the MANIFEST clause below additionally rejects a hand-copied
+        sharded dir missing its publish marker)."""
         if not os.path.isdir(self.path):
             return []
         found = []
@@ -321,10 +621,13 @@ class Checkpoint:
             if not m:
                 continue
             d = os.path.join(self.path, entry)
-            complete = os.path.exists(os.path.join(d, self.MARKER)) or (
-                allow_unmarked
-                and os.path.exists(os.path.join(d, f"{self.OPTIM}.json"))
-                and os.path.exists(os.path.join(d, f"{self.MODEL}.json")))
+            complete = (os.path.exists(os.path.join(d, self.MARKER))
+                        or os.path.exists(os.path.join(d, self.MANIFEST))
+                        or (allow_unmarked
+                            and os.path.exists(
+                                os.path.join(d, f"{self.OPTIM}.json"))
+                            and os.path.exists(
+                                os.path.join(d, f"{self.MODEL}.json"))))
             if complete:
                 found.append((int(m.group(1)), d))
         return [d for _, d in sorted(found, reverse=True)]
@@ -344,6 +647,8 @@ class Checkpoint:
         return cands[0] if cands else None
 
     def _load_dir(self, d: str, with_optim_meta: bool):
+        if os.path.exists(os.path.join(d, self.MANIFEST)):
+            return self._load_sharded_dir(d, with_optim_meta)
         model_variables, meta = load_pytree(d, self.MODEL)
         optim_state, optim_meta = load_pytree(d, self.OPTIM)
         self._last_loaded = d
@@ -353,6 +658,55 @@ class Checkpoint:
         if with_optim_meta:
             return (model_variables, optim_state, meta.get("train_state", {}),
                     optim_meta)
+        return model_variables, optim_state, meta.get("train_state", {})
+
+    def _load_sharded_dir(self, d: str, with_optim_meta: bool):
+        """Load a sharded checkpoint: verify + concatenate the per-
+        shard flat slot slices back into the full (padded,) vectors.
+        The result carries the SAVE-time layout (optim_meta from the
+        MANIFEST) — a different current world size reshards via
+        DistriOptimizer._adapt_slots (elastic resume). Any damaged or
+        missing shard raises (CheckpointCorruptError /
+        FileNotFoundError), which `load()` turns into newest-valid
+        fallback."""
+        import jax
+
+        mpath = os.path.join(d, self.MANIFEST)
+        try:
+            with open(mpath) as f:
+                man = json.load(f)
+            nshards = int(man["nshards"])
+        except (ValueError, OSError, KeyError, TypeError) as e:
+            # parseable-but-damaged manifests (missing/garbled nshards)
+            # must fall back like unreadable ones — load() only catches
+            # CheckpointCorruptError/FileNotFoundError
+            raise CheckpointCorruptError(
+                f"unreadable sharded manifest {mpath}: {e}") from e
+        model_variables, meta = load_pytree(d, self.MODEL)
+        parts = []
+        for i in range(nshards):
+            tree, _ = load_pytree(d, shard_unit_name(i, nshards),
+                                  as_jax=False)
+            parts.append(tree)
+        if parts and jax.tree_util.tree_leaves(parts[0]):
+            # host-side concatenate: the shards were loaded as numpy on
+            # purpose — callers re-place/re-shard onto the current mesh,
+            # so a jnp.concatenate here would bounce the full optimizer
+            # state through the default device for nothing
+            optim_state = jax.tree_util.tree_map(
+                lambda *xs: np.concatenate([np.asarray(x) for x in xs]),
+                *parts)
+        else:  # slot-less method (plain SGD): every shard tree is empty
+            optim_state = parts[0] if parts else {}
+        self._last_loaded = d
+        from bigdl_tpu import obs
+
+        obs.emit_event("checkpoint_load", path=d, sharded=True,
+                       nshards=nshards)
+        optim_meta = man.get("optim_meta") or {}
+        if with_optim_meta:
+            return (model_variables, optim_state,
+                    meta.get("train_state", {}), optim_meta)
         return model_variables, optim_state, meta.get("train_state", {})
 
     def load(self, directory: Optional[str] = None,

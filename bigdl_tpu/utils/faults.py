@@ -32,8 +32,23 @@ Fault kinds and where they are consulted:
                   partially written, before publish — the crash-mid-write
                   model; latest() must never surface the leftovers
     ckpt_corrupt  complete Checkpoint.save(step) normally, then truncate
-                  the published model.npz — load() must fall back to the
-                  newest valid checkpoint
+                  the published model.npz (or, for a SHARDED save, a
+                  middle optim shard's npz) — load() must fall back to
+                  the newest valid checkpoint
+    preempt       simulated worker kill: raise Preempted before
+                  dispatching train step `step`. Unlike `step`, this is
+                  NOT retryable in-process — DistriOptimizer's retry
+                  budget re-raises it (a preempted TPU worker is dead;
+                  the pod restarts the job with --resume, which the
+                  preempt_resume drill models end to end)
+    ckpt_async_torn
+                  kill the checkpoint writer mid-sharded-save (after at
+                  least one shard unit, before the manifest-last
+                  publish): the torn dir has units but no MANIFEST.json,
+                  so it never becomes a latest() candidate; with
+                  async_save the error surfaces at the next
+                  Checkpoint.save()/wait() — the background-writer
+                  death model (drill kill_mid_save/ckpt_async_torn)
 
 Serving kinds — consulted inside the serving engine's step loop
 (bigdl_tpu/serving/engine.py), keyed by the engine's DECODE step
@@ -68,11 +83,18 @@ logger = logging.getLogger("bigdl_tpu.faults")
 ENV_VAR = "BIGDL_FAULTS"
 
 KINDS = ("step", "nan", "data", "ckpt_torn", "ckpt_corrupt",
+         "preempt", "ckpt_async_torn",
          "serve_nan", "serve_err", "serve_slow")
 
 
 class FaultInjected(RuntimeError):
     """Raised by an injected failure (never by real code paths)."""
+
+
+class Preempted(FaultInjected):
+    """An injected worker preemption (`preempt@step`): the in-process
+    retry paths must NOT absorb this — the modeled worker is gone, and
+    recovery is a fresh process with `resume_from_checkpoint()`."""
 
 
 class FaultPlan:
@@ -117,6 +139,16 @@ class FaultPlan:
     def maybe_raise(self, kind: str, step: int) -> None:
         if self.fires(kind, step):
             raise FaultInjected(f"injected fault {kind}@{int(step)}")
+
+    def maybe_preempt(self, step: int) -> None:
+        """Consulted by both training loops BEFORE the step's retry
+        scope: a preemption is a dead worker, not a transient step
+        failure, so the retry budget must never absorb it (recovery is
+        a fresh process with --resume; drill preempt_resume)."""
+        if self.fires("preempt", step):
+            raise Preempted(
+                f"injected fault preempt@{int(step)}: "
+                f"worker killed before step dispatch")
 
 
 _NO_FAULTS = FaultPlan("")

@@ -30,6 +30,31 @@ same shard_map code a pod runs):
                     rot): load() detects the checksum/zip damage and
                     falls back to the newest VALID checkpoint
 
+Elastic-training legs (ISSUE 9 — ZeRO-2 + async sharded checkpoints,
+all on the 8-device virtual mesh with `set_mesh(zero=2)` and
+`set_checkpoint(sharded=True, async_save=True)`):
+
+    preempt_resume  preempt@5 kills the worker (NOT retryable — the
+                    in-process retry budget must re-raise it); a fresh
+                    process resumes from the sharded checkpoint and
+                    finishes BIT-IDENTICAL to the uninterrupted run
+    ckpt_async_torn the background checkpoint writer is killed mid-
+                    sharded-save: the torn units stay in the
+                    .inprogress staging dir (never a latest()
+                    candidate; the final dir is never created), the
+                    error surfaces at the next save, and resume from
+                    the previous checkpoint is bit-identical
+    torn_shard      a PUBLISHED sharded checkpoint has one shard's npz
+                    truncated (bit rot): per-shard crc32s catch it,
+                    load() falls back to the newest valid checkpoint,
+                    resume is bit-identical
+    worldsize_resume an 8-shard ZeRO-2 checkpoint resumes onto a
+                    4-device mesh (strip padding, re-pad, re-shard):
+                    training completes finite and the resumed run is
+                    bit-deterministic across two invocations (cross-
+                    topology bit-identity is NOT promised — summation
+                    order changes with the shard count)
+
 Serving plane (--plane serving): each leg drives the continuous-
 batching InferenceEngine (bigdl_tpu/serving/engine.py) over a tiny LM
 with utils/faults serving kinds injected by DECODE step number:
@@ -130,11 +155,16 @@ def _flat(model):
 
 
 def _train(workdir, end_iter, *, faults="", guard=None, mesh=False,
-           ckpt_iter=None, resume=False, tag="run"):
+           ckpt_iter=None, resume=False, tag="run", zero=1,
+           sharded=False, async_save=False, mesh_devices=None):
     """One training run under an injection plan; returns (flat params,
-    the Optimizer) so legs can inspect guard stats / checkpoint state.
+    the Optimizer, the consumed FaultPlan) so legs can inspect guard
+    stats / checkpoint state / which shots actually fired.
     The plan is installed fresh per run — one-shot budgets never leak
-    across runs, which is what makes every leg reproducible."""
+    across runs, which is what makes every leg reproducible.
+    `zero`/`sharded`/`async_save` arm the ISSUE-9 elastic-training
+    plane; `mesh_devices` runs the mesh on a device SUBSET (the
+    world-size-change resume leg)."""
     import jax
 
     from bigdl_tpu import nn
@@ -157,11 +187,17 @@ def _train(workdir, end_iter, *, faults="", guard=None, mesh=False,
         opt.set_anomaly_guard(guard)
     if ckpt_iter is not None:
         opt.set_checkpoint(os.path.join(workdir, tag),
-                           Trigger.several_iteration(ckpt_iter))
+                           Trigger.several_iteration(ckpt_iter),
+                           sharded=sharded, async_save=async_save)
     if resume:
         opt.resume_from_checkpoint()
-    if mesh:
-        opt.set_mesh(make_mesh({"data": jax.device_count()}))
+    if mesh or mesh_devices:
+        if mesh_devices:
+            m = make_mesh({"data": mesh_devices},
+                          devices=jax.devices()[:mesh_devices])
+        else:
+            m = make_mesh({"data": jax.device_count()})
+        opt.set_mesh(m, zero=zero)
     faults_mod.set_plan(faults_mod.FaultPlan(faults))
     try:
         trained = opt.optimize()
@@ -336,6 +372,167 @@ def drill_ckpt_fallback(workdir):
             "corrupt_skipped": skipped,
             "resumed_from": resumed_from,
             "bit_identical": bool(np.array_equal(ref, got)),
+            "events": log.counts_by_kind()}
+
+
+# --------------------------------------------------- elastic-training legs
+# ISSUE 9: every leg runs the ZeRO-2 mesh step with sharded async
+# checkpoints — the full preemption-tolerant training plane, not a
+# simplified stand-in. References share the same compiled graph
+# (zero=2) so bit-identity compares like with like.
+
+def drill_preempt_resume(workdir):
+    """preempt@5 kills the worker: the DistriOptimizer retry budget
+    must RE-RAISE it (a preempted worker is dead, not a transient step
+    failure — no in-process checkpoint reload), and a fresh process
+    resuming from the sharded checkpoint finishes bit-identical to the
+    uninterrupted run."""
+    from bigdl_tpu.utils.faults import Preempted
+
+    ref, _, _ = _train(workdir, end_iter=8, mesh=True, zero=2, tag="per")
+    died = False
+    with _telemetry() as log:
+        try:
+            _train(workdir, end_iter=8, faults="preempt@5", mesh=True,
+                   zero=2, ckpt_iter=3, sharded=True, async_save=True,
+                   tag="pef")
+        except Preempted:
+            died = True  # the modeled worker kill
+    injected = log.events("fault_injected", fault="preempt", step=5)
+    absorbed = log.events("checkpoint_load")   # retry must NOT have run
+    saves = [e for e in log.events("checkpoint_save") if "shard" not in e]
+    with _telemetry() as rlog:
+        got, opt, _ = _train(workdir, end_iter=8, mesh=True, zero=2,
+                             ckpt_iter=3, sharded=True, async_save=True,
+                             resume=True, tag="pef")
+    resumed = rlog.events("checkpoint_load")
+    return {"ok": died and len(injected) == 1 and not absorbed
+            and len(saves) == 1 and saves[0]["async"]
+            and saves[0]["nshards"] == 8
+            and len(resumed) == 1 and resumed[0].get("sharded") is True
+            and bool(np.array_equal(ref, got)),
+            "died_unretried": died and not absorbed,
+            "bit_identical": bool(np.array_equal(ref, got)),
+            "resumed_from": os.path.basename(resumed[0]["path"])
+            if resumed else "",
+            "events": log.counts_by_kind(),
+            "resume_events": rlog.counts_by_kind()}
+
+
+def drill_ckpt_async_torn(workdir):
+    """The background checkpoint writer is killed mid-sharded-save
+    (ckpt_async_torn@4): the torn dir holds shard units but no
+    MANIFEST.json, so it never becomes a latest() candidate; the
+    stored writer error surfaces at the next save (failing the run —
+    a dead writer must not pass silently); resume falls back to the
+    previous checkpoint and finishes bit-identical."""
+    from bigdl_tpu.utils.faults import FaultInjected
+
+    ref, _, _ = _train(workdir, end_iter=6, mesh=True, zero=2, tag="atr")
+    died = False
+    with _telemetry() as log:
+        try:
+            _train(workdir, end_iter=6, faults="ckpt_async_torn@4",
+                   mesh=True, zero=2, ckpt_iter=2, sharded=True,
+                   async_save=True, tag="atf")
+        except FaultInjected:
+            died = True  # surfaced from the writer thread
+    # the writer died in the staging dir: checkpoint-4 itself must not
+    # exist (the swap never happened), the torn units sit in
+    # checkpoint-4.inprogress where latest() can never see them
+    torn_dir = os.path.join(workdir, "atf", "checkpoint-4")
+    torn_is_unpublished = (not os.path.isdir(torn_dir)
+                           and os.path.isdir(torn_dir + ".inprogress"))
+    injected = log.events("fault_injected", fault="ckpt_async_torn",
+                          step=4)
+    # per-shard saves for step 4 started, but the publish event never
+    # fired (the final checkpoint_save record carries no "shard" field)
+    shard_saves_4 = [e for e in log.events("checkpoint_save", step=4)
+                     if "shard" in e]
+    final_saves_4 = [e for e in log.events("checkpoint_save", step=4)
+                     if "shard" not in e]
+    with _telemetry() as rlog:
+        got, opt, _ = _train(workdir, end_iter=6, mesh=True, zero=2,
+                             ckpt_iter=2, sharded=True, async_save=True,
+                             resume=True, tag="atf")
+    resumed = rlog.events("checkpoint_load")
+    resumed_from = os.path.basename(resumed[0]["path"]) if resumed else ""
+    return {"ok": died and torn_is_unpublished and len(injected) == 1
+            and len(shard_saves_4) >= 1 and not final_saves_4
+            and resumed_from == "checkpoint-2"
+            and bool(np.array_equal(ref, got)),
+            "writer_died": died,
+            "torn_never_published": torn_is_unpublished,
+            "resumed_from": resumed_from,
+            "bit_identical": bool(np.array_equal(ref, got)),
+            "events": log.counts_by_kind(),
+            "resume_events": rlog.counts_by_kind()}
+
+
+def drill_torn_shard(workdir):
+    """checkpoint-6 publishes, then ONE optim shard's npz is truncated
+    (bit-rot model, ckpt_corrupt on the sharded path): the per-shard
+    crc32 manifest catches it, load() skips the dir and falls back to
+    checkpoint-3, and the resume still finishes bit-identical."""
+    ref, _, _ = _train(workdir, end_iter=9, mesh=True, zero=2, tag="tsr")
+    _train(workdir, end_iter=7, faults="ckpt_corrupt@6", mesh=True,
+           zero=2, ckpt_iter=3, sharded=True, async_save=True, tag="tsf")
+    with _telemetry() as log:
+        got, opt, _ = _train(workdir, end_iter=9, mesh=True, zero=2,
+                             ckpt_iter=3, sharded=True, async_save=True,
+                             resume=True, tag="tsf")
+    skipped_ev = log.events("checkpoint_corrupt_skipped")
+    loaded_ev = log.events("checkpoint_load")
+    skipped = [os.path.basename(e["path"]) for e in skipped_ev]
+    resumed_from = os.path.basename(loaded_ev[0]["path"]) \
+        if loaded_ev else ""
+    return {"ok": "checkpoint-6" in skipped
+            and resumed_from == "checkpoint-3"
+            and bool(np.array_equal(ref, got)),
+            "corrupt_skipped": skipped,
+            "resumed_from": resumed_from,
+            "bit_identical": bool(np.array_equal(ref, got)),
+            "events": log.counts_by_kind()}
+
+
+def drill_worldsize_resume(workdir):
+    """An 8-shard ZeRO-2 sharded checkpoint resumes onto a 4-device
+    mesh: the flat slot vectors are re-concatenated, stripped of the
+    old padding and re-padded for the new world size (padded length
+    actually CHANGES for this model: 184 -> 180). Cross-topology
+    bit-identity is not promised (summation order changes with the
+    shard count); what IS pinned: the resume completes finite, loads
+    the 8-shard checkpoint, and two identical resumed runs are
+    bit-identical to each other."""
+    import json as _json
+
+    _train(workdir, end_iter=6, mesh=True, zero=2, ckpt_iter=3,
+           sharded=True, async_save=True, tag="wsr")
+    manifest = os.path.join(workdir, "wsr", "checkpoint-6",
+                            "MANIFEST.json")
+    with open(manifest) as f:
+        man = _json.load(f)
+    # ckpt_iter=100: the resumed runs never re-save, so BOTH resume
+    # from the same 8-shard checkpoint-6 (a re-save by run 1 would
+    # hand run 2 a different, 4-shard starting point)
+    with _telemetry() as log:
+        got1, opt, _ = _train(workdir, end_iter=10, mesh_devices=4,
+                              zero=2, ckpt_iter=100, sharded=True,
+                              async_save=True, resume=True, tag="wsr")
+    resumed = log.events("checkpoint_load")
+    got2, _, _ = _train(workdir, end_iter=10, mesh_devices=4, zero=2,
+                        ckpt_iter=100, sharded=True, async_save=True,
+                        resume=True, tag="wsr")
+    resharded = (man["nshards"] == 8
+                 and man["optim_meta"]["padded"] != man["optim_meta"]
+                 ["total"])  # old padding really was stripped on resume
+    return {"ok": bool(np.isfinite(got1).all()) and resharded
+            and len(resumed) == 1 and resumed[0].get("nshards") == 8
+            and bool(np.array_equal(got1, got2)),
+            "saved_shards": man["nshards"],
+            "resumed_mesh_devices": 4,
+            "finite": bool(np.isfinite(got1).all()),
+            "deterministic_across_runs": bool(np.array_equal(got1, got2)),
             "events": log.counts_by_kind()}
 
 
@@ -923,6 +1120,11 @@ TRAINING_LEGS = {
     "data_retry": drill_data_retry,
     "ckpt_torn": drill_ckpt_torn,
     "ckpt_fallback": drill_ckpt_fallback,
+    # ISSUE 9 elastic-training legs (ZeRO-2 + async sharded ckpt)
+    "preempt_resume": drill_preempt_resume,
+    "ckpt_async_torn": drill_ckpt_async_torn,
+    "torn_shard": drill_torn_shard,
+    "worldsize_resume": drill_worldsize_resume,
 }
 
 SERVING_LEGS = {

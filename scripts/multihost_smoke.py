@@ -27,6 +27,17 @@ detect the damage (per-array checksums, serialization/checkpoint.py),
 fall back to the newest VALID checkpoint, and finish bit-identical to
 the uninterrupted run.
 
+Leg 4 (zero2_resume — ISSUE 9 preemption-tolerant training plane):
+2 procs × 4 devices with `set_mesh(zero=2)` (master fp32 weights
+sharded across all 8 devices) and `set_checkpoint(sharded=True,
+async_save=True)` — each host background-writes ONLY the shard units
+its devices own, host 0 publishes MANIFEST.json last. One worker is
+SIGKILLed after the first sharded checkpoint publishes (the
+collective wedges, the launcher reaps the job — a preempted-host
+model with possibly-torn in-flight saves on disk); the full restart
+with --resume must reshard the published checkpoint and finish
+bit-identical to the uninterrupted zero2 run.
+
     python scripts/multihost_smoke.py          # all legs
 """
 
@@ -106,8 +117,9 @@ def child(args):
                .set_end_when(Trigger.max_iteration(end_iter))
                .set_validation(Trigger.several_iteration(3), val,
                                [Loss(nn.ClassNLLCriterion())], 32)
-               .set_checkpoint(ckpt, Trigger.several_iteration(3))
-               .set_mesh(mesh))
+               .set_checkpoint(ckpt, Trigger.several_iteration(3),
+                               sharded=args.zero2, async_save=args.zero2)
+               .set_mesh(mesh, zero=2 if args.zero2 else 1))
         if resume:
             opt.resume_from_checkpoint()
         return opt.optimize(), opt
@@ -148,7 +160,7 @@ def child(args):
 
 
 def _spawn_group(leg, n_procs, devices_per_proc, port, workdir,
-                 end_iter=6, resume=False):
+                 end_iter=6, resume=False, zero2=False):
     procs = []
     for pid in range(n_procs):
         cmd = [sys.executable, os.path.abspath(__file__),
@@ -158,6 +170,8 @@ def _spawn_group(leg, n_procs, devices_per_proc, port, workdir,
                "--leg", leg, "--end-iter", str(end_iter)]
         if resume:
             cmd.append("--resume")
+        if zero2:
+            cmd.append("--zero2")
         procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                       stderr=subprocess.STDOUT))
     return procs
@@ -357,6 +371,81 @@ def _leg_ckpt_corrupt(port):
             "bit_identical": shas_res[0] == shas_ref[0]}
 
 
+def _leg_zero2_resume(port):
+    """ISSUE 9: kill/resume over the FULL elastic-training plane —
+    ZeRO-2 weight sharding across both hosts' devices, each host
+    background-writing only its own shard units, manifest-last
+    publish. One worker SIGKILLed after the first sharded checkpoint
+    publishes; full restart with --resume must finish bit-identical
+    to the uninterrupted zero2 run."""
+    import re
+    import tempfile
+    import time
+
+    n, dpp, end = 2, 4, 12
+    wd_ref = tempfile.mkdtemp(prefix="multihost_z2ref_")
+    codes_ref = _reap(_spawn_group("kill_resume", n, dpp, port, wd_ref,
+                                   end_iter=end, zero2=True))
+    if any(c != 0 for c in codes_ref):
+        return {"ok": False, "stage": "reference",
+                "return_codes": codes_ref}
+    _, shas_ref = _collect(wd_ref, n)
+
+    wd = tempfile.mkdtemp(prefix="multihost_z2kill_")
+    procs = _spawn_group("kill_resume", n, dpp, port + 1, wd,
+                         end_iter=end, zero2=True)
+    ckdir = os.path.join(wd, "ckpt")
+    # a sharded checkpoint only EXISTS once MANIFEST.json lands (the
+    # manifest-last publish point) — the dir alone is a torn save
+    marker = os.path.join(ckdir, "checkpoint-3", "MANIFEST.json")
+    deadline = time.time() + 300
+    saw_ckpt = False
+    while time.time() < deadline:
+        if os.path.exists(marker):
+            saw_ckpt = True
+            break
+        if any(p.poll() is not None for p in procs):
+            break
+        time.sleep(0.05)
+    killed = False
+    if saw_ckpt and all(p.poll() is None for p in procs):
+        procs[1].kill()              # the preempted host
+        killed = True
+        time.sleep(5)                # collective wedges; reap the job
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    _reap(procs, timeout=30)
+    if not killed:
+        return {"ok": False, "stage": "kill",
+                "detail": "no published sharded checkpoint before the "
+                          "job ended — nothing to resume from"}
+    published = [d for d in os.listdir(ckdir)
+                 if re.fullmatch(r"checkpoint-(\d+)", d)
+                 and os.path.exists(os.path.join(ckdir, d,
+                                                 "MANIFEST.json"))]
+    shard_units = [f for f in os.listdir(os.path.join(
+        ckdir, "checkpoint-3")) if f.startswith("optim-shard")
+        and f.endswith(".npz")]
+
+    codes_res = _reap(_spawn_group("kill_resume", n, dpp, port + 2, wd,
+                                   end_iter=end, resume=True,
+                                   zero2=True))
+    if any(c != 0 for c in codes_res):
+        return {"ok": False, "stage": "resume",
+                "return_codes": codes_res}
+    _, shas_res = _collect(wd, n)
+    ok = (len(set(shas_res)) == 1 and len(set(shas_ref)) == 1
+          and shas_res[0] == shas_ref[0] and len(shard_units) == 8)
+    return {"ok": ok, "processes": n, "devices_per_process": dpp,
+            "steps": end, "zero": 2, "killed_process": 1,
+            "sharded_checkpoints_at_kill": sorted(published),
+            "shard_units_in_first_ckpt": len(shard_units),
+            "sha256_uninterrupted": shas_ref[0][:16],
+            "sha256_resumed": shas_res[0][:16],
+            "bit_identical": shas_res[0] == shas_ref[0]}
+
+
 def launcher(legs):
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "MULTIHOST.json")
@@ -372,7 +461,8 @@ def launcher(legs):
     ok = True
     if "smoke" in legs:
         smoke = _leg_smoke(PORT)
-        prev = {k: result[k] for k in ("kill_resume", "ckpt_corrupt")
+        prev = {k: result[k] for k in ("kill_resume", "ckpt_corrupt",
+                                       "zero2_resume")
                 if k in result}
         result = dict(smoke)  # legacy top-level shape for leg 1
         result.update(prev)
@@ -385,6 +475,10 @@ def launcher(legs):
         corrupt = _leg_ckpt_corrupt(PORT + 20)
         result["ckpt_corrupt"] = corrupt
         ok = ok and corrupt.get("ok", False)
+    if "zero2_resume" in legs:
+        z2 = _leg_zero2_resume(PORT + 30)
+        result["zero2_resume"] = z2
+        ok = ok and z2.get("ok", False)
     result["ok"] = bool(ok and result.get("ok", True))
     with open(path, "w") as f:
         json.dump(result, f)
@@ -402,10 +496,14 @@ def main():
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--leg", default="smoke",
                     choices=["smoke", "kill_resume"])
-    ap.add_argument("--legs", default="smoke,kill_resume,ckpt_corrupt",
+    ap.add_argument("--legs",
+                    default="smoke,kill_resume,ckpt_corrupt,zero2_resume",
                     help="launcher mode: comma subset of legs to run")
     ap.add_argument("--end-iter", type=int, default=6)
     ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--zero2", action="store_true",
+                    help="child mode: ZeRO-2 weight sharding + sharded "
+                         "async checkpoints (ISSUE 9)")
     args = ap.parse_args()
     if args.process_id is None:
         launcher(set(args.legs.split(",")))

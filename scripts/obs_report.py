@@ -90,9 +90,7 @@ def summarize(events: List[dict]) -> Dict[str, object]:
     faults = [e for e in events if e.get("kind") == "fault_injected"]
     if faults:
         out["faults"] = [f'{e["fault"]}@{e["step"]}' for e in faults]
-    ckpt = {k: by_kind.get(k, 0) for k in
-            ("checkpoint_save", "checkpoint_load",
-             "checkpoint_corrupt_skipped") if by_kind.get(k)}
+    ckpt = _checkpoint_section(events)
     if ckpt:
         out["checkpoints"] = ckpt
 
@@ -218,6 +216,57 @@ def _prefix_section(events: List[dict]) -> Optional[dict]:
     return out or None
 
 
+def _checkpoint_section(events: List[dict]) -> Optional[dict]:
+    """Checkpoint digest (ISSUE 9): save cadence and durations from
+    the enriched `checkpoint_save` events (`async`/`duration_s`/
+    `shard`/`nshards` fields), load + corrupt-skip counts, and the
+    `training_checkpoint_seconds` histogram of the last embedded
+    metrics snapshot when one exists. Per-shard unit writes (events
+    carrying a `shard` field) are tallied separately — the cadence and
+    duration stats describe whole-checkpoint publishes only."""
+    saves = [e for e in events if e.get("kind") == "checkpoint_save"]
+    finals = [e for e in saves if "shard" not in e]
+    units = [e for e in saves if "shard" in e]
+    loads = [e for e in events if e.get("kind") == "checkpoint_load"]
+    skipped = [e for e in events
+               if e.get("kind") == "checkpoint_corrupt_skipped"]
+    if not (saves or loads or skipped):
+        return None
+    out: dict = {"saves": len(finals), "loads": len(loads),
+                 "corrupt_skipped": len(skipped)}
+    if finals:
+        out["async_saves"] = sum(1 for e in finals if e.get("async"))
+        steps = sorted(e["step"] for e in finals
+                       if isinstance(e.get("step"), (int, float)))
+        gaps = [b - a for a, b in zip(steps, steps[1:]) if b > a]
+        if gaps:
+            out["save_cadence_steps"] = round(sum(gaps) / len(gaps), 2)
+        durs = [e["duration_s"] for e in finals
+                if isinstance(e.get("duration_s"), (int, float))]
+        if durs:
+            out["save_duration_p50_s"] = _pctl(durs, 0.50)
+            out["save_duration_max_s"] = round(max(durs), 6)
+    if units:
+        out["shard_unit_writes"] = len(units)
+        out["nshards"] = max(int(e.get("nshards", 1)) for e in units)
+    if loads:
+        out["sharded_loads"] = sum(1 for e in loads if e.get("sharded"))
+    snaps = [e for e in events if e.get("kind") == "metrics_snapshot"]
+    if snaps:
+        fam = snaps[-1]["snapshot"].get("metrics", {}).get(
+            "training_checkpoint_seconds")
+        if fam is not None:
+            out["histogram"] = {
+                s["labels"].get("mode", "?"): {
+                    "count": s["count"],
+                    "p50_s": quantile_from_buckets(
+                        s["buckets"], s["counts"], 0.50),
+                    "p95_s": quantile_from_buckets(
+                        s["buckets"], s["counts"], 0.95)}
+                for s in fam["series"]}
+    return out
+
+
 def _digest_snapshot(snapshot: dict) -> dict:
     """Counters/gauges verbatim; histograms → count/sum/p50/p95/p99."""
     out = {}
@@ -302,8 +351,17 @@ def render(events: List[dict], tail: int = 15) -> str:
     if "faults" in s:
         lines.append("\ninjected faults: " + ", ".join(s["faults"]))
     if "checkpoints" in s:
+        c = s["checkpoints"]
         lines.append("\ncheckpoints:")
-        lines.append(_fmt_table(sorted(s["checkpoints"].items())))
+        rows = [(k, v) for k, v in sorted(c.items())
+                if k != "histogram"]
+        for mode, h in sorted(c.get("histogram", {}).items()):
+            def sec(v):
+                return "-" if v is None else f"{v * 1e3:.3g}ms"
+            rows.append((f"{mode} save (hist)",
+                         f"n={h['count']} p50/p95="
+                         f"{sec(h['p50_s'])}/{sec(h['p95_s'])}"))
+        lines.append(_fmt_table(rows))
     if "metrics" in s:
         lines.append("\nmetrics (last snapshot):")
         rows = []

@@ -28,3 +28,12 @@ def make_trace(n):
 
 def autoscale_decision(router):
     return {"t": datetime.now()}  # BAD
+
+
+def schedule_preempt(n_steps):
+    # ISSUE 9: drawing the kill step from a global stream — two drill
+    # invocations preempt at different steps, so "resume-after-kill is
+    # bit-identical" becomes unfalsifiable run to run
+    kill_step = np.random.randint(2, n_steps)  # BAD
+    torn_at = random.randrange(n_steps)  # BAD
+    return f"preempt@{kill_step},ckpt_async_torn@{torn_at}"

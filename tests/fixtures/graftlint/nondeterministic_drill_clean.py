@@ -34,3 +34,13 @@ class Router:
 
     def autoscale_decision(self):
         return {"t": self._clock()}         # injected, not wall clock
+
+
+def schedule_preempt(n_steps, seed):
+    # ISSUE 9: the kill step comes from a FAULT-PLAN SCHEDULE — a
+    # seeded draw baked into a `kind@step` string, so every drill
+    # invocation preempts at the same step and resume bit-identity is
+    # a falsifiable assertion
+    rng = np.random.RandomState(seed)
+    kill_step = int(rng.randint(2, n_steps))
+    return f"preempt@{kill_step},ckpt_async_torn@{n_steps - 1}"

@@ -52,6 +52,18 @@ def test_drill_leg(tmp_path, leg):
     assert result["ok"], result
 
 
+@pytest.mark.parametrize("leg", ["preempt_resume", "ckpt_async_torn",
+                                 "torn_shard", "worldsize_resume"])
+def test_elastic_drill_leg(tmp_path, leg):
+    """ISSUE 9: the preemption-tolerant training plane drills — ZeRO-2
+    sharded updates with async sharded checkpoints survive worker
+    kills, torn background saves, shard bit-rot, and world-size
+    changes, bit-deterministically, on every tier-1 pass."""
+    fd = _load_drill()
+    result = fd.LEGS[leg](str(tmp_path))
+    assert result["ok"], result
+
+
 @pytest.mark.parametrize("leg", ["serve_poison", "serve_overload",
                                  "serve_deadline", "serve_retry",
                                  "serve_watchdog", "serve_prefix",

@@ -436,3 +436,44 @@ def test_obs_report_summarize_and_render(tmp_path, capsys):
     assert "training:" in out and "serving:" in out
     assert "status poisoned" in out
     assert rep.main([str(tmp_path / "missing.jsonl")]) == 2
+
+def test_obs_report_checkpoint_section(tmp_path, capsys):
+    """ISSUE 9: the checkpoint digest — save cadence and durations
+    from the enriched checkpoint_save events (async/duration_s/shard/
+    nshards), shard-unit tally, corrupt-skip count, and the
+    training_checkpoint_seconds histogram from the snapshot."""
+    path = tmp_path / "run.jsonl"
+    obs.set_event_log(obs.EventLog(path=str(path), clock=lambda: 1.0))
+    h = obs.get_registry().histogram(
+        "training_checkpoint_seconds", "save seconds", ("mode",))
+    for step, dur in ((3, 0.010), (6, 0.030), (9, 0.020)):
+        for shard in range(2):
+            obs.emit_event("checkpoint_save", step=step, path=f"c-{step}",
+                           **{"async": True}, duration_s=dur / 4,
+                           nshards=2, shard=shard)
+        obs.emit_event("checkpoint_save", step=step, path=f"c-{step}",
+                       **{"async": True}, duration_s=dur, nshards=2,
+                       mid_cycle=False)
+        h.labels(mode="async").observe(dur)
+    obs.emit_event("checkpoint_corrupt_skipped", path="c-9",
+                   error="crc mismatch")
+    obs.emit_event("checkpoint_load", path="c-6", sharded=True, nshards=2)
+    obs.log_metrics_snapshot()
+    obs.get_event_log().close()
+
+    rep = _load_report()
+    s = rep.summarize(obs.read_jsonl(str(path)))
+    c = s["checkpoints"]
+    assert c["saves"] == 3 and c["async_saves"] == 3
+    assert c["shard_unit_writes"] == 6 and c["nshards"] == 2
+    assert c["save_cadence_steps"] == 3.0
+    assert c["loads"] == 1 and c["sharded_loads"] == 1
+    assert c["corrupt_skipped"] == 1
+    assert c["save_duration_p50_s"] == pytest.approx(0.020)
+    assert c["save_duration_max_s"] == pytest.approx(0.030)
+    hist = c["histogram"]["async"]
+    assert hist["count"] == 3 and hist["p50_s"] is not None
+    assert rep.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "checkpoints:" in out and "save_cadence_steps" in out
+    assert "async save (hist)" in out

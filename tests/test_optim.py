@@ -52,8 +52,35 @@ class TestMethodsConverge:
     def test_adagrad(self):
         assert converges(Adagrad(learningrate=1.0))
 
+    @pytest.mark.slow
     def test_adamax(self):
+        # ~40 s toy-convergence run; Adamax's update math is pinned
+        # exactly by test_adamax_trajectory_matches_torch (per-step
+        # oracle below) — tier-2 keeps the redundant slow check
         assert converges(Adamax(learningrate=0.5))
+
+    def test_adamax_trajectory_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        w0 = np.asarray([1.0, -2.0, 0.5], np.float32)
+        grads_seq = [np.asarray([0.5, -0.25, 1.5], np.float32) * (i + 1)
+                     for i in range(6)]
+        method = Adamax(learningrate=0.05, beta1=0.9, beta2=0.999,
+                        epsilon=1e-8)
+        params = {"w": jnp.asarray(w0)}
+        slots = method.init_slots(params)
+        for i, g in enumerate(grads_seq):
+            params, slots = method.update({"w": jnp.asarray(g)}, params,
+                                          slots, jnp.asarray(0.05),
+                                          jnp.asarray(i))
+        tw = torch.tensor(w0.copy(), requires_grad=True)
+        opt = torch.optim.Adamax([tw], lr=0.05, betas=(0.9, 0.999),
+                                 eps=1e-8)
+        for g in grads_seq:
+            opt.zero_grad()
+            tw.grad = torch.tensor(g)
+            opt.step()
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   tw.detach().numpy(), rtol=1e-5)
 
     def test_rmsprop(self):
         assert converges(RMSprop(learningrate=0.1))

@@ -1,0 +1,374 @@
+"""ZeRO-2 sharded update + async sharded checkpoints (ISSUE 9).
+
+Parity contract: `all_gather` of the disjoint per-device weight shards
+reconstructs the exact concatenation the ZeRO-1 step holds replicated,
+so sharding the master fp32 residency must not change a single bit of
+the update — pinned BITWISE here for both the plain step and the
+grad-accum pair (the `zero2` dryrun leg in __graft_entry__.py asserts
+the same invariant from the driver contract side).
+
+Resume contract: train 2N steps uninterrupted == train N + kill +
+fresh-process resume + N, bit-for-bit, through the sharded async
+checkpoint (fast tier-1 sibling of scripts/fault_drill.py's
+preempt_resume leg — which additionally drives the injected fault
+plan and asserts from telemetry events).
+
+Checkpoint format contract (serialization/checkpoint.py): per-shard
+units + MANIFEST.json published LAST — a dir without a MANIFEST is
+torn and never a `latest()` candidate; a damaged published shard
+fails its crc32 and `load()` falls back newest-valid; a failed async
+save surfaces at the next `save()`/`wait()`, never silently.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DataSet
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.optim import Adam, Optimizer, Trigger
+from bigdl_tpu.parallel import (
+    FlatParamSpec, make_dp_accum_steps, make_dp_train_step, make_mesh,
+)
+from bigdl_tpu.serialization.checkpoint import (
+    Checkpoint, CheckpointCorruptError, shard_unit_name,
+)
+from bigdl_tpu.utils import faults
+
+KEY = jax.random.PRNGKey(0)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert jax.device_count() >= 8, "conftest must force 8 CPU devices"
+    return make_mesh({"data": 8})
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    faults.set_plan(None)
+    yield
+    faults.set_plan(None)
+
+
+def _setup(mesh, grad_dtype):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    model = nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 4))
+    model.build(KEY)
+    crit = nn.CrossEntropyCriterion()
+    from bigdl_tpu.optim import SGD
+
+    method = SGD(learningrate=0.1, momentum=0.9, dampening=0.0)
+    spec = FlatParamSpec(model.variables["params"], 8)
+    bx = jax.random.normal(jax.random.PRNGKey(1), (32, 6))
+    by = jax.random.randint(jax.random.PRNGKey(2), (32,), 0, 4)
+
+    def inputs(w_spec):
+        flat_w = jax.device_put(
+            spec.flatten(model.variables["params"]),
+            NamedSharding(mesh, w_spec))
+        slots = jax.tree_util.tree_map(
+            lambda s: jax.device_put(s, NamedSharding(mesh, P("data"))),
+            method.init_slots(jnp.zeros((spec.padded,), jnp.float32)))
+        return flat_w, slots
+
+    return model, crit, method, spec, bx, by, inputs
+
+
+class TestZero2StepParity:
+    @pytest.mark.parametrize("grad_dtype", [None, "bfloat16"])
+    def test_step_bitwise_vs_zero1(self, mesh8, grad_dtype):
+        """The ZeRO-2 step's updated params and loss are bit-identical
+        to the ZeRO-1 step's on the same inputs — fp32 master path and
+        bf16-gradient-wire path both."""
+        from jax.sharding import PartitionSpec as P
+
+        model, crit, method, spec, bx, by, inputs = _setup(mesh8,
+                                                           grad_dtype)
+        args = (model.variables["state"], bx, by,
+                jnp.asarray(0.1, jnp.float32), jnp.asarray(0, jnp.int32),
+                KEY)
+
+        step1 = make_dp_train_step(model, crit, method, mesh8, spec,
+                                   grad_dtype=grad_dtype)
+        w, s = inputs(P())
+        ref_w, ref_slots, _, ref_loss = step1(w, s, *args)
+
+        step2 = make_dp_train_step(model, crit, method, mesh8, spec,
+                                   grad_dtype=grad_dtype, zero=2)
+        w2, s2 = inputs(P("data"))
+        assert all(sh.data.shape == (spec.shard_size,)
+                   for sh in w2.addressable_shards)
+        new_w, new_slots, _, loss = step2(w2, s2, *args)
+        # output stays sharded: ZeRO-2 persists 1/n residency
+        assert all(sh.data.shape == (spec.shard_size,)
+                   for sh in new_w.addressable_shards)
+
+        np.testing.assert_array_equal(np.asarray(loss),
+                                      np.asarray(ref_loss))
+        np.testing.assert_array_equal(np.asarray(new_w),
+                                      np.asarray(ref_w))
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            new_slots, ref_slots)
+
+    def test_accum_pair_bitwise_vs_zero1(self, mesh8):
+        """Two micro-steps + apply under zero=2 match zero=1 bitwise —
+        the accumulator path all_gathers the sharded weights the same
+        way the plain step does."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        model, crit, method, spec, bx, by, inputs = _setup(mesh8, None)
+        mod_state = model.variables["state"]
+
+        def run(zero):
+            micro_fn, apply_fn = make_dp_accum_steps(
+                model, crit, method, mesh8, spec, grad_dtype=None,
+                zero=zero)
+            w, s = inputs(P("data") if zero == 2 else P())
+            g_acc = jax.device_put(jnp.zeros((spec.padded,), jnp.float32),
+                                   NamedSharding(mesh8, P("data")))
+            state = mod_state
+            for i in range(2):
+                g_acc, state, _ = micro_fn(w, g_acc, state, bx, by,
+                                           jax.random.fold_in(KEY, i))
+            w, s, g_acc = apply_fn(w, s, g_acc, jnp.asarray(0.1),
+                                   jnp.asarray(0), jnp.asarray(2.0))
+            return np.asarray(w), s
+
+        ref_w, ref_s = run(1)
+        got_w, got_s = run(2)
+        np.testing.assert_array_equal(got_w, ref_w)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            got_s, ref_s)
+
+    def test_zero_knob_validation(self, mesh8):
+        model, crit, method, spec, *_ = _setup(mesh8, None)
+        with pytest.raises(ValueError, match="zero must be 1 or 2"):
+            make_dp_train_step(model, crit, method, mesh8, spec, zero=3)
+        with pytest.raises(ValueError, match="zero must be 1 or 2"):
+            make_dp_accum_steps(model, crit, method, mesh8, spec, zero=0)
+        with pytest.raises(ValueError, match="zero must be 1 or 2"):
+            Optimizer(nn.Linear(2, 2).build(KEY), DataSet.array(
+                [Sample(np.zeros(2, np.float32), 0)]),
+                nn.ClassNLLCriterion(), batch_size=1).set_mesh(
+                    mesh8, zero=3)
+
+
+# ---------------------------------------------------------------- e2e
+
+def _train(workdir, end_iter, *, ckpt_iter=None, resume=False,
+           tag="run", zero=2, sharded=True, async_save=True):
+    """Tiny ZeRO-2 mesh run with sharded async checkpoints; returns
+    the trained flat parameter vector (same dataset/model/seeds every
+    call — runs differ only in interruption/resume)."""
+    rng = np.random.RandomState(11)
+    samples = [Sample(rng.rand(6).astype(np.float32),
+                      int(rng.randint(0, 4))) for _ in range(64)]
+    model = nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 4),
+                          nn.LogSoftMax()).build(jax.random.PRNGKey(3))
+    opt = (Optimizer(model, DataSet.array(samples),
+                     nn.ClassNLLCriterion(), batch_size=8)
+           .set_optim_method(Adam(learningrate=1e-2))
+           .set_end_when(Trigger.max_iteration(end_iter))
+           .set_mesh(make_mesh({"data": 8}), zero=zero))
+    if ckpt_iter is not None:
+        opt.set_checkpoint(os.path.join(workdir, tag),
+                           Trigger.several_iteration(ckpt_iter),
+                           sharded=sharded, async_save=async_save)
+    if resume:
+        opt.resume_from_checkpoint()
+    trained = opt.optimize()
+    return np.concatenate([np.ravel(np.asarray(a, np.float32))
+                           for _, a in trained.parameters()]), opt
+
+
+class TestElasticResume:
+    def test_resume_bit_identity(self, tmp_path):
+        """train 2N uninterrupted == train N (sharded async ckpt at N)
+        + fresh-process resume + N, bit-for-bit (acceptance criterion:
+        resume-after-kill indistinguishable from never having died)."""
+        ref, _ = _train(str(tmp_path), 8, tag="ref")
+        _train(str(tmp_path), 4, ckpt_iter=4, tag="kill")
+        got, opt = _train(str(tmp_path), 8, ckpt_iter=4, resume=True,
+                          tag="kill")
+        assert opt.checkpoint._last_loaded.endswith("checkpoint-4")
+        np.testing.assert_array_equal(got, ref)
+
+    def test_sharded_needs_mesh(self, tmp_path):
+        """A local (mesh-less) run cannot WRITE sharded checkpoints —
+        there is no ZeRO flat state to shard."""
+        rng = np.random.RandomState(0)
+        samples = [Sample(rng.rand(6).astype(np.float32), 0)
+                   for _ in range(8)]
+        opt = (Optimizer(nn.Sequential(nn.Linear(6, 4),
+                                       nn.LogSoftMax()).build(KEY),
+                         DataSet.array(samples), nn.ClassNLLCriterion(),
+                         batch_size=8)
+               .set_optim_method(Adam(learningrate=1e-2))
+               .set_end_when(Trigger.max_iteration(2))
+               .set_checkpoint(str(tmp_path / "c"),
+                               Trigger.several_iteration(1),
+                               sharded=True))
+        with pytest.raises(ValueError, match="need a mesh"):
+            opt.optimize()
+
+
+# --------------------------------------------- sharded checkpoint format
+
+def _toy_shards(nshards=4, shard_size=3):
+    full = {"m": np.arange(nshards * shard_size, dtype=np.float32),
+            "v": np.arange(nshards * shard_size, dtype=np.float32) * 2}
+    shards = {i: {k: v[i * shard_size:(i + 1) * shard_size]
+                  for k, v in full.items()} for i in range(nshards)}
+    return full, shards
+
+
+def _model_tree():
+    return {"params": {"w": np.ones((2, 2), np.float32)}, "state": {}}
+
+
+META = {"layout": "zero2_flat", "num_shards": 4, "total": 12,
+        "padded": 12}
+
+
+class TestShardedCheckpointFormat:
+    def test_roundtrip_concatenates_shards(self, tmp_path):
+        ck = Checkpoint(str(tmp_path))
+        full, shards = _toy_shards()
+        ck.save_sharded(3, _model_tree(), shards, nshards=4,
+                        train_state={"neval": 3}, optim_meta=META)
+        d = os.path.join(str(tmp_path), "checkpoint-3")
+        assert os.path.exists(os.path.join(d, "MANIFEST.json"))
+        assert os.path.exists(os.path.join(
+            d, shard_unit_name(0, 4) + ".npz"))
+        vars_, optim, ts, meta = ck.load(with_optim_meta=True)
+        np.testing.assert_array_equal(np.asarray(optim["m"]), full["m"])
+        np.testing.assert_array_equal(np.asarray(optim["v"]), full["v"])
+        assert ts["neval"] == 3
+        assert meta["layout"] == "zero2_flat" and meta["padded"] == 12
+
+    def test_torn_dir_never_a_candidate(self, tmp_path):
+        """A writer death mid-save strands only the .inprogress
+        staging dir (never surfaced by latest()); load() uses the
+        older complete checkpoint. A later clean re-save of the SAME
+        step adopts the leftover staging and publishes fine."""
+        ck = Checkpoint(str(tmp_path))
+        full, shards = _toy_shards()
+        ck.save_sharded(2, _model_tree(), shards, nshards=4,
+                        optim_meta=META)
+        # torn save at step 4: sync dispatch raises mid-write
+        faults.set_plan(faults.FaultPlan("ckpt_async_torn@4"))
+        with pytest.raises(faults.FaultInjected):
+            ck.save_sharded(4, _model_tree(), shards, nshards=4,
+                            optim_meta=META)
+        torn = os.path.join(str(tmp_path), "checkpoint-4")
+        assert not os.path.isdir(torn), "torn save must never publish"
+        assert os.path.isdir(torn + ".inprogress")
+        assert ck.latest().endswith("checkpoint-2")
+        # recovery re-saves step 4 over the stale staging
+        faults.set_plan(None)
+        ck.save_sharded(4, _model_tree(), shards, nshards=4,
+                        optim_meta=META)
+        assert ck.latest().endswith("checkpoint-4")
+        assert not os.path.isdir(torn + ".inprogress")
+
+    def test_resave_crash_keeps_previous_same_step_checkpoint(
+            self, tmp_path):
+        """Re-saving an existing COMPLETE checkpoint-N must not
+        destroy it before the replacement publishes: a writer death
+        mid-re-save leaves the original intact and loadable."""
+        ck = Checkpoint(str(tmp_path))
+        full, shards = _toy_shards()
+        ck.save_sharded(4, _model_tree(), shards, nshards=4,
+                        train_state={"neval": 4}, optim_meta=META)
+        faults.set_plan(faults.FaultPlan("ckpt_async_torn@4"))
+        with pytest.raises(faults.FaultInjected):
+            ck.save_sharded(4, _model_tree(), shards, nshards=4,
+                            optim_meta=META)
+        faults.set_plan(None)
+        # the previous complete checkpoint-4 survived the torn re-save
+        vars_, optim, ts = ck.load()
+        assert ck._last_loaded.endswith("checkpoint-4")
+        assert ts["neval"] == 4
+        np.testing.assert_array_equal(np.asarray(optim["m"]), full["m"])
+
+    def test_damaged_published_shard_falls_back(self, tmp_path):
+        """Bit rot on one PUBLISHED shard: per-shard crc32 catches it,
+        load() skips the dir (recording it) and falls back."""
+        ck = Checkpoint(str(tmp_path))
+        _, shards = _toy_shards()
+        ck.save_sharded(2, _model_tree(), shards, nshards=4,
+                        optim_meta=META)
+        ck.save_sharded(4, _model_tree(), shards, nshards=4,
+                        optim_meta=META)
+        npz = os.path.join(str(tmp_path), "checkpoint-4",
+                           shard_unit_name(2, 4) + ".npz")
+        faults.corrupt_file(npz)
+        vars_, optim, ts = ck.load()
+        assert ck._last_loaded.endswith("checkpoint-2")
+        assert any(d.endswith("checkpoint-4")
+                   for d in ck.corrupt_skipped)
+        # a fully-damaged history must still raise, not loop
+        faults.corrupt_file(os.path.join(
+            str(tmp_path), "checkpoint-2", shard_unit_name(1, 4) + ".npz"))
+        ck2 = Checkpoint(str(tmp_path))
+        with pytest.raises(CheckpointCorruptError):
+            ck2.load()
+
+    def test_damaged_manifest_falls_back(self, tmp_path):
+        """A MANIFEST.json that still parses as JSON but lost its
+        fields (partial overwrite) must fall back like an unreadable
+        one — not escape load() as a KeyError."""
+        import json as _json
+
+        ck = Checkpoint(str(tmp_path))
+        _, shards = _toy_shards()
+        ck.save_sharded(2, _model_tree(), shards, nshards=4,
+                        optim_meta=META)
+        ck.save_sharded(4, _model_tree(), shards, nshards=4,
+                        optim_meta=META)
+        mpath = os.path.join(str(tmp_path), "checkpoint-4",
+                             Checkpoint.MANIFEST)
+        with open(mpath, "w") as f:
+            _json.dump({"step": 4}, f)  # valid JSON, no nshards
+        vars_, optim, ts = ck.load()
+        assert ck._last_loaded.endswith("checkpoint-2")
+        assert any(d.endswith("checkpoint-4")
+                   for d in ck.corrupt_skipped)
+
+    def test_async_error_surfaces_at_wait(self, tmp_path):
+        """A writer death on the background thread surfaces at wait()
+        (and at the next save), never silently."""
+        ck = Checkpoint(str(tmp_path), async_save=True)
+        _, shards = _toy_shards()
+        faults.set_plan(faults.FaultPlan("ckpt_async_torn@4"))
+        ck.save_sharded(4, _model_tree(), shards, nshards=4,
+                        optim_meta=META)  # returns immediately
+        with pytest.raises(faults.FaultInjected):
+            ck.wait()
+        # the error is consumed once; the saver is reusable after
+        ck.save_sharded(6, _model_tree(), shards, nshards=4,
+                        optim_meta=META)
+        ck.wait()
+        assert ck.latest().endswith("checkpoint-6")
+
+    def test_async_full_format_roundtrip(self, tmp_path):
+        """async_save also covers the unsharded format: the snapshot
+        is taken synchronously, the write lands by wait()."""
+        ck = Checkpoint(str(tmp_path), async_save=True)
+        ck.save(5, _model_tree(), {"m": np.arange(4, dtype=np.float32)},
+                train_state={"neval": 5})
+        ck.wait()
+        vars_, optim, ts = ck.load()
+        assert ts["neval"] == 5
+        np.testing.assert_array_equal(np.asarray(optim["m"]),
+                                      np.arange(4, dtype=np.float32))
