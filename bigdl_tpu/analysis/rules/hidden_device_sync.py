@@ -18,6 +18,16 @@ observe, and the paged-cache lookup/insert/evict/alloc paths —
 ISSUE 8: block-table and radix-tree surgery runs between EVERY decode
 step, so a sync there stalls the whole batch once per admission) in
 `serving/`, `ops/kv_cache.py` and `models/transformer.py`.
+
+ISSUE 10 widens the hot set to the sharded-serving paths: handoff
+export/import (`_export_handoff` carries the ONE suppressed
+per-request fetch — the disaggregation boundary; anything else on a
+handoff path is a stealth sync per package) and pool placement
+(`place_pools` runs on the step path after eager pool surgery — it
+must re-COMMIT shardings, never fetch). `serving/tp.py` is inside the
+`serving/` scope like the rest of the plane; its `gather_serving_
+params` (the checkpoint form — a deliberate whole-tree fetch) is
+host-side setup by name, not a hot path.
 """
 
 from __future__ import annotations
@@ -34,7 +44,7 @@ _SYNC_CALLS = {"np.asarray", "numpy.asarray", "np.array",
 _SYNC_METHODS = {"item", "block_until_ready", "tolist", "__array__"}
 _HOT_FN = re.compile(
     r"(decode|prefill|dispatch|step|sample|work|emit|observe"
-    r"|lookup|insert|evict|alloc)")
+    r"|lookup|insert|evict|alloc|handoff|place)")
 
 
 @register

@@ -14,6 +14,14 @@ The rule inspects functions decorated `@jax.jit` /
 parameter is flagged. Shape metadata (`x.shape`, `x.ndim`, `x.dtype`,
 `len(x)`, `isinstance(x, ...)`) is static under trace and allowed, as
 are parameters named in `static_argnums`/`static_argnames`.
+
+ISSUE 10: `shard_map`-wrapped bodies are trace roots too — the
+sharded serving plane (serving/tp.py) builds its paged trio as local
+functions handed to `shard_map(body, mesh=..., ...)`, which traces
+`body` exactly like jit traces its function and has NO static-arg
+escape hatch: every parameter is a traced operand. A function passed
+as the first argument to a `shard_map(...)` call anywhere in the
+module is therefore checked with all parameters traced.
 """
 
 from __future__ import annotations
@@ -22,7 +30,7 @@ import ast
 
 from bigdl_tpu.analysis.engine import Rule, register
 from bigdl_tpu.analysis.rules._common import call_name, functions, \
-    jit_decoration, param_names
+    jit_decoration, last_segment, param_names
 
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
                  "itemsize"}
@@ -39,16 +47,35 @@ class RetraceHazard(Rule):
     scope = ("bigdl_tpu/",)
 
     def check(self, ctx):
+        shard_bodies = self._shard_map_bodies(ctx.tree)
         for fn in functions(ctx.tree):
             jit = jit_decoration(fn)
             if jit is None:
-                continue
-            nums, names = jit
+                if fn.name not in shard_bodies:
+                    continue
+                # shard_map body: no static-arg escape — everything
+                # the mesh hands in is a traced operand
+                nums, names = set(), set()
+            else:
+                nums, names = jit
             params = param_names(fn)
             traced = {p for i, p in enumerate(params)
                       if i not in nums and p not in names}
             traced.discard("self")
             yield from self._check_fn(ctx, fn, traced)
+
+    @staticmethod
+    def _shard_map_bodies(tree):
+        """Names of local functions handed to shard_map(body, ...) —
+        traced exactly like jit roots (serving/tp.py's paged trio)."""
+        out = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and last_segment(call_name(node)) == "shard_map" \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Name):
+                out.add(node.args[0].id)
+        return out
 
     def _bare_traced_names(self, ctx, expr, traced):
         """Name nodes of traced params used by VALUE (not via static

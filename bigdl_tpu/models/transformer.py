@@ -78,6 +78,25 @@ def _tpred_bwd(axis, _, ct):
 tp_reduce.defvjp(_tpred_fwd, _tpred_bwd)
 
 
+def tp_shard_gather(x, axis):
+    """Reconstruct a full activation from disjoint per-shard column
+    slabs — the BIT-EXACT stand-in for Megatron's row-parallel psum on
+    the serving path (ISSUE 10).
+
+    A true row-split matmul psums PARTIAL sums, which changes the fp32
+    accumulation order vs the unsharded gemm and breaks the serving
+    plane's bitwise contract. Instead the sharded serving path keeps
+    every contraction FULL-extent (the ops/kv_cache.py prefix-cache
+    discipline) and uses ONE collective per layer half to concatenate
+    the disjoint column shards back into the exact array the unsharded
+    step holds — the zero2 discipline (all_gather of disjoint shards
+    reconstructs the replicated value bit-for-bit) applied to
+    activations. The downstream wo/w2 gemm then runs replicated over
+    identical shapes, so its bits match the unsharded step exactly
+    (pinned by tests/test_tp_serving.py and the tp_serve dryrun leg)."""
+    return lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
+
+
 @dataclass
 class TransformerConfig:
     vocab_size: int = 256
@@ -441,11 +460,18 @@ class TransformerLM(Module):
     # max_len, position-indexed dynamic_update_slice writes; shared
     # primitives in bigdl_tpu/ops/kv_cache.py).
 
-    def _serving_guard(self):
-        if self.sp_axis is not None or self.tp_axis is not None:
+    def _serving_guard(self, tp_ok=False):
+        """`tp_ok=True` on the PAGED trio: those paths are tp-aware
+        (ISSUE 10 — head-parallel attention + column-split MLP with
+        tp_shard_gather keeping every reduction full-extent) and run
+        inside shard_map via bigdl_tpu/serving/tp.py. The dense cache
+        path stays single-mesh."""
+        if self.sp_axis is not None \
+                or (self.tp_axis is not None and not tp_ok):
             raise NotImplementedError(
-                "incremental decode runs single-mesh (no sp/tp axis); "
-                "build a plain TransformerLM for serving")
+                "incremental decode runs single-mesh (no sp axis; tp "
+                "only on the paged trio via serving/tp.py); build a "
+                "plain TransformerLM for dense-cache serving")
         if self.cfg.moe_experts:
             raise NotImplementedError(
                 "incremental decode for MoE FFNs (routing is per-token; "
@@ -507,7 +533,16 @@ class TransformerLM(Module):
                      for l in range(self.cfg.num_layers))
 
     def _dense_ffn(self, y, bp):
+        """Serving FFN. Under `tp_axis` (paged trio inside shard_map)
+        w1/b1 arrive column-sharded: the gelu hidden is computed
+        locally (1/tp of the up-projection flops), then
+        tp_shard_gather concatenates the disjoint hidden shards so the
+        w2 gemm keeps its FULL contraction extent over a replicated
+        w2 — bitwise identical to the unsharded step (the down-proj
+        flops are the price of bit-identity; see tp_shard_gather)."""
         y = jax.nn.gelu(y @ bp["w1"] + bp["b1"])
+        if self.tp_axis is not None:
+            y = tp_shard_gather(y, self.tp_axis)
         return y @ bp["w2"] + bp["b2"]
 
     def prefill(self, variables, tokens, cache, lengths=None):
@@ -570,7 +605,7 @@ class TransformerLM(Module):
         scratch block (ops/kv_cache.py)."""
         from bigdl_tpu.ops.kv_cache import init_block_pool
 
-        self._serving_guard()
+        self._serving_guard(tp_ok=True)
         c = self.cfg
         return tuple(
             dict(zip(("k", "v"), init_block_pool(
@@ -595,13 +630,21 @@ class TransformerLM(Module):
         which is what makes the written KV bitwise identical whether a
         position is computed cold (start=0, one big bucket) or warm
         (nonzero start, a small suffix bucket): all reductions keep
-        the same shape (ops/kv_cache.py module docstring)."""
+        the same shape (ops/kv_cache.py module docstring).
+
+        Tensor parallelism (ISSUE 10, inside shard_map via
+        serving/tp.py): wq/wk/wv arrive column-sharded by HEAD and the
+        pools head-sharded, so each shard prefills its own heads'
+        blocks — the attention reductions are per-head (a pure batch
+        split, bitwise invariant) and the block table is a replicated
+        host-side operand, identical on every shard. tp_shard_gather
+        then rebuilds the full attention output so the wo gemm keeps
+        its full contraction extent (bitwise == unsharded)."""
         from bigdl_tpu.ops.kv_cache import (block_attention,
                                             gather_block_cache,
                                             write_prompt_blocks)
 
-        self._serving_guard()
-        c = self.cfg
+        self._serving_guard(tp_ok=True)
         p = variables["params"] if "params" in variables else variables
         bsz, s = tokens.shape
         if bsz != 1:
@@ -615,13 +658,14 @@ class TransformerLM(Module):
         new_pools = []
         visible = valid = None
         for bp, pl in zip(self._layer_blocks(p), pools):
+            h = bp["wq"].shape[-1] // d     # local heads (= H/tp)
             y = self._ln(x, bp["ln1_g"], bp["ln1_b"])
             q = (y @ bp["wq"] + bp["bq"]).reshape(
-                bsz, s, c.num_heads, d).transpose(0, 2, 1, 3)
+                bsz, s, h, d).transpose(0, 2, 1, 3)
             k = (y @ bp["wk"] + bp["bk"]).reshape(
-                bsz, s, c.num_heads, d).transpose(0, 2, 1, 3)
+                bsz, s, h, d).transpose(0, 2, 1, 3)
             v = (y @ bp["wv"] + bp["bv"]).reshape(
-                bsz, s, c.num_heads, d).transpose(0, 2, 1, 3)
+                bsz, s, h, d).transpose(0, 2, 1, 3)
             kp, vp = write_prompt_blocks(pl["k"], pl["v"], k, v,
                                          block_ids)
             new_pools.append({"k": kp, "v": vp})
@@ -634,7 +678,9 @@ class TransformerLM(Module):
                            <= ipos[None, :, None])  # (1, s, S_tab)
                 valid = (jpos[None, :] < start + s)  # (1, S_tab)
             a = block_attention(q, kc, vc, visible, valid)
-            a = a.transpose(0, 2, 1, 3).reshape(bsz, s, c.num_heads * d)
+            a = a.transpose(0, 2, 1, 3).reshape(bsz, s, h * d)
+            if self.tp_axis is not None:
+                a = tp_shard_gather(a, self.tp_axis)
             x = x + a @ bp["wo"] + bp["bo"]
             x = x + self._dense_ffn(
                 self._ln(x, bp["ln2_g"], bp["ln2_b"]), bp)
@@ -648,12 +694,20 @@ class TransformerLM(Module):
         row's write position at a shared block) — then attends through
         the gathered table. Same per-ROW isolation contract as
         decode_step: a non-finite row contaminates only its own logits
-        and its own exclusive blocks."""
+        and its own exclusive blocks.
+
+        Tensor parallelism (ISSUE 10): same construction as
+        prefill_paged — head-sharded pools and head-column-sharded qkv
+        make the attention a pure per-head batch split over a
+        REPLICATED host-side block table; tp_shard_gather rebuilds the
+        full attention output (and _dense_ffn the full mlp hidden) so
+        every downstream contraction keeps its unsharded extent and
+        the logits come out replicated AND bitwise identical to
+        tp=1."""
         from bigdl_tpu.ops.kv_cache import (paged_attention,
                                             write_decode_blocks)
 
-        self._serving_guard()
-        c = self.cfg
+        self._serving_guard(tp_ok=True)
         p = variables["params"] if "params" in variables else variables
         bsz = tokens.shape[0]
         d = self.head_dim
@@ -665,18 +719,21 @@ class TransformerLM(Module):
 
         new_pools = []
         for bp, pl in zip(self._layer_blocks(p), pools):
+            h = bp["wq"].shape[-1] // d     # local heads (= H/tp)
             y = self._ln(x, bp["ln1_g"], bp["ln1_b"])
             q = (y @ bp["wq"] + bp["bq"]).reshape(
-                bsz, 1, c.num_heads, d).transpose(0, 2, 1, 3)
+                bsz, 1, h, d).transpose(0, 2, 1, 3)
             k = (y @ bp["wk"] + bp["bk"]).reshape(
-                bsz, 1, c.num_heads, d).transpose(0, 2, 1, 3)
+                bsz, 1, h, d).transpose(0, 2, 1, 3)
             v = (y @ bp["wv"] + bp["bv"]).reshape(
-                bsz, 1, c.num_heads, d).transpose(0, 2, 1, 3)
+                bsz, 1, h, d).transpose(0, 2, 1, 3)
             kp, vp = write_decode_blocks(pl["k"], pl["v"], k, v,
                                          block_ids, offsets)
             new_pools.append({"k": kp, "v": vp})
-            a = paged_attention(q, kp, vp, table, pos)  # (B, H, 1, D)
-            a = a.transpose(0, 2, 1, 3).reshape(bsz, c.num_heads * d)
+            a = paged_attention(q, kp, vp, table, pos)  # (B, h, 1, D)
+            a = a.transpose(0, 2, 1, 3).reshape(bsz, h * d)
+            if self.tp_axis is not None:
+                a = tp_shard_gather(a, self.tp_axis)
             x = x + a @ bp["wo"] + bp["bo"]
             x = x + self._dense_ffn(
                 self._ln(x, bp["ln2_g"], bp["ln2_b"]), bp)

@@ -32,6 +32,12 @@ fine; these are the wired ones):
                         matched_tokens, blocks (ISSUE 8)
     prefix_evict        LRU prefix blocks evicted under pool
                         pressure: blocks
+    handoff_export / handoff_import / router_handoff
+                        disaggregated prefill (ISSUE 10): a prefill-
+                        role engine detaches a prefilled request
+                        (request, prompt_len, blocks), a serving
+                        engine seats it (+ source), and the router
+                        records the move (source, target)
     metrics_snapshot    a full registry snapshot embedded as an event
                         (obs.log_metrics_snapshot) — gives a JSONL file
                         self-contained percentiles for obs_report

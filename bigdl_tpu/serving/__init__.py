@@ -10,18 +10,25 @@ from bigdl_tpu.serving.bucketing import (bucket_for, bucket_histogram,
                                          pad_tokens)
 from bigdl_tpu.serving.engine import (STATUSES, EngineDegraded,
                                       EngineDraining, GenerationResult,
-                                      InferenceEngine, OverloadError,
-                                      Request, StepTimeout)
+                                      HandoffPackage, InferenceEngine,
+                                      OverloadError, Request,
+                                      StepTimeout)
 from bigdl_tpu.serving.kv_pool import BlockPool
 from bigdl_tpu.serving.prefix_cache import RadixPrefixCache
 from bigdl_tpu.serving.router import (EngineRouter, NoHealthyEngine,
                                       ROUTER_LATENCY_BUCKETS)
 from bigdl_tpu.serving.sampler import filter_logits, sample_logits
+from bigdl_tpu.serving.tp import (TPServingLM, gather_serving_params,
+                                  shard_serving_params,
+                                  tp_serving_model, tp_serving_specs)
 
 __all__ = [
     "InferenceEngine", "Request", "GenerationResult", "STATUSES",
     "OverloadError", "StepTimeout", "EngineDegraded", "EngineDraining",
-    "EngineRouter", "NoHealthyEngine", "ROUTER_LATENCY_BUCKETS",
+    "HandoffPackage", "EngineRouter", "NoHealthyEngine",
+    "ROUTER_LATENCY_BUCKETS",
+    "TPServingLM", "tp_serving_model", "tp_serving_specs",
+    "gather_serving_params", "shard_serving_params",
     "Autoscaler", "BlockPool", "RadixPrefixCache",
     "sample_logits", "filter_logits",
     "bucket_for", "bucket_histogram", "default_buckets", "pad_tokens",

@@ -109,6 +109,31 @@ The engine is model-agnostic over anything exposing
 are a pytree of block-leading leaves (and, optionally,
 `serving_params(variables)` for a fast weight layout) — the paged
 trio models/transformer.py implements.
+
+Tensor-parallel sharding (ISSUE 10): `tp_mesh=` swaps the model for
+the memoized `serving/tp.py` wrapper — weights and the per-layer KV
+pool shard over the mesh (pool on the HEAD axis, so the block table
+and every host-side invariant here stay byte-identical), the jitted
+steps trace shard_map'd bodies, and the emitted tokens are BITWISE
+identical to the unsharded engine (the tp_shard_gather construction).
+Everything in this file is layout-blind: slots, tables, the radix
+tree, overload/poison/watchdog handling never ask how many shards
+serve them — which is exactly what lets a tp=2 engine fail over to an
+unsharded survivor with bit-identical rerouted tokens (the
+fleet_tp_failover drill).
+
+Disaggregated prefill (ISSUE 10 stretch): `role="prefill"` turns an
+engine into a prefill tier — step() admits and prefills as usual, but
+then EXPORTS each filled slot's KV block contents as a host-side
+HandoffPackage instead of decoding (take_handoffs() drains them) —
+and `import_handoff()` on a serving engine seats a package directly
+into a slot + fresh pool blocks, skipping prefill entirely. The block
+contents are bitwise what the importer's own prefill would have
+written (the same full-extent-reduction discipline that makes warm ==
+cold), so a handed-off request decodes bit-identically to a
+single-engine run — across sharding layouts, since prefill bits are
+tp-invariant. The router (serving/router.py handoff path) moves the
+packages so long prompts never stall a decode engine's token streams.
 """
 
 from __future__ import annotations
@@ -247,6 +272,21 @@ class Request:
 
 
 @dataclass
+class HandoffPackage:
+    """One prefilled request, detached from its prefill engine
+    (disaggregated prefill, ISSUE 10): the original Request, the
+    host-side KV block contents its prefill wrote (per-layer
+    {'k','v'} arrays of shape (nb, H, block_size, D) — GLOBAL arrays,
+    so the package moves between sharding layouts), and the original
+    submit stamp (the importer re-stamps its meta with it, so
+    TTFT/latency tell the whole truth across the handoff)."""
+    request: Request
+    kv: Tuple[Dict[str, object], ...]
+    submit_t: float
+    source: str
+
+
+@dataclass
 class GenerationResult:
     """`status` is the terminal lifecycle state (one of STATUSES):
     'done' (finish_reason: "stop_id" | "max_tokens" | "cache_full"),
@@ -294,7 +334,15 @@ class InferenceEngine:
     the reserved scratch block 0; default slots * cache_len //
     block_size + 1 — dense-capacity parity), `prefix_cache` (False
     disables radix reuse — every admission prefills cold; the bench's
-    cold-baseline column)."""
+    cold-baseline column).
+
+    Sharding knobs (ISSUE 10; constructor args, never env):
+    `tp_mesh` + `tp_axis` — serve through the serving/tp.py wrapper:
+    weights and KV pool shard over the mesh (pool on the head axis),
+    tokens stay BITWISE identical to the unsharded engine.
+    `role='prefill'` turns the engine into a disaggregated-prefill
+    tier (step() exports HandoffPackages instead of decoding);
+    'decode' is a topology label serving exactly like 'both'."""
 
     def __init__(self, model, variables=None, slots: int = 4,
                  max_len: Optional[int] = None,
@@ -309,8 +357,48 @@ class InferenceEngine:
                  step_retries: int = 0,
                  retry_backoff_s: float = 0.05,
                  clock: Callable[[], float] = time.monotonic,
-                 obs_label: Optional[str] = None):
+                 obs_label: Optional[str] = None,
+                 tp_mesh=None, tp_axis: str = "model",
+                 role: str = "both"):
+        if tp_mesh is not None:
+            # memoized: engines over the same (model, mesh, axis)
+            # share one wrapper and therefore every jitted executable
+            # (serving/tp.py) — a sharded model passed directly as
+            # `model` (e.g. by a fleet factory) works identically
+            from bigdl_tpu.serving.tp import tp_serving_model
+
+            model = tp_serving_model(model, tp_mesh, tp_axis)
+        elif getattr(model, "tp_axis", None) is not None:
+            # a training-TP model's paged trio would trace
+            # tp_shard_gather's all_gather with no mesh bound to the
+            # axis — a cryptic deep-trace failure; refuse here with
+            # the fix in hand
+            raise ValueError(
+                f"model has tp_axis={model.tp_axis!r} armed (training "
+                "tensor parallelism): serve it sharded via "
+                "InferenceEngine(tp_mesh=...), which wraps it through "
+                "serving/tp.py — or build a plain TransformerLM for "
+                "unsharded serving")
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"role {role!r}: expected 'both', "
+                             "'prefill' or 'decode'")
+        if role == "prefill" and (step_timeout_s is not None
+                                  or step_retries):
+            # the watchdog/retry machinery wraps the DECODE dispatch,
+            # which a prefill tier never runs — accepting the knobs
+            # would promise a guard that cannot trip
+            raise ValueError(
+                "step_timeout_s/step_retries on a prefill-role "
+                "engine: the watchdog and retry budget guard the "
+                "decode dispatch, which role='prefill' never runs")
+        # 'decode' is a fleet-topology label — it serves exactly like
+        # 'both' (handoff imports AND direct admissions); 'prefill'
+        # changes step() into the export path
+        self.role = role
         self.model = model
+        # tp degree for telemetry/provenance (1 = unsharded); the
+        # serving/tp.py wrapper carries it, plain models don't
+        self.tp = int(getattr(model, "tp", 1))
         self.variables = variables if variables is not None \
             else model.variables
         # one-time repack into the per-layer serving layout (stacked
@@ -381,6 +469,7 @@ class InferenceEngine:
             "prefix_hits": 0, "prefix_blocks_reused": 0,
             "prefix_tokens_saved": 0, "prefix_bytes_saved": 0,
             "pool_evictions": 0,
+            "handoffs_out": 0, "handoffs_in": 0,
         }
         # ---- telemetry plane (ISSUE 5): every _stats increment also
         # mirrors into the process-wide registry under this engine's
@@ -396,11 +485,15 @@ class InferenceEngine:
         # instead of growing the registry with one label set per
         # rebuild.
         self._obs_name = obs_label or f"engine{next(_ENGINE_IDS)}"
+        # ISSUE 10: every engine series carries its tensor-parallel
+        # shard count as a label ("1" unsharded), so fleet dashboards
+        # can split traffic by layout without new metric families
+        self._obs_tp = str(self.tp)
         reg = obs.get_registry()
         self._m_requests = reg.counter(
             "serving_requests_total",
             "requests reaching a terminal status",
-            labelnames=("engine", "status"))
+            labelnames=("engine", "status", "tp"))
         op_help = {
             "prefill_calls": "prefill dispatches",
             "decode_steps": "batched decode steps",
@@ -417,20 +510,33 @@ class InferenceEngine:
                                   "prefix hits",
             "pool_evictions": "LRU prefix blocks evicted under pool "
                               "pressure",
+            "handoffs_out": "prefilled requests exported for "
+                            "disaggregated decode",
+            "handoffs_in": "prefilled requests imported from a "
+                           "prefill tier",
         }
         self._m_ops = {
             key: reg.counter(f"serving_{key}_total", help_,
-                             labelnames=("engine",)
-                             ).labels(engine=self._obs_name)
+                             labelnames=("engine", "tp")
+                             ).labels(engine=self._obs_name,
+                                      tp=self._obs_tp)
             for key, help_ in op_help.items()}
         self._m_lat = reg.histogram(
             "serving_decode_step_seconds",
             "decode dispatch+fetch wall seconds",
-            labelnames=("engine",)).labels(engine=self._obs_name)
+            labelnames=("engine", "tp")).labels(
+                engine=self._obs_name, tp=self._obs_tp)
         self._m_pool_gauge = reg.gauge(
             "serving_kv_pool_blocks_in_use",
             "KV pool blocks held by live requests or cached prefixes",
+            labelnames=("engine", "tp")).labels(
+                engine=self._obs_name, tp=self._obs_tp)
+        self._m_tp_gauge = reg.gauge(
+            "serving_tp_shards",
+            "tensor-parallel shard count serving this engine",
             labelnames=("engine",)).labels(engine=self._obs_name)
+        if obs.enabled():
+            self._m_tp_gauge.set(self.tp)
         self._trace0 = dict(_TRACES)
         # finished results not yet handed back by a run(requests=...)
         # call — retrievable here (results are never silently dropped)
@@ -456,6 +562,8 @@ class InferenceEngine:
         self._meta: Dict[int, Dict[str, float]] = {}  # id → submit time
         self._degraded: Optional[str] = None
         self._draining = False
+        # prefill-role export queue, drained by take_handoffs()
+        self._handoffs: List[HandoffPackage] = []
         if step_timeout_s is not None:
             # arming the watchdog opts into a warmup decode at
             # construction: the FIRST decode call traces+compiles
@@ -552,6 +660,10 @@ class InferenceEngine:
         return {
             "state": state,
             "degraded_reason": self._degraded,
+            "tp": self.tp,
+            "role": self.role,
+            "handoffs_out": s["handoffs_out"],
+            "handoffs_in": s["handoffs_in"],
             "slots": self.slots,
             "slots_active": self.slots_active,
             "queue_depth": self.queue_depth,
@@ -731,7 +843,8 @@ class InferenceEngine:
         status = _COUNTER_STATUS.get(key)
         if status is not None:
             self._m_requests.labels(engine=self._obs_name,
-                                    status=status).inc(n)
+                                    status=status,
+                                    tp=self._obs_tp).inc(n)
         else:
             self._m_ops[key].inc(n)
 
@@ -824,6 +937,11 @@ class InferenceEngine:
         if obs.enabled():
             self._m_pool_gauge.set(self._pool_mgr.capacity
                                    - self._pool_mgr.free_count)
+            # re-asserted alongside the pool gauge (not only at
+            # construction) so an engine built under BIGDL_OBS=off
+            # reports its layout once telemetry is switched on, like
+            # every counter series does
+            self._m_tp_gauge.set(self.tp)
 
     def _admit(self):
         self._expire_queued(self._clock())
@@ -988,6 +1106,9 @@ class InferenceEngine:
         self.pool = jax.tree_util.tree_map(
             lambda leaf: leaf.at[idx].set(jnp.zeros((), leaf.dtype)),
             self.pool)
+        if hasattr(self.model, "place_pools"):
+            # keep the tp head-axis placement through the eager scrub
+            self.pool = self.model.place_pools(self.pool)
 
     def _cache_consumed(self) -> bool:
         """True if any pool leaf's buffer was donated/deleted by a
@@ -1101,6 +1222,185 @@ class InferenceEngine:
             self._slot_blocks[i][1].append(new[0])
         return done
 
+    # -------------------------------------------- disaggregated prefill
+    def _step_prefill(self) -> List[GenerationResult]:
+        """Prefill-tier scheduling round (role='prefill'): admit +
+        prefill exactly like a serving engine — same buckets, same
+        radix prefix reuse, same executables — then export every
+        filled slot as a HandoffPackage instead of decoding. The slot
+        frees immediately, so one prefill engine pipelines a stream of
+        long prompts without ever holding decode capacity; queued-TTL
+        expiries (inside _admit) settle into `completed` as usual."""
+        self._admit()
+        for i, req in enumerate(self._req):
+            if req is not None:
+                self._export_handoff(i)
+        return []
+
+    def _export_handoff(self, slot: int) -> HandoffPackage:
+        """Package one prefilled slot for disaggregated decode: fetch
+        the prompt's KV block contents to host — ONE deliberate
+        device→host transfer per REQUEST (the disaggregation boundary;
+        priced like a prefill, never per-token) — then free the slot.
+        The exported arrays are GLOBAL values, so the package imports
+        into any sharding layout (tp included) bit-identically; the
+        freed blocks park in this engine's radix tree, so repeated
+        long prompts amortize their prefill here too."""
+        req = self._req[slot]
+        n = len(req.prompt)
+        nb = -(-n // self.block_size)           # blocks covering [0, n)
+        idx = jnp.asarray([int(b) for b in self._table[slot, :nb]],
+                          jnp.int32)
+        kv = jax.device_get(tuple(                                   # graftlint: disable=hidden-device-sync — the one deliberate handoff fetch, once per request (the disaggregation boundary), never per token or per layer: device_get over the whole indexed tree batches all layers into a single transfer
+            {k: leaf[idx] for k, leaf in layer.items()}
+            for layer in self.pool))
+        meta = self._meta.pop(req.id, None)
+        pkg = HandoffPackage(req, kv,
+                             meta["t"] if meta else self._clock(),
+                             self._obs_name)
+        self._req[slot] = None
+        self._gen[slot] = []
+        self._temp[slot] = 0.0
+        self._release_slot(slot)
+        self._handoffs.append(pkg)
+        self._bump("handoffs_out")
+        obs.emit_event("handoff_export", plane="serving",
+                       engine=self._obs_name, request=req.id,
+                       prompt_len=n, blocks=nb)
+        return pkg
+
+    def take_handoffs(self) -> List[HandoffPackage]:
+        """Drain the packages a prefill-role engine exported (the
+        router's harvest point; empty on serving-role engines)."""
+        out, self._handoffs = self._handoffs, []
+        return out
+
+    def import_handoff(self, pkg: HandoffPackage) -> bool:
+        """Seat a prefilled package directly into a slot, skipping
+        prefill: allocate exclusive blocks (LRU-evicting cached
+        prefixes under pressure), scatter the imported contents into
+        the pool, point the slot's table row at them, and enter the
+        decode loop at clock len(prompt)-1 — the first decode step
+        re-decodes the last prompt token exactly as a locally
+        prefilled request would, and the tokens come out BIT-IDENTICAL
+        (the contents are bitwise what local prefill writes — the
+        full-extent-reduction discipline, ops/kv_cache.py). False when
+        no free slot or insufficient blocks (the caller retries next
+        round). The prompt's pre-COW-cap blocks register in the radix
+        tree, so a handed-off prompt seeds prefix reuse here too."""
+        if self.role == "prefill":
+            raise ValueError("import_handoff on a prefill-role engine")
+        if self._degraded:
+            raise EngineDegraded(
+                f"engine degraded ({self._degraded}); hand off to a "
+                "healthy engine")
+        if self._draining:
+            raise EngineDraining(
+                "engine is draining (stop-admission): hand off to "
+                "another engine in the pool")
+        req = pkg.request
+        in_flight = {r.id for r in self._queue} \
+            | {r.id for r in self._req if r is not None} \
+            | set(self.completed)
+        if req.id in in_flight:
+            raise ValueError(f"request id {req.id} already in flight "
+                             "or completed-unclaimed")
+        pkg_bs = int(pkg.kv[0]["k"].shape[2])
+        if len(pkg.kv) != len(self.pool) \
+                or pkg_bs != self.block_size \
+                or pkg.kv[0]["k"].shape[1:] != self.pool[0]["k"].shape[1:] \
+                or pkg.kv[0]["k"].dtype != self.pool[0]["k"].dtype:
+            # config error, not transient pressure: a mismatched fleet
+            # (different block_size/model/cache dtype) can never seat
+            # this package — a silent dtype cast in particular would
+            # break the handoff bit-identity contract, not just crash
+            raise ValueError(
+                f"handoff package layout {len(pkg.kv)} layers x "
+                f"{tuple(pkg.kv[0]['k'].shape[1:])} (block_size "
+                f"{pkg_bs}, {pkg.kv[0]['k'].dtype}) does not match "
+                f"this engine's {len(self.pool)} layers x "
+                f"{tuple(self.pool[0]['k'].shape[1:])} (block_size "
+                f"{self.block_size}, {self.pool[0]['k'].dtype}) — "
+                "prefill and decode tiers must share model, "
+                "block_size and cache_dtype")
+        free = self._free_slots()
+        if not free:
+            return False
+        prompt = list(req.prompt)
+        n = len(prompt)
+        nb = int(pkg.kv[0]["k"].shape[0])
+        if nb > self._table.shape[1]:
+            # prompt spans more blocks than one slot's table row can
+            # hold here (importer has a shorter max_len) — the backlog
+            # retries and run()'s stuck-backlog guard names the cause
+            return False
+        bs = self.block_size
+        hit: List[int] = []
+        if self.prefix_cache_enabled:
+            # same lookup + COW cap as _admit_into: blocks the
+            # importer already caches for this prefix are REUSED, not
+            # re-scattered — their content is bitwise the package's
+            # content for the same tokens (warm == cold), and without
+            # this the allocator would evict the cached chain to make
+            # room for its own duplicate under pool pressure
+            hit = self._prefix.lookup(prompt, (n - 1) // bs)
+        nh = len(hit)
+        # pin the hit chain BEFORE allocating (the _admit_into rule:
+        # LRU eviction must never eat the chain this import matched)
+        self._pool_mgr.ref(hit)
+        new = self._alloc_blocks(nb - nh)
+        if new is None:
+            self._pool_mgr.unref(hit)     # back to cached parking
+            return False
+        slot = free[0]
+        idx = jnp.asarray(new, jnp.int32)
+        self.pool = tuple(
+            {k: leaf.at[idx].set(jnp.asarray(pkg.kv[li][k][nh:]))
+             for k, leaf in layer.items()}
+            for li, layer in enumerate(self.pool))
+        if hasattr(self.model, "place_pools"):
+            # host-side scatter may drop the tp head-axis placement —
+            # re-commit so the jitted steps keep their shardings
+            self.pool = self.model.place_pools(self.pool)
+        row = self._table[slot]
+        row[:] = 0
+        row[:nh] = hit
+        row[nh:nb] = new
+        self._req[slot] = req
+        self._gen[slot] = []
+        self._slot_blocks[slot] = [list(hit), list(new)]
+        self._pos[slot] = n - 1         # re-decode last prompt token
+        self._tok[slot] = prompt[-1]
+        self._nout[slot] = 0
+        self._seed[slot] = req.seed
+        self._temp[slot] = req.temperature
+        self._topk[slot] = req.top_k
+        self._topp[slot] = req.top_p
+        self._meta[req.id] = {"t": pkg.submit_t}
+        if self.prefix_cache_enabled:
+            cap_blocks = (n - 1) // bs
+            if cap_blocks:
+                owned = self._prefix.insert(
+                    prompt, [int(x) for x in row[:cap_blocks]])
+                for bid in owned:
+                    self._pool_mgr.mark_cached(bid)
+        if nh:
+            # hits/blocks count like any admission; tokens/bytes-saved
+            # stay prefill-side metrics — this import skipped a
+            # SCATTER, the prefill itself already ran on the exporter
+            self._bump("prefix_hits")
+            self._bump("prefix_blocks_reused", nh)
+            obs.emit_event("prefix_hit", plane="serving",
+                           engine=self._obs_name, request=req.id,
+                           matched_tokens=nh * bs, blocks=nh,
+                           prompt_len=n)
+        self._update_pool_gauge()
+        self._bump("handoffs_in")
+        obs.emit_event("handoff_import", plane="serving",
+                       engine=self._obs_name, request=req.id,
+                       prompt_len=n, blocks=nb, source=pkg.source)
+        return True
+
     def step(self) -> List[GenerationResult]:
         """Admit queued requests into free slots, run ONE decode step
         over all slots, evict finished/poisoned/expired sequences.
@@ -1109,6 +1409,8 @@ class InferenceEngine:
         and returns every in-flight/queued request as 'failed'."""
         if self._degraded:
             return []
+        if self.role == "prefill":
+            return self._step_prefill()
         self._admit()
         done = self._ensure_blocks()
         if all(r is None for r in self._req):
@@ -1209,6 +1511,14 @@ class InferenceEngine:
         never dropped. Shed/expired/poisoned/failed requests return
         with their terminal status (never a KeyError); a 'reject'
         overload raises OverloadError out of the submission phase."""
+        if self.role == "prefill":
+            # step() exports instead of finishing, so nothing would
+            # ever land in completed — drive a prefill tier through an
+            # EngineRouter, which harvests take_handoffs()
+            raise ValueError(
+                "run() on a prefill-role engine: it exports "
+                "HandoffPackages instead of decoding — front it with "
+                "EngineRouter(prefill_engines=[...])")
         ids = [self.submit(r) for r in requests] if requests else None
         while self._queue or any(r is not None for r in self._req):
             for res in self.step():

@@ -52,6 +52,25 @@ surviving failover) is fed unconditionally — the Autoscaler's SLO
 input and health() percentiles are core bookkeeping, like the
 engine's decode histogram.
 
+* **Disaggregated prefill (ISSUE 10).** `prefill_engines=` adds a
+  prefill tier in front of the pool: prompts of `handoff_len` tokens
+  or more route to a `role='prefill'` engine, whose step() exports
+  each prefilled request's KV block contents as a HandoffPackage
+  instead of decoding; `handoff()` then seats the package on the
+  least-loaded serving engine (engine.import_handoff — slot + fresh
+  blocks + table surgery, no prefill), so a long prompt's bucket-wide
+  prefill never stalls a decode engine's token streams. The block
+  contents are bitwise what the importer's own prefill would write —
+  across sharding layouts, since prefill bits are tp-invariant
+  (serving/tp.py) — so handed-off requests finish bit-identical to a
+  single-engine run (tests/test_tp_serving.py pins it). Packages that
+  cannot seat (slots full, pool pressure) stay in a backlog and retry
+  every round; a degraded prefill engine's held requests fail over to
+  the serving pool, which simply prefills them in place (defensive:
+  today nothing degrades a prefill tier — the watchdog/retry budget
+  guard only the decode dispatch, and the engine refuses those knobs
+  on role='prefill').
+
 Engines fronted by a router are driven ONLY through it (the router
 harvests `engine.completed`; a concurrent engine.run() would race the
 harvest).
@@ -114,10 +133,29 @@ class EngineRouter:
                  engine_factory: Optional[
                      Callable[[], InferenceEngine]] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 obs_label: Optional[str] = None):
+                 obs_label: Optional[str] = None,
+                 prefill_engines: Sequence[InferenceEngine] = (),
+                 handoff_len: Optional[int] = None):
         if not engines:
             raise ValueError("EngineRouter needs at least one engine")
+        for eng in prefill_engines:
+            if eng.role != "prefill":
+                raise ValueError(
+                    "prefill_engines must be role='prefill' engines "
+                    f"(got role={eng.role!r})")
+        if handoff_len is not None and not prefill_engines:
+            raise ValueError("handoff_len without prefill_engines")
         self.engines: List[InferenceEngine] = list(engines)
+        self.prefill_engines: List[InferenceEngine] = \
+            list(prefill_engines)
+        # prompts >= handoff_len route to the prefill tier; with
+        # prefill engines present the default (1) sends everything
+        # through it — set the threshold where "long prompt" starts
+        # for your buckets
+        self.handoff_len = 1 if (prefill_engines
+                                 and handoff_len is None) \
+            else handoff_len
+        self._handoff_backlog: List[object] = []
         self.engine_factory = engine_factory
         self._clock = clock
         self.completed: Dict[int, GenerationResult] = {}
@@ -134,6 +172,7 @@ class EngineRouter:
             "dispatched": 0, "spillover": 0, "failover": 0,
             "failover_lost": 0, "rejected": 0, "rebalanced": 0,
             "engines_added": 0, "engines_removed": 0,
+            "prefill_dispatched": 0, "handoffs": 0,
         }
         self._obs_name = obs_label or f"router{next(_ROUTER_IDS)}"
         reg = obs.get_registry()
@@ -153,6 +192,10 @@ class EngineRouter:
                                  "surviving engine to take them",
                 "rejected": "submissions rejected by every engine",
                 "rebalanced": "queued requests moved between engines",
+                "prefill_dispatched": "requests routed to the "
+                                      "disaggregated prefill tier",
+                "handoffs": "prefilled packages seated on serving "
+                            "engines",
             }.items()}
         self._m_pool = reg.gauge(
             "router_pool_size", "engines in the pool",
@@ -186,18 +229,28 @@ class EngineRouter:
         return [e for e in self.engines
                 if e.degraded is None and not e.draining]
 
-    def _ranked(self) -> List[InferenceEngine]:
+    @staticmethod
+    def _rank(engines) -> List[InferenceEngine]:
         """Healthy engines by load, least-loaded first; ties break on
         pool index (deterministic dispatch)."""
         scored = [((e.slots_active + e.queue_depth) / max(e.slots, 1),
                    i, e)
-                  for i, e in enumerate(self.engines)
+                  for i, e in enumerate(engines)
                   if e.degraded is None and not e.draining]
         return [e for _, _, e in sorted(scored, key=lambda s: s[:2])]
 
+    def _ranked(self) -> List[InferenceEngine]:
+        return self._rank(self.engines)
+
+    def _ranked_prefill(self) -> List[InferenceEngine]:
+        """Healthy prefill-tier engines, least-loaded first (the same
+        ranking as the serving pool — one formula, two pools)."""
+        return self._rank(self.prefill_engines)
+
     def _resolve(self, engine) -> InferenceEngine:
         if isinstance(engine, InferenceEngine):
-            if engine not in self.engines:
+            if engine not in self.engines \
+                    and engine not in self.prefill_engines:
                 raise ValueError("engine is not in this router's pool")
             return engine
         return self.engines[engine]
@@ -220,6 +273,25 @@ class EngineRouter:
                 or request.id in self.completed:
             raise ValueError(f"request id {request.id} already in "
                              "flight or completed-unclaimed")
+        # disaggregated prefill: long prompts go to the prefill tier
+        # (falling back to in-place prefill on the serving pool when
+        # every prefill engine is unhealthy or rejects)
+        if self.handoff_len is not None \
+                and len(request.prompt) >= self.handoff_len:
+            for eng in self._ranked_prefill():
+                try:
+                    eng.submit(request)
+                except OverloadError:
+                    continue
+                self._pending[request.id] = _Assignment(
+                    request, eng, next(self._seq), self._clock())
+                self._bump("dispatched")
+                self._bump("prefill_dispatched")
+                if obs.enabled():
+                    self._m_dispatch.labels(
+                        router=self._obs_name,
+                        engine=eng.obs_name).inc()
+                return request.id
         order = self._ranked()
         if not order:
             raise NoHealthyEngine(
@@ -388,6 +460,21 @@ class EngineRouter:
         self._rebalance()
         out: List[GenerationResult] = list(self._settled_backlog)
         self._settled_backlog.clear()
+        # prefill tier first: admit+prefill+export, then seat the
+        # packages (fresh and backlogged) on the serving pool — a
+        # package that cannot seat this round (slots full, pool
+        # pressure) retries next round; a degraded prefill engine's
+        # held requests fail over through _harvest/_settle to the
+        # serving pool, which prefills them in place
+        for eng in list(self.prefill_engines):
+            if eng.degraded is None:
+                eng.step()
+            self._handoff_backlog.extend(eng.take_handoffs())
+            self._harvest(eng, out)
+        if self._handoff_backlog:
+            self._handoff_backlog = [
+                pkg for pkg in self._handoff_backlog
+                if self.handoff(pkg) is None]
         for eng in list(self.engines):
             results = [] if eng.degraded is not None else eng.step()
             # in-flight failures first (admitted earlier), then the
@@ -401,6 +488,28 @@ class EngineRouter:
             self._harvest(eng, out)
         return out
 
+    def handoff(self, pkg) -> Optional[InferenceEngine]:
+        """Seat one prefilled HandoffPackage on the least-loaded
+        healthy serving engine (engine.import_handoff); None when no
+        engine can take it right now — the caller (step's backlog)
+        retries next round. Reassigns the request's pending entry to
+        the importer, so terminals and failover keep working across
+        the disaggregation boundary."""
+        for eng in self._ranked():
+            if not eng.import_handoff(pkg):
+                continue
+            asg = self._pending.get(pkg.request.id)
+            if asg is not None:
+                asg.engine = eng
+            self._bump("handoffs")
+            obs.emit_event("router_handoff", plane="serving",
+                           router=self._obs_name,
+                           request=pkg.request.id,
+                           source=pkg.source, target=eng.obs_name,
+                           blocks=len(pkg.kv[0]["k"]))
+            return eng
+        return None
+
     def run(self, requests: Optional[Sequence[Request]] = None
             ) -> List[GenerationResult]:
         """Submit `requests` (if given), then step the pool until every
@@ -408,10 +517,30 @@ class EngineRouter:
         (or, with no argument, everything that finished, id order) —
         identical semantics to InferenceEngine.run, one level up."""
         ids = [self.submit(r) for r in requests] if requests else None
-        while any(not e.idle for e in self.engines):
+        while any(not e.idle for e in self.engines) \
+                or any(not e.idle for e in self.prefill_engines) \
+                or self._handoff_backlog:
+            before = len(self._handoff_backlog)
+            # stuck-backlog detection must give a TRANSIENTLY
+            # unseatable package one more round: seating runs at the
+            # top of step(), so slots freed later in the same round
+            # are only retried next round — raise only when a round
+            # that STARTED with the whole pool idle (nothing left to
+            # free) still could not shrink the backlog
+            idle_before = all(e.idle for e in self.engines) \
+                and all(e.idle for e in self.prefill_engines)
             self.step()
-        for eng in self.engines:          # final sweep: late sheds
-            self._harvest(eng, None)
+            if (self._handoff_backlog
+                    and len(self._handoff_backlog) >= before
+                    and idle_before
+                    and all(e.idle for e in self.engines)
+                    and all(e.idle for e in self.prefill_engines)):
+                raise RuntimeError(
+                    f"{len(self._handoff_backlog)} handoff package(s) "
+                    "cannot be seated on any serving engine (prompt "
+                    "needs more blocks than a slot can hold?)")
+        for eng in list(self.engines) + list(self.prefill_engines):
+            self._harvest(eng, None)      # final sweep: late sheds
         # run() delivers through its return value — don't re-surface
         # these through a later step()
         self._settled_backlog.clear()
@@ -464,7 +593,10 @@ class EngineRouter:
             raise ValueError("engine still holds router-owned "
                              "requests; step() the pool first")
         self._harvest(eng, None)
-        self.engines.remove(eng)
+        if eng in self.engines:
+            self.engines.remove(eng)
+        else:
+            self.prefill_engines.remove(eng)
         self._bump("engines_removed")
         self._m_pool.set(len(self.engines))
         obs.emit_event("engine_removed", plane="serving",
@@ -488,6 +620,8 @@ class EngineRouter:
         return {
             "pool_size": len(self.engines),
             "healthy": len(healthy),
+            "prefill_engines": len(self.prefill_engines),
+            "handoff_backlog": len(self._handoff_backlog),
             "states": [h["state"] for h in per],
             "slots": sum(e.slots for e in healthy),
             "slots_active": sum(e.slots_active for e in healthy),

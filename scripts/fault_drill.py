@@ -104,6 +104,14 @@ bigdl_tpu/serving/router.py + autoscaler.py):
                     an autoscaled pool (grows to 3, rebalances the
                     backlog, holds the target) — decision sequence and
                     load report bit-identical across runs
+    fleet_tp_failover (ISSUE 10) the fleet_failover invariant ACROSS
+                    sharding layouts: the watchdog-tripped engine is
+                    tensor-parallel (tp=2 over the virtual mesh), the
+                    survivor is UNSHARDED — rerouted tokens must still
+                    be bit-identical to the undisturbed run, because
+                    sharded decode is bitwise == unsharded decode
+                    (serving/tp.py). Needs >= 2 devices (the 8-device
+                    XLA_FLAGS above); reports skipped=... on fewer
 
 Every training leg compares parameters BIT-FOR-BIT against an
 uninterrupted reference run (same init, same deterministic batch
@@ -995,6 +1003,62 @@ def drill_fleet_failover(workdir):
             "events": log.counts_by_kind()}
 
 
+def drill_fleet_tp_failover(workdir):
+    """fleet_failover ACROSS sharding layouts (ISSUE 10): serve_slow@2
+    trips the watchdog on a tp=2 SHARDED engine 0 of a 2-engine router
+    mid-decode; its in-flight and queued requests fail over to the
+    UNSHARDED engine 1 and finish with tokens BIT-IDENTICAL to an
+    undisturbed single-engine run. This holds only because sharded
+    decode is bitwise == unsharded decode (the tp_shard_gather
+    construction, serving/tp.py) — the PR 7 failover invariant never
+    learned what a layout is, and this leg pins that it never has to."""
+    import jax
+
+    if jax.device_count() < 2:
+        # the CLI without the 8-device XLA_FLAGS; tier-1 always runs
+        # under the virtual mesh (tests/conftest.py) and asserts this
+        # key is absent, so the drill cannot silently stop drilling
+        return {"ok": True,
+                "skipped": "needs >= 2 devices (run with XLA_FLAGS="
+                           "--xla_force_host_platform_device_count=8)"}
+    from bigdl_tpu.parallel import make_mesh
+    from bigdl_tpu.serving import EngineRouter
+
+    mesh = make_mesh({"model": 2}, devices=jax.devices()[:2])
+    specs = [dict(prompt=[i + 1, i + 2, i + 3], max_new_tokens=5,
+                  temperature=0.8, seed=60 + i) for i in range(6)]
+    ref = _engine(slots=2).run([_req(**s) for s in specs])
+    fm = _plan("serve_slow@2")
+    try:
+        with _telemetry() as log:
+            e0 = _engine(step_timeout_s=0.05, tp_mesh=mesh)
+            e1 = _engine()
+            router = EngineRouter([e0, e1])
+            got = router.run([_req(**s) for s in specs])
+    finally:
+        fm.set_plan(None)
+    degraded_ev = log.events("engine_degraded")
+    failover_ev = log.events("router_failover")
+    done_ev = log.events("request_terminal", status="done")
+    bit_identical = [g.tokens for g in got] == [r.tokens for r in ref]
+    ok = (e0.tp == 2 and e1.tp == 1
+          and e0.degraded is not None and "watchdog" in e0.degraded
+          and all(g.status == "done" for g in got)
+          and bit_identical
+          and router.stats["failover"] == 3      # 2 in-flight + 1 queued
+          and router.stats["failover_lost"] == 0
+          and len(failover_ev) == 3
+          and len(degraded_ev) == 1
+          and len(done_ev) == 6)
+    return {"ok": bool(ok),
+            "statuses": [g.status for g in got],
+            "bit_identical_to_undisturbed": bit_identical,
+            "failovers": router.stats["failover"],
+            "degraded_engine": e0.degraded,
+            "layouts": {"degraded_tp": e0.tp, "survivor_tp": e1.tp},
+            "events": log.counts_by_kind()}
+
+
 def drill_fleet_drain(workdir):
     """Drain engine 0 of a 2-engine router mid-traffic: its accepted
     work (in-flight + own queue) finishes normally while direct
@@ -1137,6 +1201,7 @@ SERVING_LEGS = {
     "fleet_failover": drill_fleet_failover,
     "fleet_drain": drill_fleet_drain,
     "fleet_autoscale": drill_fleet_autoscale,
+    "fleet_tp_failover": drill_fleet_tp_failover,
 }
 
 LEGS = {**TRAINING_LEGS, **SERVING_LEGS}

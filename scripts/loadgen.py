@@ -285,11 +285,18 @@ def build_fleet(engines: int = 1, *, slots: int = 4,
                 overload_policy: str = "reject",
                 clock: Optional[Dict[str, float]] = None,
                 autoscale: bool = False, target_p99_s: float = 8.0,
-                max_engines: int = 4, evaluate_every_s: float = 1.0):
+                max_engines: int = 4, evaluate_every_s: float = 1.0,
+                tp: Optional[int] = None, tp_axis: str = "model"):
     """Tiny-LM fleet for the CLI and the drills: a routed pool over
     ONE model object (engines share executables — #buckets+1 compiles
     total however large the pool grows), every clock the same virtual
-    cell. Returns (router, autoscaler-or-None, clk)."""
+    cell. Returns (router, autoscaler-or-None, clk).
+
+    `tp` (ISSUE 10) serves every engine tensor-parallel over the first
+    `tp` devices — one shared serving/tp.py wrapper, so the pool-wide
+    compile contract is unchanged and the emitted tokens are bitwise
+    the tp=None tokens. Needs `tp` devices (the 8-device XLA_FLAGS)
+    and tp must divide the tiny model's 2 heads."""
     import jax
 
     from bigdl_tpu.models.transformer import build_lm
@@ -300,6 +307,16 @@ def build_fleet(engines: int = 1, *, slots: int = 4,
     model = build_lm(vocab_size=50, dim=32, num_heads=2, num_layers=2,
                      max_len=max_len)
     model.build(jax.random.PRNGKey(0))
+    mesh = None
+    if tp:
+        from bigdl_tpu.parallel import make_mesh
+
+        if tp > jax.device_count():
+            raise ValueError(
+                f"--tp {tp} needs {tp} devices, have "
+                f"{jax.device_count()} (run with XLA_FLAGS="
+                "--xla_force_host_platform_device_count=8)")
+        mesh = make_mesh({tp_axis: tp}, devices=jax.devices()[:tp])
 
     def factory():
         return InferenceEngine(model, slots=slots,
@@ -307,7 +324,8 @@ def build_fleet(engines: int = 1, *, slots: int = 4,
                                block_size=block_size,
                                max_queue=max_queue,
                                overload_policy=overload_policy,
-                               clock=lambda: clk["t"])
+                               clock=lambda: clk["t"],
+                               tp_mesh=mesh, tp_axis=tp_axis)
 
     router = EngineRouter([factory() for _ in range(engines)],
                           engine_factory=factory,
@@ -355,6 +373,11 @@ def main(argv=None) -> int:
     ap.add_argument("--overload-policy", default="reject",
                     choices=("reject", "shed-oldest",
                              "shed-lowest-priority"))
+    ap.add_argument("--tp", type=int, default=None,
+                    help="serve every engine tensor-parallel over this "
+                         "many devices (ISSUE 10; needs the 8-device "
+                         "XLA_FLAGS and must divide the tiny model's "
+                         "2 heads — tokens stay bitwise == unsharded)")
     ap.add_argument("--autoscale", action="store_true")
     ap.add_argument("--target-p99", type=float, default=8.0)
     ap.add_argument("--max-engines", type=int, default=4)
@@ -389,9 +412,12 @@ def main(argv=None) -> int:
         prefill_buckets=buckets, max_len=max_len,
         block_size=args.block_size,
         autoscale=args.autoscale,
-        target_p99_s=args.target_p99, max_engines=args.max_engines)
+        target_p99_s=args.target_p99, max_engines=args.max_engines,
+        tp=args.tp)
     report = replay(router, trace, clock=clk, step_dt=args.step_dt,
                     autoscaler=asc)
+    if args.tp:
+        report["pool"]["tp"] = args.tp
     text = json.dumps(report, sort_keys=True)
     print(text)
     if args.json:

@@ -34,3 +34,14 @@ def alloc_blocks(pool, n, stats):
 def insert_chain(tree, blocks):
     blocks.block_until_ready()  # BAD
     return tree
+
+
+# ISSUE 10: handoff export/import and pool placement are hot — a
+# handoff moves once per request, placement runs on the step path
+def import_handoff(pool, pkg):
+    return np.asarray(pkg.kv)  # BAD
+
+
+def place_pools(pools, stats):
+    jax.device_get(stats)  # BAD
+    return pools

@@ -42,3 +42,21 @@ def evict_lru_leaf(cached):
 def alloc_blocks(free_list, n):
     return [free_list.pop() for _ in range(n)] \
         if len(free_list) >= n else None
+
+
+# ISSUE 10 sharded-serving paths
+def export_handoff(pool, idx):
+    # the ONE deliberate per-request fetch at the disaggregation
+    # boundary, justified + suppressed:
+    return np.asarray(pool[idx])  # graftlint: disable=hidden-device-sync
+
+
+def place_pools(pools, mesh, specs):
+    # re-COMMITS shardings (device-side placement), fetches nothing
+    return [mesh.place(p, s) for p, s in zip(pools, specs)]
+
+
+def gather_serving_params(params):
+    # not a hot-path name: the checkpoint form is a deliberate
+    # whole-tree host fetch in host-side setup
+    return np.asarray(params)

@@ -24,3 +24,17 @@ def loop_on_traced(x, n):
         x = x * 2
         n = n - 1
     return x
+
+
+# ISSUE 10: shard_map bodies are trace roots with NO static-arg
+# escape — every parameter is a traced operand (serving/tp.py shape)
+def sharded_decode(params, pools, tokens, mesh, specs):
+    from jax.experimental.shard_map import shard_map
+
+    def body(p, pool, tok):
+        if tok:  # BAD
+            return p @ pool
+        return float(tok)  # BAD
+
+    return shard_map(body, mesh=mesh, in_specs=specs,
+                     out_specs=specs)(params, pools, tokens)

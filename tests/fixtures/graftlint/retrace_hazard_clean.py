@@ -44,3 +44,19 @@ def optional_operand(x, mask=None):
     if mask is not None and x.ndim == 2:
         return x * mask
     return x
+
+
+# ISSUE 10: shard_map bodies may branch on shape metadata and pytree
+# structure exactly like jit roots — only VALUE branches are hazards
+def sharded_decode(params, pools, tokens, mesh, specs):
+    from jax.experimental.shard_map import shard_map
+
+    def body(p, pool, tok):
+        if tok.ndim == 2:
+            tok = tok[None]
+        if pool is None:
+            return p * tok
+        return jnp.where(tok > 0, p, -p)  # traced select, not a branch
+
+    return shard_map(body, mesh=mesh, in_specs=specs,
+                     out_specs=specs)(params, pools, tokens)

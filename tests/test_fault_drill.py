@@ -68,16 +68,20 @@ def test_elastic_drill_leg(tmp_path, leg):
                                  "serve_deadline", "serve_retry",
                                  "serve_watchdog", "serve_prefix",
                                  "fleet_failover", "fleet_drain",
-                                 "fleet_autoscale"])
+                                 "fleet_autoscale",
+                                 "fleet_tp_failover"])
 def test_serving_drill_leg(tmp_path, leg):
-    """ISSUE 4 + ISSUE 7: the serving-plane reliability drills
-    (poisoned co-batch, overload shed, deadline expiry,
+    """ISSUE 4 + ISSUE 7 + ISSUE 10: the serving-plane reliability
+    drills (poisoned co-batch, overload shed, deadline expiry,
     retry-then-succeed, watchdog trip) and the fleet drills (failover
-    bit-identity, drain, SLO autoscaling) run bit-deterministically
-    on every tier-1 pass."""
+    bit-identity — including across sharding layouts, drain, SLO
+    autoscaling) run bit-deterministically on every tier-1 pass.
+    Legs must actually DRILL here: the CPU-mesh conftest gives them 8
+    devices, so the device-count skip escape is asserted shut."""
     fd = _load_drill()
     result = fd.SERVING_LEGS[leg](str(tmp_path))
     assert result["ok"], result
+    assert "skipped" not in result, result
 
 
 # ------------------------------------------------------------- FaultPlan

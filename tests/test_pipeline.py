@@ -79,9 +79,16 @@ def test_pipeline_rejects_bad_layer_split():
         make_pipeline_train_step(model, SGD(), mesh, microbatches=2)
 
 
+@pytest.mark.slow
 def test_interleaved_pipeline_matches_single_device():
     """1F1B-interleaved (virtual stages): same math as the oracle, with
-    params in virtual layout; bubble fraction strictly below GPipe's."""
+    params in virtual layout; bubble fraction strictly below GPipe's.
+
+    tier-2 (ISSUE 10 budget satellite): the pipeline
+    1F1B-interleaved dryrun leg asserts loss==oracle + bubble < GPipe
+    on every driver run, and the pipe+data
+    test_pipeline_matches_single_device keeps the pipeline step
+    tier-1."""
     from bigdl_tpu.parallel.pipeline import (interleaved_bubble_fraction,
                                              to_virtual_layout)
 

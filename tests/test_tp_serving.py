@@ -1,0 +1,342 @@
+"""Tensor-parallel sharded serving (ISSUE 10): sharded-vs-unsharded
+BITWISE parity, the compile-count guard, paged warm==cold under tp,
+serving_params resharding round-trips, and the disaggregated-prefill
+handoff path.
+
+The load-bearing claim is the tp_shard_gather construction
+(models/transformer.py / serving/tp.py): head-parallel attention is a
+pure batch split, the column gemms keep each output element's
+contraction extent, and the per-layer collectives concatenate DISJOINT
+shards — so a sharded engine's tokens are the unsharded engine's
+tokens bit-for-bit, which is what lets failover, prefix reuse and
+handoff cross sharding layouts without a tolerance anywhere."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.models.transformer import build_lm
+from bigdl_tpu.parallel import make_mesh
+from bigdl_tpu.serving import (EngineRouter, InferenceEngine, Request,
+                               gather_serving_params,
+                               shard_serving_params, tp_serving_model)
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 4,
+    reason="tp serving tests need the 8-device virtual CPU mesh "
+           "(tests/conftest.py forces it)")
+
+# one shared model: every engine (sharded or not) over it shares
+# jitted executables per (model-or-wrapper, shapes) — and the wrapper
+# itself is memoized per (model, mesh, axis), so the whole module
+# compiles each layout once
+_LM = None
+
+
+def _lm():
+    global _LM
+    if _LM is None:
+        _LM = build_lm(vocab_size=50, dim=32, num_heads=4,
+                       num_layers=2, max_len=64)
+        _LM.build(jax.random.PRNGKey(0))
+    return _LM
+
+
+def _mesh(tp):
+    return make_mesh({"model": tp}, devices=jax.devices()[:tp])
+
+
+def _reqs():
+    # greedy + seeded sampling + per-row knobs, both prefill buckets
+    return [
+        Request(prompt=[1, 2, 3], max_new_tokens=6, seed=1),
+        Request(prompt=list(range(1, 11)), max_new_tokens=6,
+                temperature=0.9, top_k=5, seed=7),
+        Request(prompt=[4, 5], max_new_tokens=5, temperature=1.0,
+                top_p=0.9, seed=3),
+        Request(prompt=[9] * 7, max_new_tokens=4, temperature=0.7,
+                seed=11),
+    ]
+
+
+def _engine(tp=None, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("prefill_buckets", (8, 16))
+    if tp:
+        kw["tp_mesh"] = _mesh(tp)
+    return InferenceEngine(_lm(), **kw)
+
+
+class TestShardedParity:
+    """tp=2 / tp=4 tokens bitwise identical to tp=1 — the acceptance
+    bar (greedy AND seeded sampling, slot eviction in between)."""
+
+    def test_tp2_bitwise(self):
+        ref = _engine().run(_reqs())
+        got = _engine(tp=2).run(_reqs())
+        assert [g.tokens for g in got] == [r.tokens for r in ref]
+        assert [g.finish_reason for g in got] \
+            == [r.finish_reason for r in ref]
+
+    @pytest.mark.slow
+    def test_tp4_bitwise(self):
+        """tier-2 (ISSUE 10 budget satellite): same construction as
+        tp=2 on a bigger mesh — tp=4 bitwise stays pinned on every
+        driver run by the tp_serve dryrun leg (greedy + seeded
+        sampling + compile counts), and test_tp2_bitwise stays
+        tier-1."""
+        ref = _engine().run(_reqs())
+        got = _engine(tp=4).run(_reqs())
+        assert [g.tokens for g in got] == [r.tokens for r in ref]
+
+    @pytest.mark.slow
+    def test_tp2_bitwise_bf16_compute(self):
+        """bf16 KV compute (cache_dtype=bf16: keys/values stored and
+        multiplied in bf16, scores still fp32): the construction is
+        dtype-blind, so sharded == unsharded holds bitwise in reduced
+        precision too."""
+        kw = dict(cache_dtype=jnp.bfloat16)
+        ref = _engine(**kw).run(_reqs())
+        got = _engine(tp=2, **kw).run(_reqs())
+        assert [g.tokens for g in got] == [r.tokens for r in ref]
+
+    def test_prefix_warm_equals_cold_under_tp(self):
+        """The paged warm==cold pin (ISSUE 8) re-run under tp=2: a
+        cached-prefix admission decodes bitwise identical to its cold
+        run, and the cold run is bitwise identical to the unsharded
+        cold run — one contract across both features."""
+        P = dict(prompt=[5, 9, 3, 7, 2, 8, 4, 6, 1, 3, 9, 2, 7],
+                 max_new_tokens=5, temperature=0.8, seed=11)
+        kw = dict(block_size=4, max_len=32)
+        cold_ref = _engine(**kw).run([Request(**P)])[0]
+        eng = _engine(tp=2, **kw)
+        cold = eng.run([Request(**P)])[0]       # seeds the radix tree
+        warm = eng.run([Request(**P)])[0]       # hits it
+        assert eng.stats["prefix_hits"] == 1
+        assert cold.tokens == cold_ref.tokens
+        assert warm.tokens == cold.tokens
+
+
+class TestCompileContract:
+    def test_buckets_plus_one_per_sharded_engine(self):
+        """A sharded engine compiles (#buckets used) prefills + 1
+        decode; the second traffic wave and a second engine over the
+        same (model, mesh, axis) compile NOTHING — the #buckets+1
+        contract holds for sharded pools exactly as for plain ones.
+        A FRESH model object isolates the count from the module's
+        shared (already-compiled) wrapper."""
+        m = build_lm(vocab_size=50, dim=32, num_heads=4, num_layers=2,
+                     max_len=64)
+        m.build(jax.random.PRNGKey(0))
+        eng = InferenceEngine(m, slots=2, prefill_buckets=(8, 16),
+                              tp_mesh=_mesh(2))
+        eng.run(_reqs())                        # wave 1: both buckets
+        assert eng.stats["prefill_traces"] == 2
+        assert eng.stats["decode_traces"] == 1
+        eng.run(_reqs())                        # wave 2: zero compiles
+        assert eng.stats["prefill_traces"] == 2
+        assert eng.stats["decode_traces"] == 1
+        twin = InferenceEngine(m, slots=2, prefill_buckets=(8, 16),
+                               tp_mesh=_mesh(2))  # memoized wrapper
+        twin.run(_reqs()[:1])
+        assert twin.stats["prefill_traces"] == 0
+        assert twin.stats["decode_traces"] == 0
+
+    def test_wrapper_memoized(self):
+        w1 = tp_serving_model(_lm(), _mesh(2))
+        w2 = tp_serving_model(_lm(), _mesh(2))
+        assert w1 is w2
+        assert w1.tp == 2
+        # an already-wrapped model passes through on the same layout
+        # (a fleet factory reusing engine.model with tp_mesh=) and is
+        # refused — not silently double-sharded — on another
+        assert tp_serving_model(w1, _mesh(2)) is w1
+        with pytest.raises(ValueError, match="already tp-wrapped"):
+            tp_serving_model(w1, _mesh(4))
+
+    def test_divisibility_guards(self):
+        m = build_lm(vocab_size=16, dim=24, num_heads=3, num_layers=1,
+                     max_len=16)
+        m.build(jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="num_heads"):
+            tp_serving_model(m, _mesh(2))
+
+    def test_training_tp_model_refused_unsharded(self):
+        """A tp_axis-armed (training-TP) model served WITHOUT tp_mesh
+        would trace an unbound all_gather deep in jit — the engine
+        must refuse up front and name the fix."""
+        from bigdl_tpu.models.transformer import (TransformerConfig,
+                                                  TransformerLM)
+
+        m = TransformerLM(TransformerConfig(vocab_size=16, max_len=16,
+                                            dim=16, num_heads=2,
+                                            num_layers=1),
+                          tp_axis="model")
+        with pytest.raises(ValueError, match="tp_mesh"):
+            InferenceEngine(m, slots=1, variables={"params": {}})
+
+
+class TestResharding:
+    def test_round_trip_across_tp_sizes(self):
+        """A 'checkpointed' (host-gathered) sharded serving_params
+        tree re-places onto any other tp degree with every leaf
+        bit-identical — leaves are GLOBAL values, the mesh only places
+        them (the zero2 resharding story, serving side)."""
+        m = _lm()
+        ref = gather_serving_params(
+            m.serving_params(m.variables))      # unsharded host form
+        sp2 = tp_serving_model(m, _mesh(2)).serving_params(m.variables)
+        host = gather_serving_params(sp2)       # tp=2 checkpoint form
+        flat_a = jax.tree_util.tree_leaves(ref)
+        flat_b = jax.tree_util.tree_leaves(host)
+        assert all(np.array_equal(a, b)
+                   for a, b in zip(flat_a, flat_b))
+        sp4 = shard_serving_params(_mesh(4), host)   # reshard 2 → 4
+        flat_c = jax.tree_util.tree_leaves(gather_serving_params(sp4))
+        assert all(np.array_equal(a, c)
+                   for a, c in zip(flat_a, flat_c))
+        # and the resharded tree actually SERVES bitwise-identically
+        ref_tok = _engine().run(_reqs()[:2])
+        eng = InferenceEngine(tp_serving_model(m, _mesh(4)),
+                              variables={"params": sp4},
+                              slots=2, prefill_buckets=(8, 16))
+        got = eng.run(_reqs()[:2])
+        assert [g.tokens for g in got] == [r.tokens for r in ref_tok]
+
+
+class TestHandoff:
+    """Disaggregated prefill (the ISSUE 10 stretch): a prefill-role
+    engine exports KV block contents, the router seats them on
+    serving engines, tokens stay bitwise identical — including ACROSS
+    sharding layouts."""
+
+    def test_handoff_bitwise_and_routed(self):
+        ref = _engine().run(_reqs())
+        pf = _engine(role="prefill")
+        de = _engine()
+        router = EngineRouter([de], prefill_engines=[pf],
+                              handoff_len=7)
+        got = router.run(_reqs())
+        assert [g.tokens for g in got] == [r.tokens for r in ref]
+        assert all(g.status == "done" for g in got)
+        # the two long prompts went through the tier, the short two
+        # prefilled in place
+        assert router.stats["prefill_dispatched"] == 2
+        assert router.stats["handoffs"] == 2
+        assert pf.stats["handoffs_out"] == 2
+        assert de.stats["handoffs_in"] == 2
+        assert pf.stats["decode_steps"] == 0    # prefill tier never decodes
+
+    @pytest.mark.slow
+    def test_handoff_across_layouts(self):
+        """tp=2 prefill tier feeding an UNSHARDED decode engine:
+        prefill bits are layout-invariant, so the handed-off request
+        still decodes bit-identically."""
+        ref = _engine().run(_reqs())
+        pf = _engine(role="prefill", tp=2)
+        de = _engine()
+        router = EngineRouter([de], prefill_engines=[pf],
+                              handoff_len=7)
+        got = router.run(_reqs())
+        assert [g.tokens for g in got] == [r.tokens for r in ref]
+
+    def test_prefill_engine_seeds_importer_prefix_cache(self):
+        """An imported prompt registers in the decode engine's radix
+        tree: the SAME prompt resubmitted directly (below the handoff
+        threshold path is irrelevant — same engine) hits the prefix
+        cache and stays bitwise identical."""
+        P = dict(prompt=[5, 9, 3, 7, 2, 8, 4, 6, 1, 3, 9, 2, 7],
+                 max_new_tokens=5, temperature=0.8, seed=11)
+        kw = dict(block_size=4, max_len=32)
+        ref = _engine(**kw).run([Request(**P)])[0]
+        pf = _engine(role="prefill", **kw)
+        de = _engine(**kw)
+        router = EngineRouter([de], prefill_engines=[pf],
+                              handoff_len=8)
+        first = router.run([Request(**P)])[0]
+        assert first.tokens == ref.tokens
+        again = de.run([Request(**P)])[0]       # direct, post-handoff
+        assert de.stats["prefix_hits"] == 1
+        assert again.tokens == ref.tokens
+        # and a REPEATED handoff of the same prompt reuses the
+        # importer's cached chain instead of re-scattering duplicates
+        reused = router.run([Request(**P)])[0]
+        assert reused.tokens == ref.tokens
+        assert de.stats["prefix_hits"] == 2
+        assert de.stats["prefix_blocks_reused"] > 0
+
+    def test_backlog_retries_when_slots_free_mid_round(self):
+        """A package that cannot seat THIS round (the only slot busy)
+        must retry after the slot frees — not trip run()'s
+        stuck-backlog RuntimeError. Regression: seating runs at the
+        top of step(), so a slot freed later the same round is only
+        seatable next round, and the guard must allow that round."""
+        ref = _engine().run(_reqs()[:2])
+        pf = _engine(role="prefill")
+        de = _engine(slots=1)
+        router = EngineRouter([de], prefill_engines=[pf],
+                              handoff_len=1)
+        got = router.run(_reqs()[:2])
+        assert [g.tokens for g in got] == [r.tokens for r in ref]
+        assert de.stats["handoffs_in"] == 2
+
+    def test_role_guards(self):
+        with pytest.raises(ValueError, match="role"):
+            _engine(role="frontend")
+        with pytest.raises(ValueError, match="prefill"):
+            # watchdog/retry guard the decode dispatch, which a
+            # prefill tier never runs — dead knobs are refused
+            _engine(role="prefill", step_timeout_s=0.1)
+        pf = _engine(role="prefill")
+        with pytest.raises(ValueError, match="prefill-role"):
+            pf.import_handoff(None)
+        with pytest.raises(ValueError, match="EngineRouter"):
+            # direct run() would export-and-never-finish: clear error,
+            # not a KeyError out of the drain loop
+            pf.run(_reqs()[:1])
+        with pytest.raises(ValueError, match="role='prefill'"):
+            EngineRouter([_engine()], prefill_engines=[_engine()],
+                         handoff_len=4)
+
+    def test_mismatched_layout_rejected(self):
+        """A package from a different block_size (or model) fleet is a
+        CONFIG error — import_handoff must say so, not crash in table
+        surgery or silently retry forever."""
+        pf = _engine(role="prefill", block_size=4, max_len=32)
+        pf.submit(_reqs()[1])
+        pf.step()
+        (pkg,) = pf.take_handoffs()
+        de = _engine(block_size=8, max_len=32)
+        with pytest.raises(ValueError, match="block_size"):
+            de.import_handoff(pkg)
+        # mixed cache dtype would silently CAST — a bit-identity
+        # break, not a crash — so it must refuse too
+        de16 = _engine(block_size=4, max_len=32,
+                       cache_dtype=jnp.bfloat16)
+        with pytest.raises(ValueError, match="cache_dtype"):
+            de16.import_handoff(pkg)
+
+
+def test_tp_health_and_gauge():
+    """health() reports the shard count; the serving_tp_shards gauge
+    and the tp label ride the engine's registry series."""
+    from bigdl_tpu import obs
+
+    prev = obs.set_enabled(True)
+    obs.reset_all()
+    try:
+        eng = _engine(tp=2)
+        eng.run(_reqs()[:1])
+        h = eng.health()
+        assert h["tp"] == 2 and h["role"] == "both"
+        snap = obs.get_registry().snapshot()["metrics"]
+        tp_series = snap["serving_tp_shards"]["series"]
+        assert any(s["labels"]["engine"] == eng.obs_name
+                   and s["value"] == 2 for s in tp_series)
+        req_series = snap["serving_requests_total"]["series"]
+        assert all(s["labels"]["tp"] == "2" for s in req_series
+                   if s["labels"]["engine"] == eng.obs_name)
+    finally:
+        obs.reset_all()
+        obs.set_enabled(prev)
