@@ -930,13 +930,26 @@ def bench_lm_decode_batched(on_tpu, context=512, new_tokens=None,
     # telemetry overhead, self-attributing (ISSUE 5 acceptance): the
     # SAME engine and executables run one steady wave with every
     # emission path disabled and one with telemetry on; the row
-    # publishes both throughputs and the delta (<1% contract)
+    # publishes both throughputs and the delta (<1% contract).
+    # ISSUE 11 re-measures with the NEW layers armed too: journey
+    # tracing is always-on event fields, and the telemetry-on wave
+    # additionally runs under an installed FlightRecorder — the <1%
+    # bar now covers the whole observability plane
     prev = obs.set_enabled(False)
     try:
         res_off, dt_off, steps_off = steady(100)
     finally:
         obs.set_enabled(prev)
-    res, dt, steps = steady(200)                # telemetry on
+    import tempfile
+
+    from bigdl_tpu.obs.flightrecorder import FlightRecorder
+
+    recorder = FlightRecorder(
+        tempfile.mkdtemp(prefix="bench_flightrec_")).install()
+    try:
+        res, dt, steps = steady(200)            # telemetry + recorder on
+    finally:
+        recorder.close()
     total = sum(len(r.tokens) for r in res)
     total_off = sum(len(r.tokens) for r in res_off)
     thr_on, thr_off = total / dt, total_off / dt_off
@@ -956,6 +969,9 @@ def bench_lm_decode_batched(on_tpu, context=512, new_tokens=None,
             dt_off / max(steps_off, 1) * 1e3, 2),
         "telemetry_overhead_frac": round(
             max(0.0, 1.0 - thr_on / thr_off), 4),
+        "journey_tracing": "on",
+        "flight_recorder": "armed",
+        "flight_recorder_bundles": len(recorder.bundles),
         "telemetry": _obs_provenance("serving_"),
     }), flush=True)
 

@@ -28,6 +28,15 @@ must re-COMMIT shardings, never fetch). `serving/tp.py` is inside the
 `serving/` scope like the rest of the plane; its `gather_serving_
 params` (the checkpoint form — a deliberate whole-tree fetch) is
 host-side setup by name, not a hot path.
+
+ISSUE 11 extends the scope to the journey/flight-recorder layer
+(`obs/journey.py`, `obs/flightrecorder.py` — named explicitly below
+even though the `bigdl_tpu/obs/` prefix already covers them: shrinking
+the obs/ scope must not silently drop them) and the hot-name set to
+journey/record/dump/bundle/flight functions: the flight recorder runs
+INSIDE emit (an EventLog listener), so a sync in a dump path would
+stall the decode loop once per incident-adjacent event — everything it
+records must be an already-emitted host dict.
 """
 
 from __future__ import annotations
@@ -44,7 +53,8 @@ _SYNC_CALLS = {"np.asarray", "numpy.asarray", "np.array",
 _SYNC_METHODS = {"item", "block_until_ready", "tolist", "__array__"}
 _HOT_FN = re.compile(
     r"(decode|prefill|dispatch|step|sample|work|emit|observe"
-    r"|lookup|insert|evict|alloc|handoff|place)")
+    r"|lookup|insert|evict|alloc|handoff|place"
+    r"|journey|record|dump|bundle|flight)")
 
 
 @register
@@ -53,7 +63,9 @@ class HiddenDeviceSync(Rule):
     severity = "error"
     description = ("device→host fetch on a decode/step hot path or "
                    "obs emission path")
-    scope = ("bigdl_tpu/obs/", "bigdl_tpu/serving/",
+    scope = ("bigdl_tpu/obs/", "bigdl_tpu/obs/journey.py",
+             "bigdl_tpu/obs/flightrecorder.py",
+             "bigdl_tpu/serving/",
              "bigdl_tpu/ops/kv_cache.py",
              "bigdl_tpu/models/transformer.py")
 

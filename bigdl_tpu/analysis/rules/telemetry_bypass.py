@@ -8,7 +8,11 @@ BIGDL_OBS kill switch, the event log, and every consumer parsing
 stdout (bench JSON rows, drill output).
 
 Scope is the `bigdl_tpu/` package only — scripts and examples are
-CLIs and own their stdout.
+CLIs and own their stdout. ISSUE 11 names `obs/journey.py` and
+`obs/flightrecorder.py` explicitly (already inside the package
+prefix): the flight recorder writes bundle FILES, never stdout — a
+print() there would interleave with the bench/drill JSON its own
+incident events are meant to index.
 """
 
 from __future__ import annotations

@@ -33,6 +33,9 @@ from typing import Optional
 
 from bigdl_tpu.obs.events import (EventLog, get_event_log, read_jsonl,
                                   set_event_log)
+from bigdl_tpu.obs.flightrecorder import FlightRecorder, default_trigger
+from bigdl_tpu.obs.journey import (build_journeys, journeys_json,
+                                   summarize_journeys, to_perfetto)
 from bigdl_tpu.obs.registry import (DEFAULT_LATENCY_BUCKETS, Counter,
                                     Gauge, Histogram, MetricsRegistry,
                                     get_registry, series_key,
@@ -44,6 +47,9 @@ __all__ = [
     "DEFAULT_LATENCY_BUCKETS", "get_registry", "set_registry",
     "EventLog", "get_event_log", "set_event_log", "read_jsonl",
     "SpanTracer", "get_tracer", "set_tracer",
+    "FlightRecorder", "default_trigger",
+    "build_journeys", "journeys_json", "summarize_journeys",
+    "to_perfetto",
     "enabled", "set_enabled", "emit_event", "log_metrics_snapshot",
     "provenance", "reset_all",
 ]

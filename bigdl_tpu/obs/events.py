@@ -41,15 +41,38 @@ fine; these are the wired ones):
     metrics_snapshot    a full registry snapshot embedded as an event
                         (obs.log_metrics_snapshot) — gives a JSONL file
                         self-contained percentiles for obs_report
+    preempted           a worker preemption propagating out of a
+                        training loop (ISSUE 11): step — emitted on the
+                        re-raise path (optim/optimizer.py,
+                        parallel/distri_optimizer.py), a flight-
+                        recorder trigger
+    incident_dump       the flight recorder wrote a post-mortem bundle
+                        (ISSUE 11): incident, bundle, component,
+                        trigger_kind, events_in_tail
+                        (obs/flightrecorder.py; obs_report's
+                        "incidents" section digests these)
+
+Request-journey tracing (ISSUE 11): every request-lifecycle event
+above (request_submit / request_terminal / prefix_hit / handoff_* /
+router_*) additionally carries `trace` (the host-side trace id stamped
+on the Request at admission) and `hop` (how many times the request has
+moved between engines — failover, rebalance, handoff import), and the
+seat-point events (request_submit, handoff_import) carry the engine's
+`tp` + `role`; `obs/journey.py` folds a JSONL file back into one
+cross-engine timeline per request.
 
 The log is ring-buffered in memory (default 4096 records) with an
 optional JSONL file sink; both the clock and the buffer are injectable
-so fault drills assert on bit-reproducible records.
+so fault drills assert on bit-reproducible records. Listeners
+(`add_listener`) observe every record synchronously AFTER it lands in
+the ring — the flight recorder's subscription point; a process with no
+listener installed pays one empty-list check per emit.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from collections import deque
 from typing import Dict, IO, Iterable, List, Optional
@@ -76,6 +99,7 @@ class EventLog:
         self._seq = 0
         self._lock = threading.Lock()
         self._sink: Optional[IO[str]] = None
+        self._listeners: List = []
         self.path = path
         if path:
             self._sink = open(path, "a")
@@ -91,7 +115,29 @@ class EventLog:
                 self._sink.write(json.dumps(rec, sort_keys=True,
                                             default=_jsonable) + "\n")
                 self._sink.flush()
+        # outside the lock: a listener (the flight recorder) may emit
+        # its own record (incident_dump) re-entrantly
+        for fn in list(self._listeners):
+            try:
+                fn(rec)
+            except Exception:
+                logging.getLogger("bigdl_tpu.obs").exception(
+                    "event listener failed")
         return rec
+
+    # -------------------------------------------------------- listeners
+    def add_listener(self, fn) -> None:
+        """Subscribe `fn(record)` to every emitted record (called
+        synchronously, after the ring append, outside the lock). The
+        flight recorder's hook; listeners must never emit
+        unconditionally (re-entrancy is bounded, not infinite)."""
+        self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
 
     # ------------------------------------------------------------ query
     def events(self, kind: Optional[str] = None,

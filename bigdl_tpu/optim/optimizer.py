@@ -593,7 +593,15 @@ class LocalOptimizer:
         iter_start = time.perf_counter()
 
         while not o.end_when(train_state):
-            plan.maybe_preempt(train_state["neval"])
+            try:
+                plan.maybe_preempt(train_state["neval"])
+            except faults.Preempted:
+                # the worker is dead, not retryable — record the
+                # incident (the flight recorder's training-plane
+                # trigger, ISSUE 11) and let it propagate
+                obs.emit_event("preempted", plane="training",
+                               step=train_state["neval"])
+                raise
             plan.maybe_raise("step", train_state["neval"])
             with Timer(self.metrics, "data_fetch_s"):
                 mb = next(batches)

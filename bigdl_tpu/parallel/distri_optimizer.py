@@ -335,7 +335,17 @@ class DistriOptimizer(LocalOptimizer):
         while not o.end_when(train_state):
             # outside the retry try — the retry budget must never
             # absorb a preemption (faults.FaultPlan.maybe_preempt)
-            plan.maybe_preempt(train_state["neval"])
+            try:
+                plan.maybe_preempt(train_state["neval"])
+            except faults.Preempted:
+                # dead worker propagating out (recovery is a fresh
+                # process with --resume): record the incident for the
+                # flight recorder (ISSUE 11) before re-raising
+                from bigdl_tpu import obs
+
+                obs.emit_event("preempted", plane="training",
+                               step=train_state["neval"])
+                raise
             try:
                 plan.maybe_raise("step", train_state["neval"])
                 with Timer(self.metrics, "data_fetch_s"):

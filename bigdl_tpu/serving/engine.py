@@ -257,7 +257,15 @@ class Request:
     shed-lowest-priority overload; `deadline_s` — TTL in clock seconds
     from submission, enforced while queued AND while decoding (expiry
     → status 'expired', partial tokens kept); `max_queue_wait_s` —
-    tighter bound on time spent queued only."""
+    tighter bound on time spent queued only.
+
+    Journey tracing (ISSUE 11, host-side only): `trace_id` is stamped
+    at first admission (router or engine — deterministic, derived from
+    the admitting component's obs label + the request id, never a
+    clock or RNG) and `hop` counts engine-to-engine moves (failover
+    resubmission, rebalance, disaggregated-prefill import). Every
+    lifecycle event carries both, and obs/journey.py reconstructs the
+    cross-engine timeline from them."""
     prompt: Sequence[int]
     max_new_tokens: int = 32
     temperature: float = 0.0
@@ -269,6 +277,8 @@ class Request:
     priority: int = 0
     deadline_s: Optional[float] = None
     max_queue_wait_s: Optional[float] = None
+    trace_id: Optional[str] = None
+    hop: int = 0
 
 
 @dataclass
@@ -732,6 +742,15 @@ class InferenceEngine:
         elif request.id in in_flight:
             raise ValueError(f"request id {request.id} already in flight "
                              "or completed-unclaimed")
+        if request.trace_id is None:
+            # first admission anywhere: open the journey (router
+            # admission stamps first in a fleet; a bare engine stamps
+            # its own — deterministic either way, no clock/RNG).
+            # Stamped BEFORE the overload gate below: a request shed
+            # on arrival must still carry its trace on the terminal
+            # (obs/journey.py renders it as a terminal-only hop)
+            request.trace_id = f"{self._obs_name}/{request.id}"
+            request.hop = 0
         # expire stale queued requests BEFORE the overload check: a
         # queue full of already-dead TTLs must not reject (or shed a
         # victim from) fresh traffic — and the dead ones must report
@@ -746,7 +765,9 @@ class InferenceEngine:
         self._queue.append(request)
         obs.emit_event("request_submit", plane="serving",
                        engine=self._obs_name, request=request.id,
-                       prompt_len=n, priority=request.priority)
+                       prompt_len=n, priority=request.priority,
+                       tp=self.tp, role=self.role,
+                       **self._trace_fields(request))
         return request.id
 
     def _overload(self, request: Request) -> None:
@@ -758,7 +779,8 @@ class InferenceEngine:
             self._bump("rejected")
             obs.emit_event("request_rejected", plane="serving",
                            engine=self._obs_name, request=request.id,
-                           queue_depth=len(self._queue))
+                           queue_depth=len(self._queue),
+                           **self._trace_fields(request))
             raise OverloadError(
                 f"queue full ({self.max_queue}); request {request.id} "
                 "rejected (overload_policy='reject')")
@@ -832,6 +854,15 @@ class InferenceEngine:
             return math.inf
         return self._meta[req.id]["t"] + req.deadline_s
 
+    @staticmethod
+    def _trace_fields(req: Request) -> Dict[str, object]:
+        """Journey-context fields for a request-lifecycle event
+        (ISSUE 11): empty when the request predates tracing."""
+        t = getattr(req, "trace_id", None)
+        if t is None:
+            return {}
+        return {"trace": t, "hop": int(getattr(req, "hop", 0))}
+
     def _bump(self, key: str, n: int = 1) -> None:
         """One increment path: the engine-local stats dict (always,
         core bookkeeping) plus the registry mirror (when telemetry is
@@ -871,7 +902,9 @@ class InferenceEngine:
         obs.emit_event("request_terminal", plane="serving",
                        engine=self._obs_name, request=req.id,
                        status=status, reason=reason, tokens=tokens,
-                       ttft_s=ttft_s, latency_s=latency_s)
+                       ttft_s=ttft_s, latency_s=latency_s,
+                       tp=self.tp, role=self.role,
+                       **self._trace_fields(req))
         tracer = obs.get_tracer()
         if tracer.enabled:
             t0 = self._meta.get(req.id, {}).get("t", now)
@@ -958,6 +991,52 @@ class InferenceEngine:
                 self._queue.appendleft(req)
                 return
 
+    def _point_table_row(self, slot: int, hit: List[int],
+                         new: List[int]) -> np.ndarray:
+        """Zero one slot's block-table row and point it at the shared
+        `hit` chain followed by the exclusive `new` blocks — the host
+        row both seat paths hand to the jitted steps."""
+        row = self._table[slot]
+        row[:] = 0
+        row[:len(hit)] = hit
+        row[len(hit):len(hit) + len(new)] = new
+        return row
+
+    def _seat_slot(self, slot: int, req: Request, hit: List[int],
+                   new: List[int]) -> None:
+        """Seat-slot tail shared by `_admit_into` and `import_handoff`
+        (PR 10's deferred cleanup — previously ~40 mirrored lines):
+        register the prompt's pre-COW-cap blocks in the radix tree
+        (their content is valid — the prefill/scatter this seat
+        follows is already dispatched, and device program order covers
+        any later reader), then point every per-slot host array at the
+        request so the next decode step picks it up at clock
+        len(prompt)-1. Both callers stay pinned by the bitwise tests
+        (test_kv_pool, test_tp_serving, the serve_prefix drill)."""
+        prompt = list(req.prompt)
+        n = len(prompt)
+        if self.prefix_cache_enabled:
+            # the prompt's full pre-COW-cap blocks become cacheable the
+            # moment their content lands; the already-present hit chain
+            # is skipped by insert()
+            cap_blocks = (n - 1) // self.block_size
+            if cap_blocks:
+                owned = self._prefix.insert(
+                    prompt,
+                    [int(x) for x in self._table[slot, :cap_blocks]])
+                for bid in owned:
+                    self._pool_mgr.mark_cached(bid)
+        self._req[slot] = req
+        self._gen[slot] = []
+        self._slot_blocks[slot] = [list(hit), list(new)]
+        self._pos[slot] = n - 1         # re-decode last prompt token
+        self._tok[slot] = prompt[-1]
+        self._nout[slot] = 0
+        self._seed[slot] = req.seed
+        self._temp[slot] = req.temperature
+        self._topk[slot] = req.top_k
+        self._topp[slot] = req.top_p
+
     def _admit_into(self, slot: int, req: Request) -> bool:
         """Prefix lookup + block allocation + suffix prefill into
         `slot`. False = insufficient pool blocks (caller requeues)."""
@@ -988,10 +1067,7 @@ class InferenceEngine:
         if new is None:
             self._pool_mgr.unref(hit)         # back to cached parking
             return False
-        row = self._table[slot]
-        row[:] = 0
-        row[:len(hit)] = hit
-        row[len(hit):len(hit) + nb_new] = new
+        row = self._point_table_row(slot, hit, new)
         toks = pad_tokens(suffix, b)[None, :]          # (1, bucket)
         tracer = obs.get_tracer()
         t_admit = self._clock()
@@ -1017,16 +1093,6 @@ class InferenceEngine:
                                   "bucket": int(b),
                                   "prefix_tokens": int(start)})
         self._bump("prefill_calls")
-        if self.prefix_cache_enabled:
-            # register the prompt's full pre-COW-cap blocks (their
-            # content is valid the moment the prefill above lands);
-            # the hit chain already exists in the tree and is skipped
-            cap_blocks = (n - 1) // bs
-            if cap_blocks:
-                owned = self._prefix.insert(
-                    prompt, [int(x) for x in row[:cap_blocks]])
-                for bid in owned:
-                    self._pool_mgr.mark_cached(bid)
         if start:
             self._bump("prefix_hits")
             self._bump("prefix_blocks_reused", len(hit))
@@ -1036,18 +1102,9 @@ class InferenceEngine:
             obs.emit_event("prefix_hit", plane="serving",
                            engine=self._obs_name, request=req.id,
                            matched_tokens=start, blocks=len(hit),
-                           prompt_len=n)
+                           prompt_len=n, **self._trace_fields(req))
         self._update_pool_gauge()
-        self._req[slot] = req
-        self._gen[slot] = []
-        self._slot_blocks[slot] = [list(hit), list(new)]
-        self._pos[slot] = n - 1         # re-decode last prompt token
-        self._tok[slot] = prompt[-1]
-        self._nout[slot] = 0
-        self._seed[slot] = req.seed
-        self._temp[slot] = req.temperature
-        self._topk[slot] = req.top_k
-        self._topp[slot] = req.top_p
+        self._seat_slot(slot, req, hit, new)
         return True
 
     def _finish(self, slot: int, reason: str,
@@ -1266,7 +1323,8 @@ class InferenceEngine:
         self._bump("handoffs_out")
         obs.emit_event("handoff_export", plane="serving",
                        engine=self._obs_name, request=req.id,
-                       prompt_len=n, blocks=nb)
+                       prompt_len=n, blocks=nb,
+                       **self._trace_fields(req))
         return pkg
 
     def take_handoffs(self) -> List[HandoffPackage]:
@@ -1353,6 +1411,10 @@ class InferenceEngine:
             self._pool_mgr.unref(hit)     # back to cached parking
             return False
         slot = free[0]
+        if req.trace_id is not None:
+            # the request moved across the disaggregation boundary:
+            # the seat here opens a new journey hop (obs/journey.py)
+            req.hop += 1
         idx = jnp.asarray(new, jnp.int32)
         self.pool = tuple(
             {k: leaf.at[idx].set(jnp.asarray(pkg.kv[li][k][nh:]))
@@ -1362,28 +1424,9 @@ class InferenceEngine:
             # host-side scatter may drop the tp head-axis placement —
             # re-commit so the jitted steps keep their shardings
             self.pool = self.model.place_pools(self.pool)
-        row = self._table[slot]
-        row[:] = 0
-        row[:nh] = hit
-        row[nh:nb] = new
-        self._req[slot] = req
-        self._gen[slot] = []
-        self._slot_blocks[slot] = [list(hit), list(new)]
-        self._pos[slot] = n - 1         # re-decode last prompt token
-        self._tok[slot] = prompt[-1]
-        self._nout[slot] = 0
-        self._seed[slot] = req.seed
-        self._temp[slot] = req.temperature
-        self._topk[slot] = req.top_k
-        self._topp[slot] = req.top_p
+        self._point_table_row(slot, hit, new)
+        self._seat_slot(slot, req, hit, new)
         self._meta[req.id] = {"t": pkg.submit_t}
-        if self.prefix_cache_enabled:
-            cap_blocks = (n - 1) // bs
-            if cap_blocks:
-                owned = self._prefix.insert(
-                    prompt, [int(x) for x in row[:cap_blocks]])
-                for bid in owned:
-                    self._pool_mgr.mark_cached(bid)
         if nh:
             # hits/blocks count like any admission; tokens/bytes-saved
             # stay prefill-side metrics — this import skipped a
@@ -1393,12 +1436,14 @@ class InferenceEngine:
             obs.emit_event("prefix_hit", plane="serving",
                            engine=self._obs_name, request=req.id,
                            matched_tokens=nh * bs, blocks=nh,
-                           prompt_len=n)
+                           prompt_len=n, **self._trace_fields(req))
         self._update_pool_gauge()
         self._bump("handoffs_in")
         obs.emit_event("handoff_import", plane="serving",
                        engine=self._obs_name, request=req.id,
-                       prompt_len=n, blocks=nb, source=pkg.source)
+                       prompt_len=n, blocks=nb, source=pkg.source,
+                       tp=self.tp, role=self.role,
+                       **self._trace_fields(req))
         return True
 
     def step(self) -> List[GenerationResult]:

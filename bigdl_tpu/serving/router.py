@@ -273,6 +273,13 @@ class EngineRouter:
                 or request.id in self.completed:
             raise ValueError(f"request id {request.id} already in "
                              "flight or completed-unclaimed")
+        if getattr(request, "trace_id", None) is None:
+            # journey tracing (ISSUE 11): the trace context opens at
+            # ROUTER admission — deterministic (router label + request
+            # id, no clock/RNG), and every move below (failover,
+            # rebalance, handoff import) increments the hop counter
+            request.trace_id = f"{self._obs_name}/{request.id}"
+            request.hop = 0
         # disaggregated prefill: long prompts go to the prefill tier
         # (falling back to in-place prefill on the serving pool when
         # every prefill engine is unhealthy or rejects)
@@ -361,9 +368,11 @@ class EngineRouter:
         for eng in self._ranked():
             if eng is asg.engine:
                 continue
+            asg.request.hop += 1          # the reroute is a journey hop
             try:
                 eng.submit(asg.request)
             except OverloadError:
+                asg.request.hop -= 1      # nothing moved
                 continue
             from_label = asg.engine.obs_name
             asg.engine = eng
@@ -372,7 +381,8 @@ class EngineRouter:
                 "router_failover", plane="serving",
                 router=self._obs_name, request=asg.request.id,
                 source=from_label,
-                target=eng.obs_name)
+                target=eng.obs_name,
+                trace=asg.request.trace_id, hop=asg.request.hop)
             return True
         self._bump("failover_lost")
         return False
@@ -423,14 +433,17 @@ class EngineRouter:
                 moved = donor.steal_queued(min(room, excess_best))
                 if not moved:
                     break
-                n_ok = 0
+                n_ok, moved_ids = 0, []
                 for mi, (req, t0) in enumerate(moved):
+                    req.hop += 1          # the move is a journey hop
                     try:
                         recv.submit(req)
                     except OverloadError:   # racing expiry shrank room
                         # bounce the whole remainder home with their
                         # ORIGINAL stamps — a failed move never resets
-                        # a TTL, and retrying the rest is pointless
+                        # a TTL (nor advances a journey hop), and
+                        # retrying the rest is pointless
+                        req.hop -= 1
                         for r, rt in moved[mi:]:
                             donor._requeue(r, rt)
                         room = 0
@@ -439,12 +452,14 @@ class EngineRouter:
                         self._pending[req.id].engine = recv
                     self._bump("rebalanced")
                     n_ok += 1
+                    moved_ids.append(req.id)
                     room -= 1
                 if n_ok:
                     obs.emit_event("router_rebalance", plane="serving",
                                    router=self._obs_name,
                                    source=donor.obs_name,
-                                   target=recv.obs_name, moved=n_ok)
+                                   target=recv.obs_name, moved=n_ok,
+                                   requests=moved_ids)
 
     # ---------------------------------------------------------------- step
     def step(self) -> List[GenerationResult]:
@@ -506,7 +521,9 @@ class EngineRouter:
                            router=self._obs_name,
                            request=pkg.request.id,
                            source=pkg.source, target=eng.obs_name,
-                           blocks=len(pkg.kv[0]["k"]))
+                           blocks=len(pkg.kv[0]["k"]),
+                           trace=pkg.request.trace_id,
+                           hop=pkg.request.hop)
             return eng
         return None
 

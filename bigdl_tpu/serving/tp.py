@@ -60,6 +60,15 @@ mesh — round-trip pinned by tests/test_tp_serving.py.
 All tp knobs are CONSTRUCTOR arguments (mesh, axis), never env —
 graftlint trace-env-read applies to this module like the rest of the
 serving plane.
+
+**Observability (ISSUE 11).** The wrapper's `tp` attribute is the
+layout label the whole journey/SLO plane keys on: the engine stamps
+it on every request_submit / handoff_import / request_terminal event,
+so obs/journey.py reconstructs cross-LAYOUT hops (a tp=2 engine
+failing over to an unsharded survivor shows tp 2 → 1 on the journey),
+and scripts/obs_report.py splits SLO digests per layout. Handoff
+packages stay layout-free (GLOBAL arrays) — the journey's layout
+labels come from the SEATING engine, never the package.
 """
 
 from __future__ import annotations

@@ -111,7 +111,23 @@ bigdl_tpu/serving/router.py + autoscaler.py):
                     be bit-identical to the undisturbed run, because
                     sharded decode is bitwise == unsharded decode
                     (serving/tp.py). Needs >= 2 devices (the 8-device
-                    XLA_FLAGS above); reports skipped=... on fewer
+                    XLA_FLAGS above); reports skipped=... on fewer.
+                    ISSUE 11 also pins the journey layer here: ONE
+                    reconstructed cross-layout journey per rerouted
+                    request, zero lost hops, transitional 'failed'
+                    terminals superseded
+    fleet_journey   (ISSUE 11) the observability plane against the
+                    full fleet: disaggregated prefill (pf0) + tp=2
+                    'e0' + unsharded 'e1' under one virtual clock
+                    injected everywhere (engines, router, event log,
+                    registry, flight recorder); serve_slow@2 trips
+                    e0's watchdog mid-decode. Pins: one journey per
+                    request with zero lost hops (handoff hops seated
+                    via handoff_import, failover hops crossing tp
+                    layouts), the watchdog trip dumps exactly one
+                    flight-recorder bundle whose event tail names the
+                    failing decode step, and TWO runs produce
+                    byte-identical journey JSON and bundle files
 
 Every training leg compares parameters BIT-FOR-BIT against an
 uninterrupted reference run (same init, same deterministic batch
@@ -216,7 +232,7 @@ def _train(workdir, end_iter, *, faults="", guard=None, mesh=False,
 
 
 @contextlib.contextmanager
-def _telemetry():
+def _telemetry(clock=None):
     """Fresh event log + metrics registry for one drilled run, so the
     leg's assertions read exactly that run's telemetry; both are
     restored to fresh defaults afterwards (no cross-leg leakage). The
@@ -224,11 +240,14 @@ def _telemetry():
     Telemetry is force-ENABLED for the drilled run (and the previous
     switch state restored): the drills assert on events, so they must
     opt in even when the surrounding process runs BIGDL_OBS=off (the
-    tier-1 telemetry-overhead baseline does exactly that)."""
+    tier-1 telemetry-overhead baseline does exactly that). `clock`
+    (ISSUE 11) injects the drill's virtual clock into registry, event
+    log AND tracer, so event `ts` stamps — and therefore journey and
+    flight-recorder bundle bytes — are identical across two runs."""
     from bigdl_tpu import obs
 
     prev = obs.set_enabled(True)
-    obs.reset_all()
+    obs.reset_all(clock)
     try:
         yield obs.get_event_log()
     finally:
@@ -1041,6 +1060,22 @@ def drill_fleet_tp_failover(workdir):
     failover_ev = log.events("router_failover")
     done_ev = log.events("request_terminal", status="done")
     bit_identical = [g.tokens for g in got] == [r.tokens for r in ref]
+    # ISSUE 11: the journey layer must reconstruct ONE cross-engine,
+    # cross-LAYOUT timeline per rerouted request from the very same
+    # event log — zero lost hops, the transitional 'failed' terminals
+    # recorded as superseded, never as the outcome
+    from bigdl_tpu.obs.journey import build_journeys, summarize_journeys
+
+    journeys = build_journeys(log.events())
+    jsum = summarize_journeys(journeys)
+    crossed = [j for j in journeys if j["cross_engine"]]
+    journeys_ok = (
+        jsum["count"] == 6 and jsum["complete"] == 6
+        and jsum["lost_hops"] == 0
+        and len(crossed) == 3                  # the failed-over three
+        and all(j["cross_layout"] for j in crossed)   # tp=2 -> tp=1
+        and all(j["status"] == "done" for j in journeys)
+        and jsum["superseded_terminals"] == 3)
     ok = (e0.tp == 2 and e1.tp == 1
           and e0.degraded is not None and "watchdog" in e0.degraded
           and all(g.status == "done" for g in got)
@@ -1049,13 +1084,15 @@ def drill_fleet_tp_failover(workdir):
           and router.stats["failover_lost"] == 0
           and len(failover_ev) == 3
           and len(degraded_ev) == 1
-          and len(done_ev) == 6)
+          and len(done_ev) == 6
+          and journeys_ok)
     return {"ok": bool(ok),
             "statuses": [g.status for g in got],
             "bit_identical_to_undisturbed": bit_identical,
             "failovers": router.stats["failover"],
             "degraded_engine": e0.degraded,
             "layouts": {"degraded_tp": e0.tp, "survivor_tp": e1.tp},
+            "journeys": jsum,
             "events": log.counts_by_kind()}
 
 
@@ -1176,6 +1213,159 @@ def drill_fleet_autoscale(workdir):
             "events": auto_ev}
 
 
+def _bundle_bytes(outdir):
+    """{relative path: file bytes} over a flight-recorder output dir —
+    the byte-identity surface the journey leg compares across runs."""
+    out = {}
+    for root, _, files in os.walk(outdir):
+        for f in sorted(files):
+            p = os.path.join(root, f)
+            with open(p, "rb") as fh:
+                out[os.path.relpath(p, outdir)] = fh.read()
+    return out
+
+
+def drill_fleet_journey(workdir):
+    """ISSUE 11: the full observability plane against the full fleet
+    plane, twice. A disaggregated-prefill router (pf0 → tp=2 'e0' +
+    unsharded 'e1', fixed obs labels) serves 4 long prompts through
+    the handoff path and 2 short prompts directly, under a virtual
+    clock injected into engines, router, registry, event log AND the
+    flight recorder; serve_slow@2 trips e0's watchdog mid-decode so
+    requests also fail over ACROSS layouts. Pins:
+
+    * journeys: ONE reconstructed journey per request, zero lost hops,
+      every long prompt's hop 0 on the prefill tier with its decode
+      hop seated via handoff_import, failover hops crossing tp
+      layouts;
+    * flight recorder: the watchdog trip dumps exactly one post-mortem
+      bundle whose event tail (and manifest trigger) NAMES the failing
+      decode step;
+    * determinism: two runs produce byte-identical journey JSON and
+      byte-identical bundle files — the whole black box is a pure
+      function of the event sequence + injected clocks."""
+    import jax
+
+    if jax.device_count() < 2:
+        return {"ok": True,
+                "skipped": "needs >= 2 devices (run with XLA_FLAGS="
+                           "--xla_force_host_platform_device_count=8)"}
+    from bigdl_tpu.obs.flightrecorder import FlightRecorder
+    from bigdl_tpu.obs.journey import (build_journeys, journeys_json,
+                                       summarize_journeys)
+    from bigdl_tpu.parallel import make_mesh
+    from bigdl_tpu.serving import EngineRouter
+
+    # ONE mesh for both runs: the serving/tp.py wrapper memoizes on
+    # (model, mesh, axis), so run 2 recompiles nothing
+    mesh = make_mesh({"model": 2}, devices=jax.devices()[:2])
+    longs = [dict(prompt=[(7 * i + j) % 40 + 1 for j in range(8)],
+                  max_new_tokens=4, temperature=0.8, seed=70 + i)
+             for i in range(4)]
+    shorts = [dict(prompt=[i + 1, i + 2, i + 3], max_new_tokens=4,
+                   temperature=0.7, seed=80 + i) for i in range(2)]
+    specs = longs + shorts
+
+    def run(outdir):
+        clk = {"t": 0.0}
+
+        def c():
+            return clk["t"]
+
+        fm = _plan("serve_slow@2")
+        try:
+            with _telemetry(clock=c) as log:
+                pf = _engine(role="prefill", obs_label="pf0", clock=c)
+                # budget 0.25 s, not the 0.05 s the single-run legs
+                # use: this leg compares run-to-run BYTES, so a busy
+                # host real-tripping the watchdog on a healthy step in
+                # ONE run (observed with a concurrent bench hogging
+                # the core) would break identity — only the injected
+                # 5x-budget serve_slow hang may trip
+                e0 = _engine(step_timeout_s=0.25, tp_mesh=mesh,
+                             obs_label="e0", clock=c)
+                e1 = _engine(obs_label="e1", clock=c)
+                router = EngineRouter([e0, e1], prefill_engines=[pf],
+                                      handoff_len=8, clock=c,
+                                      obs_label="r0")
+                rec = FlightRecorder(outdir, clock=c)
+                for name, eng in (("pf0", pf), ("e0", e0), ("e1", e1)):
+                    rec.register_health_source(name, eng.health)
+                rec.install()
+                got = {}
+                ids = [router.submit(_req(**s)) for s in specs]
+                rounds = 0
+                while len(got) < len(ids):
+                    rounds += 1
+                    if rounds > 200:
+                        raise RuntimeError(
+                            f"journey drill stalled: {len(got)}/"
+                            f"{len(ids)} settled after {rounds} rounds")
+                    clk["t"] += 0.5
+                    for res in router.step():
+                        got[res.id] = res
+                rec.close()
+                events = log.events()
+        finally:
+            fm.set_plan(None)
+        return [got[i] for i in ids], events, rec, e0
+
+    got1, ev1, rec1, e0 = run(os.path.join(workdir, "run1"))
+    got2, ev2, rec2, _ = run(os.path.join(workdir, "run2"))
+
+    j1, j2 = build_journeys(ev1), build_journeys(ev2)
+    jsum = summarize_journeys(j1)
+    by_req = {j["request"]: j for j in j1}
+    long_ids = [r.id for r in got1[:len(longs)]]
+    handoff_ok = all(
+        by_req[i]["hops"][0]["engine"] == "pf0"
+        and by_req[i]["hops"][0]["role"] == "prefill"
+        and len(by_req[i]["hops"]) >= 2
+        and by_req[i]["hops"][1]["via"] == "handoff_import"
+        for i in long_ids)
+    journeys_ok = (jsum["count"] == len(specs)
+                   and jsum["complete"] == len(specs)
+                   and jsum["lost_hops"] == 0
+                   and jsum["cross_engine"] >= len(longs)
+                   and jsum["cross_layout"] >= 1)
+    identical_journeys = journeys_json(j1) == journeys_json(j2)
+
+    b1 = _bundle_bytes(os.path.join(workdir, "run1"))
+    b2 = _bundle_bytes(os.path.join(workdir, "run2"))
+    identical_bundles = bool(b1) and b1 == b2
+    # the bundle's event tail must NAME the failing step
+    manifest = json.loads(b1[os.path.join(
+        rec1.bundles[0], "manifest.json")]) if rec1.bundles else {}
+    tail_lines = b1.get(os.path.join(
+        rec1.bundles[0], "events.jsonl"), b"").decode()
+    degraded_recs = [json.loads(ln) for ln in tail_lines.splitlines()
+                     if '"engine_degraded"' in ln]
+    names_failing_step = (
+        manifest.get("incident") == "engine_degraded"
+        and manifest.get("component") == "e0"
+        and len(degraded_recs) == 1
+        and "decode step 2" in degraded_recs[0]["reason"])
+
+    counts = {}
+    for e in ev1:
+        counts[e["kind"]] = counts.get(e["kind"], 0) + 1
+    ok = (all(r.status == "done" for r in got1)
+          and e0.degraded is not None and "watchdog" in e0.degraded
+          and journeys_ok and handoff_ok
+          and identical_journeys
+          and len(rec1.bundles) == 1 and identical_bundles
+          and names_failing_step)
+    return {"ok": bool(ok),
+            "statuses": [r.status for r in got1],
+            "journeys": jsum,
+            "handoff_journeys_ok": handoff_ok,
+            "journeys_byte_identical": identical_journeys,
+            "bundles": rec1.bundles,
+            "bundles_byte_identical": identical_bundles,
+            "bundle_names_failing_step": names_failing_step,
+            "events": dict(sorted(counts.items()))}
+
+
 TRAINING_LEGS = {
     "nan_skip": drill_nan_skip,
     "nan_skip_mesh": lambda wd: drill_nan_skip(wd, mesh=True),
@@ -1202,6 +1392,7 @@ SERVING_LEGS = {
     "fleet_drain": drill_fleet_drain,
     "fleet_autoscale": drill_fleet_autoscale,
     "fleet_tp_failover": drill_fleet_tp_failover,
+    "fleet_journey": drill_fleet_journey,
 }
 
 LEGS = {**TRAINING_LEGS, **SERVING_LEGS}

@@ -15,7 +15,9 @@ reproduce on any machine.
 The same `make_trace`/`replay` pair drives the fleet drills
 (scripts/fault_drill.py fleet_autoscale) and the `lmdecode_fleet`
 bench row, so the traffic shape in CI, in the drills, and in the
-published numbers is one artifact.
+published numbers is one artifact. The CLI report also carries a
+"journeys" rollup (ISSUE 11): requests reconstructed from the
+trace/hop stamps, how many crossed engines, zero lost hops.
 
 Multi-turn sessions: a session's turn k+1 resubmits its whole history
 (previous prompt + generated tokens) plus a pre-drawn continuation
@@ -385,6 +387,20 @@ def main(argv=None) -> int:
                     help="also write the report to this path")
     args = ap.parse_args(argv)
 
+    # size the in-memory event ring to the trace BEFORE any engine
+    # emits (ISSUE 11): the journeys rollup below reads the ring, and
+    # the default 4096 records would roll early seat events off a
+    # large run — terminal-only traces would then masquerade as
+    # incomplete journeys. ~16 events/request is a safe ceiling
+    # (submit/terminal/prefix/handoff/router records); the
+    # BIGDL_OBS_EVENTS file sink is unaffected (disk keeps all).
+    from bigdl_tpu import obs
+
+    expected_requests = args.requests + args.sessions * args.turns
+    obs.set_event_log(obs.EventLog(
+        capacity=max(4096, 16 * expected_requests),
+        path=os.environ.get("BIGDL_OBS_EVENTS") or None))
+
     trace = make_trace(args.requests, seed=args.seed,
                        arrival=args.arrival, rate=args.rate,
                        burst_size=args.burst_size,
@@ -418,6 +434,19 @@ def main(argv=None) -> int:
                     autoscaler=asc)
     if args.tp:
         report["pool"]["tp"] = args.tp
+    # journey rollup (ISSUE 11): the CLI runs with the default event
+    # log armed, so the trace/hop stamps are already there — report
+    # how many requests moved between engines (rebalance/failover/
+    # handoff) and that no hop was lost; counts only, so the
+    # two-runs-byte-identical acceptance is unaffected by labels
+    from bigdl_tpu import obs
+
+    if obs.enabled() and len(obs.get_event_log()):
+        from bigdl_tpu.obs.journey import (build_journeys,
+                                           summarize_journeys)
+
+        report["journeys"] = summarize_journeys(
+            build_journeys(obs.get_event_log().events()))
     text = json.dumps(report, sort_keys=True)
     print(text)
     if args.json:

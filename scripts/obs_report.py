@@ -12,7 +12,15 @@ Input: a file written by the structured event log
   per-token p50/p99, shed/expired/poisoned rates — computed from the
   `ttft_s`/`latency_s` lifecycle stamps the engine puts on every
   `request_terminal` event (engine clock, so a drill log yields
-  bit-deterministic percentiles)
+  bit-deterministic percentiles); ISSUE 11 adds each engine's tp/role
+  and a per-layout rollup (sharded vs unsharded traffic split)
+* journeys section (ISSUE 11): per-request cross-engine hop table
+  reconstructed by obs/journey.py from the trace/hop stamps — engines
+  visited, seat kind per hop, per-hop dwell (the cross-engine TTFT
+  attribution), terminal outcome; `--perfetto PATH` exports one
+  Perfetto track per request
+* incidents section (ISSUE 11): flight-recorder bundles indexed by
+  their `incident_dump` events (obs/flightrecorder.py)
 * metrics tables + latency percentiles, when the file carries a
   `metrics_snapshot` event (`obs.log_metrics_snapshot()` embeds the
   registry, making the JSONL self-contained)
@@ -84,6 +92,12 @@ def summarize(events: List[dict]) -> Dict[str, object]:
             "rejected": by_kind.get("request_rejected", 0),
         }
         out["slo"] = _slo_section(term)
+    journeys = _journeys_section(events)
+    if journeys:
+        out["journeys"] = journeys
+    incidents = _incidents_section(events)
+    if incidents:
+        out["incidents"] = incidents
     prefix = _prefix_section(events)
     if prefix:
         out["prefix"] = prefix
@@ -155,14 +169,72 @@ def _slo_digest(term: List[dict]) -> dict:
 
 
 def _slo_section(term: List[dict]) -> dict:
-    """Latency-SLO digest, fleet-wide and per engine label."""
+    """Latency-SLO digest, fleet-wide, per engine label, and (ISSUE
+    11) per tensor-parallel layout. Each per-engine digest carries the
+    engine's tp/role (from its terminal events), so dashboards can
+    split SLOs by sharding layout without new metric families."""
     engines = sorted({e.get("engine", "?") for e in term})
+    per_engine = {}
+    for eng in engines:
+        evs = [e for e in term if e.get("engine", "?") == eng]
+        d = _slo_digest(evs)
+        # tp/role ride every request_terminal (engine-constant)
+        d["tp"] = evs[-1].get("tp")
+        d["role"] = evs[-1].get("role")
+        per_engine[eng] = d
+    out = {"fleet": _slo_digest(term), "per_engine": per_engine}
+    layouts = sorted({e.get("tp") for e in term
+                      if e.get("tp") is not None})
+    if len(layouts) > 1:
+        out["per_layout"] = {
+            f"tp={tp}": _slo_digest([e for e in term
+                                     if e.get("tp") == tp])
+            for tp in layouts}
+    return out
+
+
+def _journeys_section(events: List[dict]) -> Optional[dict]:
+    """Request-journey digest (ISSUE 11): summary counts plus a
+    per-request hop table — engines visited, seat kind and dwell per
+    hop (the cross-engine TTFT/latency attribution)."""
+    from bigdl_tpu.obs.journey import build_journeys, summarize_journeys
+
+    journeys = build_journeys(events)
+    if not journeys:
+        return None
+    table = []
+    for j in journeys:
+        table.append({
+            "trace": j["trace"], "request": j["request"],
+            "status": j["status"], "tokens": j["tokens"],
+            "ttft_s": j["ttft_s"], "latency_s": j["latency_s"],
+            "hops": [
+                {"engine": h["engine"], "tp": h["tp"],
+                 "role": h["role"], "via": h["via"],
+                 "dwell_s": h["dwell_s"]} for h in j["hops"]],
+            "lost_hops": j["lost_hops"],
+        })
+    return {"summary": summarize_journeys(journeys), "table": table}
+
+
+def _incidents_section(events: List[dict]) -> Optional[dict]:
+    """Flight-recorder digest (ISSUE 11): every incident_dump event
+    names its bundle directory, trigger and component."""
+    dumps = [e for e in events if e.get("kind") == "incident_dump"]
+    if not dumps:
+        return None
+    by_kind: Dict[str, int] = {}
+    for e in dumps:
+        k = e.get("incident", "?")
+        by_kind[k] = by_kind.get(k, 0) + 1
     return {
-        "fleet": _slo_digest(term),
-        "per_engine": {
-            eng: _slo_digest([e for e in term
-                              if e.get("engine", "?") == eng])
-            for eng in engines},
+        "count": len(dumps),
+        "by_incident": dict(sorted(by_kind.items())),
+        "bundles": [{"bundle": e.get("bundle"),
+                     "incident": e.get("incident"),
+                     "component": e.get("component"),
+                     "trigger_kind": e.get("trigger_kind")}
+                    for e in dumps],
     }
 
 
@@ -335,10 +407,50 @@ def render(events: List[dict], tail: int = 15) -> str:
                     + f"  shed/exp/poison {d['shed_rate']}"
                       f"/{d['expired_rate']}/{d['poisoned_rate']}")
         lines.append("\nserving SLO:")
-        lines.append(_fmt_table(
-            [("fleet", fmt_slo(s["slo"]["fleet"]))]
-            + [(eng, fmt_slo(d))
-               for eng, d in s["slo"]["per_engine"].items()]))
+        rows = [("fleet", fmt_slo(s["slo"]["fleet"]))]
+        for eng, d in s["slo"]["per_engine"].items():
+            tag = eng
+            if d.get("tp") is not None:
+                tag += f" (tp={d['tp']}"
+                tag += f", {d['role']})" if d.get("role") else ")"
+            rows.append((tag, fmt_slo(d)))
+        for layout, d in s["slo"].get("per_layout", {}).items():
+            rows.append((layout, fmt_slo(d)))
+        lines.append(_fmt_table(rows))
+    if "journeys" in s:
+        jm = s["journeys"]["summary"]
+        lines.append("\nrequest journeys:")
+        lines.append(_fmt_table([
+            ("requests", jm["count"]),
+            ("complete", jm["complete"]),
+            ("cross-engine", jm["cross_engine"]),
+            ("cross-layout", jm["cross_layout"]),
+            ("max hops", jm["max_hops"]),
+            ("lost hops", jm["lost_hops"]),
+            ("superseded terminals", jm["superseded_terminals"])]))
+        rows = []
+        for j in s["journeys"]["table"][:20]:
+            path = " -> ".join(
+                f"{h['engine'] or '?'}"
+                + (f"[tp{h['tp']}]" if h["tp"] not in (None, 1) else "")
+                + (f"({h['dwell_s']:.3g}s)"
+                   if h["dwell_s"] is not None else "")
+                for h in j["hops"])
+            rows.append((j["trace"], f"{path} => {j['status']} "
+                                     f"({j['tokens']} tok)"))
+        if len(s["journeys"]["table"]) > 20:
+            rows.append(("...",
+                         f"{len(s['journeys']['table']) - 20} more"))
+        lines.append(_fmt_table(rows))
+    if "incidents" in s:
+        inc = s["incidents"]
+        lines.append("\nincidents (flight recorder):")
+        rows = [(f"{k}", n) for k, n in inc["by_incident"].items()]
+        rows += [(b["bundle"],
+                  f"{b['incident']} @ {b['component']} "
+                  f"(trigger {b['trigger_kind']})")
+                 for b in inc["bundles"]]
+        lines.append(_fmt_table(rows))
     if "prefix" in s:
         p = s["prefix"]
         lines.append("\nprefix cache:")
@@ -394,6 +506,10 @@ def main(argv=None) -> int:
                                  "BIGDL_OBS_EVENTS)")
     ap.add_argument("--tail", type=int, default=15,
                     help="timeline tail length (0 disables)")
+    ap.add_argument("--perfetto", default=None, metavar="PATH",
+                    help="also export the reconstructed request "
+                         "journeys as a Perfetto/chrome-trace JSON "
+                         "(one track per request, obs/journey.py)")
     args = ap.parse_args(argv)
     from bigdl_tpu.obs.events import read_jsonl
 
@@ -406,6 +522,14 @@ def main(argv=None) -> int:
         print(f"obs-report: no events in {args.path}")
         return 2
     print(render(events, tail=args.tail))
+    if args.perfetto:
+        import json as _json
+
+        from bigdl_tpu.obs.journey import build_journeys, to_perfetto
+
+        with open(args.perfetto, "w") as f:
+            _json.dump(to_perfetto(build_journeys(events)), f)
+        print(f"\nperfetto journey tracks -> {args.perfetto}")
     return 0
 
 

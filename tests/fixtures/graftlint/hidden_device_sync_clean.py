@@ -60,3 +60,24 @@ def gather_serving_params(params):
     # not a hot-path name: the checkpoint form is a deliberate
     # whole-tree host fetch in host-side setup
     return np.asarray(params)
+
+
+# ISSUE 11 journey/flight-recorder paths: pure host post-processing
+# over already-emitted event dicts is fine
+def build_journeys(events):
+    by_trace = {}
+    for e in events:
+        if e.get("trace") is not None:
+            by_trace.setdefault(e["trace"], []).append(e)
+    return by_trace
+
+
+def record_event(ring, rec):
+    # an EventLog listener consumes the already-host record verbatim
+    ring.append(rec)
+
+
+def dump_bundle(write_fn, tail, health_sources):
+    # bundle content = host dicts only (events, health snapshots)
+    write_fn("events.jsonl", list(tail))
+    write_fn("health.json", {k: fn() for k, fn in health_sources})

@@ -10,3 +10,15 @@ def emit_metric(step, loss):
 def write_raw(msg):
     sys.stdout.write(msg + "\n")  # BAD
     sys.stderr.write("warn: " + msg)  # BAD
+
+
+# ISSUE 11: the flight recorder writes bundle FILES, never stdout — a
+# print() would interleave with the bench/drill JSON that indexes it
+def dump_bundle(outdir, manifest):
+    print(f"incident dumped to {outdir}")  # BAD
+    return manifest
+
+
+def build_journeys(events):
+    print(len(events), "events")  # BAD
+    return []

@@ -15,3 +15,10 @@ def emit_metric(step, loss):
 
 def emit_event(emit_event_fn, step):
     emit_event_fn("train_step", step=step)
+
+
+# ISSUE 11: the flight recorder reports through the event log (its
+# incident_dump record) and the logger — never stdout
+def dump_bundle(emit_event_fn, outdir, slug):
+    logger.info("flight recorder dumped %s to %s", slug, outdir)
+    emit_event_fn("incident_dump", incident=slug, bundle=outdir)

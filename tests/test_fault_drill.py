@@ -69,13 +69,16 @@ def test_elastic_drill_leg(tmp_path, leg):
                                  "serve_watchdog", "serve_prefix",
                                  "fleet_failover", "fleet_drain",
                                  "fleet_autoscale",
-                                 "fleet_tp_failover"])
+                                 "fleet_tp_failover",
+                                 "fleet_journey"])
 def test_serving_drill_leg(tmp_path, leg):
-    """ISSUE 4 + ISSUE 7 + ISSUE 10: the serving-plane reliability
-    drills (poisoned co-batch, overload shed, deadline expiry,
-    retry-then-succeed, watchdog trip) and the fleet drills (failover
-    bit-identity — including across sharding layouts, drain, SLO
-    autoscaling) run bit-deterministically on every tier-1 pass.
+    """ISSUE 4 + ISSUE 7 + ISSUE 10 + ISSUE 11: the serving-plane
+    reliability drills (poisoned co-batch, overload shed, deadline
+    expiry, retry-then-succeed, watchdog trip), the fleet drills
+    (failover bit-identity — including across sharding layouts, drain,
+    SLO autoscaling) and the observability drill (request journeys
+    across handoff/failover with byte-identical flight-recorder
+    bundles) run bit-deterministically on every tier-1 pass.
     Legs must actually DRILL here: the CPU-mesh conftest gives them 8
     devices, so the device-count skip escape is asserted shut."""
     fd = _load_drill()

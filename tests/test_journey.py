@@ -1,0 +1,407 @@
+"""Request journeys + incident flight recorder (ISSUE 11): the
+journey builder over synthetic and real event streams, Perfetto
+export (pure parse), the flight recorder's trigger/dump/determinism
+contracts, and the obs_report journeys/incidents/per-layout sections.
+
+The heavier end-to-end pins live in scripts/fault_drill.py
+(fleet_journey: handoff + cross-layout failover journeys, byte-
+identical bundles across runs) — these tests cover the units and the
+single-engine integration."""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import obs
+from bigdl_tpu.obs.flightrecorder import FlightRecorder, default_trigger
+from bigdl_tpu.obs.journey import (build_journeys, journeys_json,
+                                   summarize_journeys, to_perfetto)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    prev = obs.set_enabled(True)
+    obs.reset_all()
+    yield
+    obs.reset_all()
+    obs.set_enabled(prev)
+
+
+# ------------------------------------------------------ journey builder
+
+def _ev(kind, trace, hop, ts, **f):
+    return {"schema": 1, "ts": ts, "seq": 0, "kind": kind,
+            "trace": trace, "hop": hop, **f}
+
+
+def test_build_journeys_failover_shape():
+    """A failover journey: submit@e0, transitional failed terminal,
+    re-submit@e1, done — one journey, two hops, the failed terminal
+    superseded, dwell attributed per hop."""
+    evs = [
+        _ev("request_submit", "r0/0", 0, 1.0, engine="e0", tp=2,
+            role="both", request=0),
+        _ev("request_terminal", "r0/0", 0, 3.0, engine="e0",
+            status="failed", reason="failed", tokens=1, request=0),
+        _ev("request_submit", "r0/0", 1, 3.5, engine="e1", tp=1,
+            role="both", request=0),
+        _ev("router_failover", "r0/0", 1, 3.5, source="e0",
+            target="e1", request=0),
+        _ev("request_terminal", "r0/0", 1, 6.0, engine="e1",
+            status="done", reason="max_tokens", tokens=5, request=0,
+            ttft_s=0.5, latency_s=5.0),
+    ]
+    (j,) = build_journeys(evs)
+    assert j["trace"] == "r0/0" and j["request"] == 0
+    assert j["complete"] and j["lost_hops"] == []
+    assert j["superseded_terminals"] == 1
+    assert j["status"] == "done" and j["tokens"] == 5
+    assert j["engines"] == ["e0", "e1"]
+    assert j["layouts"] == [2, 1]
+    assert j["cross_engine"] and j["cross_layout"]
+    h0, h1 = j["hops"]
+    assert h0["via"] == "request_submit" and h0["dwell_s"] == 2.5
+    assert h1["dwell_s"] == 2.5           # 3.5 -> terminal at 6.0
+    assert h0["events"]["request_terminal"] == 1
+    assert h1["events"]["router_failover"] == 1
+
+
+def test_build_journeys_handoff_and_lost_hops():
+    """Disagg-prefill journey (submit@prefill -> handoff_import@
+    decode) plus a broken trace whose hop 1 never seated."""
+    evs = [
+        _ev("request_submit", "t/a", 0, 0.0, engine="pf0", tp=1,
+            role="prefill", request=1),
+        _ev("handoff_export", "t/a", 0, 1.0, engine="pf0", request=1),
+        _ev("handoff_import", "t/a", 1, 2.0, engine="e0", tp=2,
+            role="both", request=1, source="pf0"),
+        _ev("request_terminal", "t/a", 1, 4.0, engine="e0",
+            status="done", reason="stop_id", tokens=3, request=1),
+        # trace t/b: a non-terminal annotation on hop 1 with no seat
+        # (and no settlement) — a genuinely LOST hop
+        _ev("request_submit", "t/b", 0, 0.0, engine="e1", tp=1,
+            role="both", request=2),
+        _ev("prefix_hit", "t/b", 1, 4.0, engine="e1", request=2,
+            matched_tokens=4, blocks=1),
+        _ev("request_terminal", "t/b", 0, 5.0, engine="e1",
+            status="done", reason="max_tokens", tokens=2, request=2),
+        # trace t/c: shed ON ARRIVAL at the receiving engine after a
+        # move (hop 1 terminal, never seated) — terminal-only, NOT lost
+        _ev("request_submit", "t/c", 0, 0.0, engine="e0", tp=1,
+            role="both", request=3),
+        _ev("request_terminal", "t/c", 1, 2.0, engine="e1",
+            status="shed", reason="shed", tokens=0, request=3),
+    ]
+    ja, jb, jc = build_journeys(evs)
+    assert ja["hops"][0]["role"] == "prefill"
+    assert ja["hops"][1]["via"] == "handoff_import"
+    assert ja["complete"] and not ja["lost_hops"]
+    assert ja["hops"][0]["events"]["handoff_export"] == 1
+    assert jb["lost_hops"] == [1] and not jb["complete"]
+    assert jc["lost_hops"] == [] and jc["complete"]
+    assert jc["status"] == "shed"
+    s = summarize_journeys([ja, jb, jc])
+    assert s["count"] == 3 and s["complete"] == 2
+    assert s["lost_hops"] == 1 and s["max_hops"] == 2
+
+
+def test_rejected_bounce_is_attempt_not_lost_hop():
+    """A rebalance/failover move that bounces off a full queue emits
+    request_rejected at the PRE-incremented hop before the router
+    undoes the increment — that phantom hop is a rejected ATTEMPT,
+    never a lost hop (the request settled fine where it was)."""
+    evs = [
+        _ev("request_submit", "t/r", 0, 0.0, engine="e0", tp=1,
+            role="both", request=5),
+        _ev("request_rejected", "t/r", 1, 1.0, engine="e1", request=5,
+            queue_depth=2),
+        _ev("request_terminal", "t/r", 0, 3.0, engine="e0",
+            status="done", reason="max_tokens", tokens=3, request=5),
+    ]
+    (j,) = build_journeys(evs)
+    assert j["complete"] and j["lost_hops"] == []
+    assert j["rejected_attempts"] == 1
+    assert len(j["hops"]) == 1 and j["hops"][0]["engine"] == "e0"
+    assert j["status"] == "done"
+
+
+def test_journeys_json_and_perfetto_parse():
+    evs = [
+        _ev("request_submit", "t/x", 0, 1.0, engine="e0", tp=1,
+            role="both", request=9),
+        _ev("request_terminal", "t/x", 0, 2.0, engine="e0",
+            status="done", reason="max_tokens", tokens=4, request=9),
+    ]
+    js = build_journeys(evs)
+    # canonical rendering is stable and parseable
+    assert json.loads(journeys_json(js)) == json.loads(
+        journeys_json(build_journeys(evs)))
+    doc = json.loads(json.dumps(to_perfetto(js)))
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert "thread_name" in names                 # track metadata
+    x = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(x) == 1 and x[0]["ts"] == 1.0e6 and x[0]["dur"] == 1.0e6
+    assert any(e["ph"] == "i" for e in doc["traceEvents"])
+    # events without a trace produce no journeys
+    assert build_journeys([{"kind": "train_step", "ts": 0.0}]) == []
+
+
+# ------------------------------------------------------ flight recorder
+
+def test_default_trigger_set():
+    assert default_trigger({"kind": "engine_degraded"}) \
+        == "engine_degraded"
+    assert default_trigger({"kind": "request_terminal",
+                            "status": "poisoned"}) == "poisoned"
+    assert default_trigger({"kind": "request_terminal", "status": "done",
+                            "reason": "pool_exhausted"}) \
+        == "pool_exhausted"
+    assert default_trigger({"kind": "request_terminal",
+                            "status": "done"}) is None
+    assert default_trigger({"kind": "preempted"}) == "preempted"
+    assert default_trigger({"kind": "fault_injected",
+                            "fault": "preempt"}) == "preempted"
+    assert default_trigger({"kind": "checkpoint_corrupt_skipped"}) \
+        == "checkpoint_corrupt"
+    assert default_trigger({"kind": "train_step"}) is None
+
+
+def _drive(outdir, clk):
+    """One synthetic incident run under an injected clock; returns the
+    recorder (bundles written into `outdir`)."""
+    obs.reset_all(clock=lambda: clk["t"])
+    rec = FlightRecorder(outdir, clock=lambda: clk["t"])
+    rec.register_health_source("e0", lambda: {"state": "degraded",
+                                              "watchdog_trips": 1})
+    rec.install()
+    obs.emit_event("request_submit", plane="serving", engine="e0",
+                   request=0, trace="r/0", hop=0, tp=1, role="both")
+    clk["t"] += 1.0
+    obs.emit_event("engine_degraded", plane="serving", engine="e0",
+                   reason="watchdog trip at decode step 2: budget")
+    clk["t"] += 1.0
+    obs.emit_event("request_terminal", plane="serving", engine="e0",
+                   request=0, trace="r/0", hop=0, status="failed",
+                   reason="failed", tokens=0)
+    rec.close()
+    return rec
+
+
+def test_flight_recorder_dump_and_determinism(tmp_path):
+    """A trigger event dumps a full bundle (manifest/events/components/
+    health/registry/journeys) whose event tail names the failing step;
+    two identical runs under the injected clock produce byte-identical
+    bundle files; the dump indexes itself via an incident_dump event."""
+    runs = []
+    for tag in ("a", "b"):
+        outdir = str(tmp_path / tag)
+        rec = _drive(outdir, {"t": 10.0})
+        assert rec.bundles == ["incident-000-engine_degraded"]
+        assert rec.triggers_seen == 1
+        bundle = os.path.join(outdir, rec.bundles[0])
+        files = sorted(os.listdir(bundle))
+        assert files == ["components.json", "events.jsonl",
+                         "health.json", "journeys.json",
+                         "manifest.json", "registry.json"]
+        with open(os.path.join(bundle, "manifest.json")) as f:
+            man = json.load(f)
+        assert man["incident"] == "engine_degraded"
+        assert man["component"] == "e0"
+        assert man["trigger"]["kind"] == "engine_degraded"
+        assert "decode step 2" in man["trigger"]["reason"]
+        with open(os.path.join(bundle, "events.jsonl")) as f:
+            tail = [json.loads(ln) for ln in f]
+        assert any(e["kind"] == "engine_degraded"
+                   and "decode step 2" in e["reason"] for e in tail)
+        with open(os.path.join(bundle, "health.json")) as f:
+            assert json.load(f)["e0"]["watchdog_trips"] == 1
+        with open(os.path.join(bundle, "journeys.json")) as f:
+            (j,) = json.load(f)
+        assert j["trace"] == "r/0" and j["engines"] == ["e0"]
+        # the dump indexed itself in the event record
+        dumps = obs.get_event_log().events("incident_dump")
+        assert len(dumps) == 1
+        assert dumps[0]["bundle"] == rec.bundles[0]
+        assert dumps[0]["trigger_kind"] == "engine_degraded"
+        runs.append({
+            f: open(os.path.join(bundle, f), "rb").read()
+            for f in files})
+    assert runs[0] == runs[1]                  # byte-identical bundles
+
+
+def test_flight_recorder_off_switch_and_budget(tmp_path):
+    """BIGDL_OBS=off kills the recorder (no rings, no dumps); the
+    bundle budget caps dumps but keeps counting triggers."""
+    rec = FlightRecorder(str(tmp_path / "off")).install()
+    obs.set_enabled(False)
+    obs.get_event_log().emit("engine_degraded", engine="e0", reason="x")
+    # emit_event (the gated path) wouldn't even reach the log; a direct
+    # log.emit DOES reach the listener, which must early-out on the
+    # kill switch itself
+    assert rec.bundles == [] and rec.triggers_seen == 0
+    obs.set_enabled(True)
+    rec.close()
+
+    rec2 = FlightRecorder(str(tmp_path / "cap"), max_bundles=1).install()
+    for i in range(3):
+        obs.emit_event("engine_degraded", engine=f"e{i}", reason="r")
+    rec2.close()
+    assert len(rec2.bundles) == 1 and rec2.triggers_seen == 3
+    # a failing health source never blocks the dump
+    rec3 = FlightRecorder(str(tmp_path / "err")).install()
+    rec3.register_health_source("bad", lambda: 1 / 0)
+    obs.emit_event("engine_degraded", engine="e9", reason="r")
+    rec3.close()
+    bundle = os.path.join(str(tmp_path / "err"), rec3.bundles[0])
+    with open(os.path.join(bundle, "health.json")) as f:
+        assert "error" in json.load(f)["bad"]
+
+
+def test_listener_api_and_removal():
+    log = obs.get_event_log()
+    seen = []
+    log.add_listener(seen.append)
+    obs.emit_event("tick", i=0)
+    log.remove_listener(seen.append)
+    obs.emit_event("tick", i=1)
+    assert [e["i"] for e in seen] == [0]
+    log.remove_listener(seen.append)           # idempotent
+    # a raising listener never breaks emit
+    def boom(rec):
+        raise RuntimeError("x")
+    log.add_listener(boom)
+    assert obs.emit_event("tick", i=2)["i"] == 2
+    log.remove_listener(boom)
+
+
+# --------------------------------------------- engine integration (CPU)
+
+def _tiny_lm():
+    import jax
+
+    from bigdl_tpu.models.transformer import build_lm
+
+    m = build_lm(vocab_size=50, dim=32, num_heads=2, num_layers=1,
+                 max_len=64)
+    m.build(jax.random.PRNGKey(0))
+    return m
+
+
+def test_engine_journeys_and_poison_bundle(tmp_path):
+    """A bare engine (no router) stamps its own trace context; the
+    journey builder reconstructs one single-hop journey per request;
+    a poisoned request trips the flight recorder; and the whole new
+    layer stays inside the compile contract — #buckets+1 traces with
+    journeys + recorder armed, zero on wave 2."""
+    from bigdl_tpu.serving import InferenceEngine, Request
+    from bigdl_tpu.utils import faults
+
+    m = _tiny_lm()
+    rec = FlightRecorder(str(tmp_path)).install()
+    eng = InferenceEngine(m, slots=2, prefill_buckets=(8, 16),
+                          obs_label="solo")
+    rec.register_health_source("solo", eng.health)
+    rng = np.random.RandomState(0)
+    reqs = [Request(prompt=list(rng.randint(1, 50, n)),
+                    max_new_tokens=3) for n in (3, 10, 6, 12)]
+    res = eng.run(reqs)
+    assert all(r.status == "done" for r in res)
+    assert eng.stats["prefill_traces"] == 2       # both buckets
+    assert eng.stats["decode_traces"] == 1        # ONE executable
+    # wave 2 under the armed recorder: nothing new compiles
+    faults.set_plan(faults.FaultPlan("serve_nan@" +
+                                     str(eng.stats["decode_steps"])))
+    try:
+        res2 = eng.run([Request(prompt=[1, 2, 3], max_new_tokens=4)])
+    finally:
+        faults.set_plan(None)
+    assert eng.stats["prefill_traces"] == 2
+    assert eng.stats["decode_traces"] == 1
+    assert res2[0].status == "poisoned"
+    rec.close()
+    # every request reconstructs to ONE complete single-hop journey
+    journeys = build_journeys(obs.get_event_log().events())
+    assert len(journeys) == 5
+    assert all(j["complete"] and not j["lost_hops"] for j in journeys)
+    assert all(len(j["hops"]) == 1
+               and j["hops"][0]["engine"] == "solo"
+               and j["hops"][0]["tp"] == 1 for j in journeys)
+    assert {j["status"] for j in journeys} == {"done", "poisoned"}
+    # the poisoned terminal tripped a bundle naming the request
+    assert rec.bundles and "poisoned" in rec.bundles[0]
+    with open(os.path.join(str(tmp_path), rec.bundles[0],
+                           "manifest.json")) as f:
+        man = json.load(f)
+    assert man["trigger"]["status"] == "poisoned"
+    assert man["component"] == "solo"
+
+
+# ------------------------------------------------------------ obs_report
+
+def _load_report():
+    path = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                        "obs_report.py")
+    spec = importlib.util.spec_from_file_location("obs_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_obs_report_journeys_incidents_and_layout(tmp_path, capsys):
+    """The new report sections: per-engine SLO carries tp/role with a
+    per-layout rollup, the journeys section tables per-request hops,
+    incidents digests the flight-recorder dumps, and --perfetto writes
+    a loadable journey trace."""
+    path = tmp_path / "run.jsonl"
+    obs.set_event_log(obs.EventLog(path=str(path), clock=lambda: 1.0))
+    obs.emit_event("request_submit", plane="serving", engine="e0",
+                   request=0, prompt_len=3, priority=0, tp=2,
+                   role="both", trace="r0/0", hop=0)
+    obs.emit_event("request_terminal", plane="serving", engine="e0",
+                   request=0, status="failed", reason="failed",
+                   tokens=1, ttft_s=None, latency_s=1.0, tp=2,
+                   role="both", trace="r0/0", hop=0)
+    obs.emit_event("request_submit", plane="serving", engine="e1",
+                   request=0, prompt_len=3, priority=0, tp=1,
+                   role="both", trace="r0/0", hop=1)
+    obs.emit_event("request_terminal", plane="serving", engine="e1",
+                   request=0, status="done", reason="max_tokens",
+                   tokens=5, ttft_s=0.5, latency_s=2.0, tp=1,
+                   role="both", trace="r0/0", hop=1)
+    obs.emit_event("incident_dump", incident="engine_degraded",
+                   bundle="incident-000-engine_degraded",
+                   component="e0", trigger_kind="engine_degraded",
+                   events_in_tail=4)
+    obs.get_event_log().close()
+
+    rep = _load_report()
+    events = obs.read_jsonl(str(path))
+    s = rep.summarize(events)
+    assert s["slo"]["per_engine"]["e0"]["tp"] == 2
+    assert s["slo"]["per_engine"]["e1"]["role"] == "both"
+    assert set(s["slo"]["per_layout"]) == {"tp=1", "tp=2"}
+    assert s["slo"]["per_layout"]["tp=1"]["done"] == 1
+    j = s["journeys"]
+    assert j["summary"]["count"] == 1
+    assert j["summary"]["cross_engine"] == 1
+    assert j["summary"]["cross_layout"] == 1
+    assert j["summary"]["superseded_terminals"] == 1
+    assert j["table"][0]["hops"][0]["engine"] == "e0"
+    assert j["table"][0]["status"] == "done"
+    inc = s["incidents"]
+    assert inc["count"] == 1
+    assert inc["by_incident"] == {"engine_degraded": 1}
+    assert inc["bundles"][0]["component"] == "e0"
+    # render + perfetto export through the CLI
+    out_trace = str(tmp_path / "journeys.json")
+    assert rep.main([str(path), "--perfetto", out_trace]) == 0
+    txt = capsys.readouterr().out
+    assert "request journeys:" in txt
+    assert "incidents (flight recorder):" in txt
+    assert "tp=2" in txt
+    with open(out_trace) as f:
+        doc = json.load(f)
+    assert any(e.get("cat") == "journey" for e in doc["traceEvents"])
