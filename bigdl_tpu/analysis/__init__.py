@@ -1,13 +1,15 @@
 """graftlint — JAX-aware static analysis for this repo's contracts.
 
-`engine` holds the machinery (Rule registry, suppressions, baseline);
-`rules/` the repo-specific checks; `scripts/graftlint.py` the CLI;
-`tests/test_graftlint.py` the tier-1 gate (full tree clean modulo a
-shrink-only baseline).
+`engine` holds the machinery (Rule/ProjectRule registry, suppressions,
+baseline, the two-pass driver); `project` the shared single-parse
+ProjectContext behind the cross-module rules (ISSUE 13); `astutil`
+the generic AST helpers; `rules/` the repo-specific checks;
+`scripts/graftlint.py` the CLI; `tests/test_graftlint.py` the tier-1
+gate (full tree clean modulo a shrink-only baseline).
 """
 
 from bigdl_tpu.analysis.engine import (  # noqa: F401
-    BaselineEntry, Finding, Rule, RULES, apply_baseline,
+    BaselineEntry, Finding, ProjectRule, Rule, RULES, apply_baseline,
     format_baseline, iter_python_files, lint_file, lint_source,
     load_baseline, parse_baseline, register, run_lint,
 )

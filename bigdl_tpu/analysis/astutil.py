@@ -1,0 +1,102 @@
+"""Shared AST helpers for graftlint rules and the ProjectContext.
+
+Lives outside `analysis/rules/` so `analysis/project.py` can use the
+helpers without importing the rules package (whose __init__ imports
+every rule module, several of which import project — a cycle).
+`rules/_common.py` re-exports everything for the rule modules."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Sequence, Set, Tuple
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'os.environ.get' for a Name/Attribute chain, None otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def last_segment(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def walk_calls(node: ast.AST) -> Iterator[ast.Call]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            yield n
+
+
+def functions(tree: ast.AST) -> Iterator[ast.FunctionDef]:
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield n
+
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+
+def jit_decoration(fn: ast.FunctionDef
+                   ) -> Optional[Tuple[Set[int], Set[str]]]:
+    """If `fn` is decorated as a jit root, return (static_argnums,
+    static_argnames); else None. Handles `@jax.jit`,
+    `@functools.partial(jax.jit, static_argnums=..., ...)` and
+    `@partial(jax.jit, ...)`."""
+    for dec in fn.decorator_list:
+        if dotted(dec) in _JIT_NAMES:
+            return set(), set()
+        if isinstance(dec, ast.Call):
+            name = call_name(dec)
+            if name in _JIT_NAMES:
+                return _static_args(dec)
+            if last_segment(name) == "partial" and dec.args \
+                    and dotted(dec.args[0]) in _JIT_NAMES:
+                return _static_args(dec)
+    return None
+
+
+def _static_args(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums |= {int(v) for v in int_tuple(kw.value)}
+        elif kw.arg == "static_argnames":
+            names |= set(str_tuple(kw.value))
+    return nums, names
+
+
+def int_tuple(node: ast.AST) -> Sequence[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, int)]
+    return []
+
+
+def str_tuple(node: ast.AST) -> Sequence[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)]
+    return []
+
+
+def param_names(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    return ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+            + [p.arg for p in a.kwonlyargs])

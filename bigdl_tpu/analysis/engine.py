@@ -15,6 +15,11 @@ Pieces:
   `Finding`s); registered via the `@register` decorator, carries a
   severity and a path scope so e.g. the nn-docstring rule never runs
   over `serving/`.
+* `ProjectRule` — a cross-module check (ISSUE 13) run once per lint
+  over the shared `ProjectContext` (`analysis/project.py`) that pass 1
+  builds from the SAME parsed FileContexts — the two-pass engine
+  parses every file exactly once (PARSE_OBSERVERS lets the tier-1
+  gate pin that).
 * `FileContext` — one file parsed once (AST + source lines + the
   per-line suppression table), shared by every rule.
 * suppressions — `# graftlint: disable=rule-a,rule-b` on the offending
@@ -41,8 +46,8 @@ import ast
 import dataclasses
 import os
 import re
-from typing import Callable, Dict, Iterable, Iterator, List, Optional, \
-    Sequence, Tuple
+from typing import Callable, Dict, Iterable, Iterator, List, \
+    Optional, Sequence, Tuple, Union
 
 SEVERITIES = ("error", "warning")
 
@@ -120,6 +125,13 @@ class _Suppressions:
         return "*" in here or rule in here
 
 
+# observers called with the repo-relative path each time a file is
+# PARSED into a FileContext — tests/test_graftlint.py hooks this to pin
+# the "every file parsed exactly once per run" contract of the shared
+# two-pass engine (ISSUE 13)
+PARSE_OBSERVERS: List[Callable[[str], None]] = []
+
+
 class FileContext:
     """One source file, parsed once and handed to every rule."""
 
@@ -128,6 +140,8 @@ class FileContext:
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
+        for _obs in PARSE_OBSERVERS:
+            _obs(path)
         self.suppressions = _Suppressions(self.lines)
         # lazily-built parent map for rules that need upward navigation
         self._parents: Optional[Dict[ast.AST, ast.AST]] = None
@@ -176,6 +190,22 @@ class Rule:
         return Finding(self.name, ctx.path, getattr(node, "lineno", 1),
                        getattr(node, "col_offset", 0) + 1, message,
                        self.severity)
+
+
+class ProjectRule(Rule):
+    """A cross-module rule: checked once per run over the shared
+    `ProjectContext` (pass 2) instead of per file. Subclasses implement
+    `check_project(pctx)`; the per-file `check` is a no-op. Project
+    rules run on full-tree lints and wherever an explicit
+    `project_scope` is supplied (the fixture trees, `--changed-only`);
+    a bare path-subset run skips them — a subset cannot distinguish
+    "never bumped" from "bumped in a file outside the subset"."""
+
+    def check(self, ctx: "FileContext") -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, pctx) -> Iterable[Finding]:
+        raise NotImplementedError
 
 
 RULES: Dict[str, Rule] = {}
@@ -360,25 +390,70 @@ def lint_source(rel_path: str, source: str,
     return out
 
 
-def lint_file(root: str, rel_path: str,
-              rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+def _parse_file(root: str, rel_path: str
+                ) -> Union[FileContext, Finding]:
     with open(os.path.join(root, rel_path)) as f:
         source = f.read()
     try:
-        return lint_source(rel_path, source, rules)
+        return FileContext(rel_path, source)
     except SyntaxError as e:
-        return [Finding("parse-error", rel_path, e.lineno or 1, 1,
-                        f"cannot parse: {e.msg}", "error")]
+        return Finding("parse-error", rel_path, e.lineno or 1, 1,
+                       f"cannot parse: {e.msg}", "error")
+
+
+def _check_file(ctx: FileContext, rules: Sequence[Rule]
+                ) -> List[Finding]:
+    out: List[Finding] = []
+    for rule in rules:
+        if not rule.applies_to(ctx.path):
+            continue
+        for f in rule.check(ctx):
+            if not ctx.suppressions.suppressed(f.rule, f.line):
+                out.append(f)
+    return out
+
+
+def lint_file(root: str, rel_path: str,
+              rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    _ensure_rules_loaded()
+    ctx = _parse_file(root, rel_path)
+    if isinstance(ctx, Finding):
+        return [ctx]
+    if rules is None:
+        rules = list(RULES.values())
+    return sorted(_check_file(ctx, rules),
+                  key=lambda f: (f.path, f.line, f.col, f.rule))
 
 
 def run_lint(root: str,
              paths: Optional[Sequence[str]] = None,
-             rule_names: Optional[Sequence[str]] = None
+             rule_names: Optional[Sequence[str]] = None,
+             project_scope: Optional[Sequence[str]] = None
              ) -> List[Finding]:
-    """Lint `paths` (repo-relative; default: the whole DEFAULT_ROOTS
-    tree) under repo `root`. Baseline is NOT applied here — callers
-    subtract it explicitly via `apply_baseline` so the stale-entry
-    check stays visible."""
+    """Two-pass lint under repo `root` (ISSUE 13).
+
+    Pass 1 parses every target file exactly once into a `FileContext`
+    and runs the per-file rules over `paths` (repo-relative; default:
+    the whole DEFAULT_ROOTS tree). Pass 2 folds the SAME parsed
+    contexts into one `ProjectContext` and runs the cross-module
+    `ProjectRule`s over it.
+
+    `project_scope` controls pass 2's view of the project:
+      * None + full-tree run → the project is the full tree (the tier-1
+        gate's mode); None + explicit `paths` → pass 2 is SKIPPED (a
+        bare subset cannot answer cross-module questions);
+      * "full" → the ProjectContext is built from the full tree even
+        when `paths` is a subset, and project findings are reported
+        WHEREVER they anchor — a changed file can break a contract
+        whose finding lands in an unchanged file (delete a kind from
+        EVENT_KINDS and the orphaned emit sites elsewhere fire), and
+        against a gate-clean baseline any project finding is caused by
+        the subset (the `--changed-only` mode);
+      * an explicit path list → the project is exactly those files
+        (the fixture mini-package trees).
+
+    Baseline is NOT applied here — callers subtract it explicitly via
+    `apply_baseline` so the stale-entry check stays visible."""
     _ensure_rules_loaded()
     if rule_names is None:
         rules = list(RULES.values())
@@ -388,10 +463,51 @@ def run_lint(root: str,
             raise ValueError(f"unknown rule(s): {unknown}; known: "
                              f"{sorted(RULES)}")
         rules = [RULES[n] for n in rule_names]
+    file_rules = [r for r in rules if not isinstance(r, ProjectRule)]
+    project_rules = [r for r in rules if isinstance(r, ProjectRule)]
+
+    full_tree = paths is None
     if paths is None:
         paths = list(iter_python_files(root))
+    contexts: Dict[str, FileContext] = {}
     findings: List[Finding] = []
     for rel in paths:
-        findings.extend(lint_file(root, rel, rules))
+        ctx = _parse_file(root, rel)
+        if isinstance(ctx, Finding):
+            findings.append(ctx)
+            continue
+        contexts[rel] = ctx
+        findings.extend(_check_file(ctx, file_rules))
+
+    run_project = project_rules and (
+        full_tree or project_scope is not None)
+    if run_project:
+        if project_scope is not None and project_scope != "full":
+            project_paths = list(project_scope)  # explicit list wins
+        elif full_tree:
+            project_paths = paths       # one filesystem walk, not two
+        else:                           # project_scope == "full"
+            project_paths = list(iter_python_files(root))
+        for rel in project_paths:
+            if rel not in contexts:
+                ctx = _parse_file(root, rel)
+                if not isinstance(ctx, Finding):
+                    contexts[rel] = ctx
+        from bigdl_tpu.analysis.project import ProjectContext
+        pctx = ProjectContext(
+            root, {p: contexts[p] for p in project_paths
+                   if p in contexts})
+        # project findings are never filtered to the `paths` subset:
+        # in "full" mode a changed file's breakage may anchor in an
+        # unchanged one, and the gate keeps HEAD clean — so whatever
+        # pass 2 finds was caused by the subset
+        for rule in project_rules:
+            for f in rule.check_project(pctx):
+                ctx = contexts.get(f.path)
+                if ctx is not None and ctx.suppressions.suppressed(
+                        f.rule, f.line):
+                    continue
+                findings.append(f)
+
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

@@ -2,7 +2,11 @@
 with `bigdl_tpu.analysis.engine.RULES`."""
 
 from bigdl_tpu.analysis.rules import (  # noqa: F401
+    donation_flow,
+    event_kind_contract,
     hidden_device_sync,
+    lock_discipline,
+    metric_family_contract,
     missing_reference_docstring,
     nondeterministic_drill,
     retrace_hazard,

@@ -1,0 +1,243 @@
+"""donation-flow — a buffer donated to a jitted call must not be read
+again by the caller.
+
+`donate_argnums` aliases the argument's device buffer into the
+executable's outputs: after the call returns, the donated array is
+DELETED on accelerator backends — touching it raises (or, worse,
+silently recomputes through a stale reference on backends that ignore
+donation, so the bug only fires on TPU). PR 4's donation-aware retry
+fixed exactly this class by hand; this rule pins it mechanically.
+
+Cross-module resolution via the ProjectContext:
+
+* functions **decorated** `@partial(jax.jit, donate_argnums=...)` are
+  donating callables under their own name (`_decode_step` style);
+* a function **returning** `jax.jit(f, donate_argnums=...)` is a
+  donating *factory*: any binding assigned from a call to it
+  (`step = make_dp_train_step(...)`, `self._step = self._make_step()`)
+  donates at the same positions;
+* a binding assigned `jax.jit(f, donate_argnums=...)` directly
+  donates too.
+
+At each call of a donating callable, every donated positional argument
+that is a bare name or `self.<attr>` is tracked through the REST of
+the enclosing function (linear statement order, nested defs excluded):
+if the next mention is a read — not a rebind — it fires. Rebinding via
+the call's own assignment targets (`state = step(state, batch)`, the
+sanctioned pattern) is safe; `*args` splats and non-name arguments are
+out of static reach and skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from bigdl_tpu.analysis.engine import ProjectRule, register
+from bigdl_tpu.analysis.project import is_donating_jit_call
+from bigdl_tpu.analysis.rules._common import call_name, functions, \
+    last_segment
+
+
+def _expr_key(node) -> Optional[str]:
+    """'x' for Name, 'self.x' for a self attribute — the trackable
+    donated-argument shapes."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return "self." + node.attr
+    return None
+
+
+@register
+class DonationFlow(ProjectRule):
+    name = "donation-flow"
+    severity = "error"
+    description = ("argument donated via donate_argnums read again "
+                   "after the jitted call")
+
+    def check_project(self, pctx):
+        for path, ctx in pctx.files.items():
+            yield from self._check_file(pctx, path, ctx)
+
+    def _check_file(self, pctx, path, ctx):
+        class_bindings = self._class_donating_bindings(pctx, ctx)
+        for fn in functions(ctx.tree):
+            bindings = dict(self._enclosing_class_bindings(
+                ctx, fn, class_bindings))
+            bindings.update(self._local_donating_bindings(pctx, fn))
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                donated = self._donated_positions(pctx, bindings, call)
+                if not donated \
+                        or any(isinstance(a, ast.Starred)
+                               for a in call.args):
+                    continue
+                stmt = self._enclosing_stmt(ctx, fn, call)
+                if stmt is None:
+                    continue
+                rebound = self._assign_targets(stmt)
+                for pos in donated:
+                    if pos >= len(call.args):
+                        continue
+                    key = _expr_key(call.args[pos])
+                    if key is None or key in rebound:
+                        continue
+                    hit = self._first_use_after(fn, stmt, key)
+                    if hit is not None:
+                        yield self.finding(
+                            ctx, hit,
+                            f"`{key}` was donated to the jitted call "
+                            f"at line {call.lineno} (donate_argnums "
+                            f"position {pos}) and is read again here — "
+                            f"its device buffer is deleted after the "
+                            f"call; use the call's result or copy "
+                            f"before dispatch (the donation-aware "
+                            f"retry pattern)")
+
+    # ------------------------------------------------------------ helpers
+    @classmethod
+    def _class_donating_bindings(cls, pctx, ctx
+                                 ) -> Dict[ast.ClassDef,
+                                           Dict[str, Tuple[int, ...]]]:
+        """Per class: 'self.X' → donated positions for attributes
+        assigned a donating jit/factory anywhere in the class — the
+        `self._step = self._make_step()` setup-in-__init__,
+        call-elsewhere pattern."""
+        out: Dict[ast.ClassDef, Dict[str, Tuple[int, ...]]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                b = cls._donating_assigns(pctx, node, self_only=True)
+                if b:
+                    out[node] = b
+        return out
+
+    @staticmethod
+    def _enclosing_class_bindings(ctx, fn, class_bindings):
+        cur = ctx.parent(fn)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return class_bindings.get(cur, {})
+            cur = ctx.parent(cur)
+        return {}
+
+    @staticmethod
+    def _donating_assigns(pctx, scope,
+                          self_only: bool = False
+                          ) -> Dict[str, Tuple[int, ...]]:
+        out: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(scope):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.value, ast.Call)):
+                continue
+            key = _expr_key(node.targets[0])
+            if key is None or (self_only
+                               and not key.startswith("self.")):
+                continue
+            donated = is_donating_jit_call(node.value)
+            if not donated:
+                seg = last_segment(call_name(node.value))
+                # a factory name two defs share is ambiguous — skip
+                if pctx.def_counts.get(seg) == 1:
+                    donated = pctx.donating_factories.get(seg, ())
+            if donated:
+                out[key] = donated
+        return out
+
+    @classmethod
+    def _local_donating_bindings(cls, pctx, fn
+                                 ) -> Dict[str, Tuple[int, ...]]:
+        """name/'self.x' → donated positions, for bindings assigned in
+        `fn` from a donating jit expression or factory call."""
+        return cls._donating_assigns(pctx, fn)
+
+    @staticmethod
+    def _donated_positions(pctx, bindings, call) -> Tuple[int, ...]:
+        key = _expr_key(call.func)
+        if key is not None and key in bindings:
+            return bindings[key]
+        # name-based fallback to project-wide donating defs: only for
+        # plain-Name calls of a name that exactly ONE def in the
+        # project carries — attribute chains and shadowed/ambiguous
+        # names are out of static reach
+        if isinstance(call.func, ast.Name):
+            seg = call.func.id
+            if pctx.def_counts.get(seg) == 1 \
+                    and seg in pctx.donating_defs:
+                return pctx.donating_defs[seg]
+        return ()
+
+    @staticmethod
+    def _enclosing_stmt(ctx, fn, call):
+        """The statement of `fn`'s body region containing `call`."""
+        cur = call
+        while cur is not None and cur is not fn:
+            parent = ctx.parent(cur)
+            if isinstance(cur, ast.stmt):
+                return cur
+            cur = parent
+        return None
+
+    @staticmethod
+    def _assign_targets(stmt) -> set:
+        out = set()
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    k = _expr_key(e)
+                    if k is not None:
+                        out.add(k)
+            else:
+                k = _expr_key(t)
+                if k is not None:
+                    out.add(k)
+        return out
+
+    @staticmethod
+    def _first_use_after(fn, stmt, key):
+        """First mention of `key` in `fn` strictly after `stmt` (linear
+        line order, nested function bodies excluded): the node when it
+        is a read, None when it is a rebind (or never mentioned)."""
+        end = stmt.end_lineno or stmt.lineno
+        events: List[Tuple[int, int, str, ast.AST]] = []
+        attr = key.startswith("self.")
+        name = key.split(".", 1)[1] if attr else key
+
+        def visit(node, top):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)) \
+                        and child is not top:
+                    continue
+                if attr and isinstance(child, ast.Attribute) \
+                        and child.attr == name \
+                        and isinstance(child.value, ast.Name) \
+                        and child.value.id == "self":
+                    kind = "read" if isinstance(child.ctx, ast.Load) \
+                        else "bind"
+                    events.append((child.lineno, child.col_offset,
+                                   kind, child))
+                elif not attr and isinstance(child, ast.Name) \
+                        and child.id == name:
+                    kind = "read" if isinstance(child.ctx, ast.Load) \
+                        else "bind"
+                    events.append((child.lineno, child.col_offset,
+                                   kind, child))
+                visit(child, top)
+
+        visit(fn, fn)
+        events.sort(key=lambda e: (e[0], e[1]))
+        for line, col, kind, node in events:
+            if line <= end:
+                continue
+            return node if kind == "read" else None
+        return None

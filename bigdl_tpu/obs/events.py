@@ -11,55 +11,24 @@ Record shape (every record)::
     {"schema": 1, "ts": <clock seconds>, "seq": <monotonic int>,
      "kind": "<event kind>", ...kind-specific fields}
 
-Kinds in use across the codebase (the schema is open — new kinds are
-fine; these are the wired ones):
+The kinds in use across the codebase live in the machine-readable
+`EVENT_KINDS` registry below (ISSUE 13) — kind → required/optional
+fields + a one-line doc. It is THE single source of truth: the journey
+builder derives its seat/lifecycle sets from it, `obs_report` flags
+kinds outside it, `validate_record` checks a parsed record against it,
+and graftlint's `event-kind-contract` rule statically pins every
+`emit_event` call site and kind-literal consumer to it. Emitting an
+unregistered kind still WORKS at runtime (the schema stays open for
+experiments) — but committing one fails the lint gate until it is
+registered here.
 
-    train_step          per optimizer step: step, epoch, loss, lr,
-                        throughput, and (guard armed) gnorm/guard
-    anomaly             guard observation: step, action, gnorm
-    checkpoint_save / checkpoint_load / checkpoint_corrupt_skipped
-                        checkpoint_save carries async/duration_s/
-                        nshards (+ shard on per-unit records of a
-                        sharded save — the whole-checkpoint publish
-                        record is the one WITHOUT a shard field);
-                        checkpoint_load carries sharded/nshards for
-                        sharded dirs (ISSUE 9; obs_report's checkpoint
-                        section digests these)
-    fault_injected      every utils/faults shot that fires: fault, step
-    request_submit / request_terminal   serving lifecycle endpoints
-    engine_degraded     watchdog trip / retry exhaustion
-    prefix_hit          paged-KV prefix reuse at admission: request,
-                        matched_tokens, blocks (ISSUE 8)
-    prefix_evict        LRU prefix blocks evicted under pool
-                        pressure: blocks
-    handoff_export / handoff_import / router_handoff
-                        disaggregated prefill (ISSUE 10): a prefill-
-                        role engine detaches a prefilled request
-                        (request, prompt_len, blocks), a serving
-                        engine seats it (+ source), and the router
-                        records the move (source, target)
-    metrics_snapshot    a full registry snapshot embedded as an event
-                        (obs.log_metrics_snapshot) — gives a JSONL file
-                        self-contained percentiles for obs_report
-    preempted           a worker preemption propagating out of a
-                        training loop (ISSUE 11): step — emitted on the
-                        re-raise path (optim/optimizer.py,
-                        parallel/distri_optimizer.py), a flight-
-                        recorder trigger
-    incident_dump       the flight recorder wrote a post-mortem bundle
-                        (ISSUE 11): incident, bundle, component,
-                        trigger_kind, events_in_tail
-                        (obs/flightrecorder.py; obs_report's
-                        "incidents" section digests these)
-
-Request-journey tracing (ISSUE 11): every request-lifecycle event
-above (request_submit / request_terminal / prefix_hit / handoff_* /
-router_*) additionally carries `trace` (the host-side trace id stamped
+Request-journey tracing (ISSUE 11): the kinds marked `journey` in the
+registry additionally carry `trace` (the host-side trace id stamped
 on the Request at admission) and `hop` (how many times the request has
 moved between engines — failover, rebalance, handoff import), and the
-seat-point events (request_submit, handoff_import) carry the engine's
-`tp` + `role`; `obs/journey.py` folds a JSONL file back into one
-cross-engine timeline per request.
+`seat`-marked kinds (request_submit, handoff_import) carry the
+engine's `tp` + `role`; `obs/journey.py` folds a JSONL file back into
+one cross-engine timeline per request.
 
 The log is ring-buffered in memory (default 4096 records) with an
 optional JSONL file sink; both the clock and the buffer are injectable
@@ -77,10 +46,190 @@ import threading
 from collections import deque
 from typing import Dict, IO, Iterable, List, Optional
 
-__all__ = ["SCHEMA_VERSION", "EventLog", "get_event_log",
-           "set_event_log", "read_jsonl"]
+__all__ = ["SCHEMA_VERSION", "EVENT_KINDS", "EventLog",
+           "get_event_log", "set_event_log", "read_jsonl",
+           "required_fields", "seat_kinds", "validate_record"]
 
 SCHEMA_VERSION = 1
+
+# Machine-readable event-kind registry (ISSUE 13). Per kind:
+#   required — fields every record of the kind carries (graftlint's
+#              event-kind-contract checks call sites statically;
+#              validate_record checks parsed records at runtime);
+#   optional — fields a record MAY carry (everything else is a lint
+#              error at the emit site);
+#   journey  — carries trace/hop journey stamps (obs/journey.py);
+#   seat     — opens a journey hop on an engine (SEAT_KINDS);
+#   doc      — one line for humans.
+# The envelope fields schema/ts/seq/kind are stamped by EventLog.emit
+# and never listed. "plane" (training|serving) is conventional on most
+# kinds and listed per kind.
+EVENT_KINDS: Dict[str, dict] = {
+    # ---- training plane ------------------------------------------------
+    "train_step": {
+        "required": ("plane", "step", "epoch", "lr", "throughput",
+                     "update_applied"),
+        "optional": ("loss", "gnorm"),
+        "doc": "one optimizer step (obs/training.py; loss omitted when "
+               "nothing else fenced it — the piggyback contract)"},
+    "anomaly": {
+        "required": ("plane", "step", "action", "policy", "gnorm"),
+        "optional": (),
+        "doc": "anomaly-guard observation (utils/anomaly.py)"},
+    "fault_injected": {
+        "required": ("fault", "step"),
+        "optional": ("plane",),
+        "doc": "a utils/faults shot fired (drill provenance)"},
+    "preempted": {
+        "required": ("plane", "step"),
+        "optional": (),
+        "doc": "worker preemption re-raised out of a training loop "
+               "(ISSUE 11; flight-recorder trigger)"},
+    "checkpoint_save": {
+        "required": ("step", "path", "async", "duration_s", "nshards"),
+        "optional": ("shard", "mid_cycle", "plane"),
+        "doc": "one save unit; the whole-checkpoint publish record is "
+               "the one WITHOUT a shard field (ISSUE 9)"},
+    "checkpoint_load": {
+        "required": ("path",),
+        "optional": ("sharded", "nshards", "plane"),
+        "doc": "a checkpoint directory loaded (sharded dirs carry "
+               "sharded/nshards)"},
+    "checkpoint_corrupt_skipped": {
+        "required": ("path", "error"),
+        "optional": ("plane",),
+        "doc": "a corrupt checkpoint skipped during latest-discovery "
+               "fallback (flight-recorder trigger)"},
+    "perf_result": {
+        "required": ("plane", "model", "batch_size", "iterations",
+                     "compile_s", "steady_wall_s", "images_per_sec"),
+        "optional": (),
+        "doc": "models/perf.py benchmark result row"},
+    # ---- serving plane: request lifecycle ------------------------------
+    "request_submit": {
+        "required": ("plane", "engine", "request", "prompt_len",
+                     "priority", "tp", "role"),
+        "optional": ("trace", "hop"),
+        "journey": True, "seat": True,
+        "doc": "request admitted to an engine queue (initial dispatch, "
+               "failover resubmission, rebalance move)"},
+    "request_rejected": {
+        "required": ("plane", "engine", "request", "queue_depth"),
+        "optional": ("trace", "hop"),
+        "journey": True,
+        "doc": "submission bounced off a full queue "
+               "(overload_policy='reject')"},
+    "request_terminal": {
+        "required": ("plane", "engine", "request", "status", "reason",
+                     "tokens", "ttft_s", "latency_s", "tp", "role"),
+        "optional": ("trace", "hop"),
+        "journey": True,
+        "doc": "request reached a terminal status "
+               "(done/shed/expired/poisoned/failed)"},
+    "prefix_hit": {
+        "required": ("plane", "engine", "request", "matched_tokens",
+                     "blocks", "prompt_len"),
+        "optional": ("trace", "hop"),
+        "journey": True,
+        "doc": "paged-KV prefix reuse at admission (ISSUE 8)"},
+    "prefix_evict": {
+        "required": ("plane", "engine", "blocks"),
+        "optional": (),
+        "doc": "LRU prefix blocks evicted under pool pressure"},
+    "handoff_export": {
+        "required": ("plane", "engine", "request", "prompt_len",
+                     "blocks"),
+        "optional": ("trace", "hop"),
+        "journey": True,
+        "doc": "prefill-role engine detached a prefilled request "
+               "(ISSUE 10)"},
+    "handoff_import": {
+        "required": ("plane", "engine", "request", "prompt_len",
+                     "blocks", "source", "tp", "role"),
+        "optional": ("trace", "hop"),
+        "journey": True, "seat": True,
+        "doc": "serving engine seated a disaggregated-prefill package"},
+    # ---- serving plane: fleet ------------------------------------------
+    "engine_degraded": {
+        "required": ("plane", "engine", "reason"),
+        "optional": (),
+        "doc": "watchdog trip / retry exhaustion (flight-recorder "
+               "trigger)"},
+    "engine_drain": {
+        "required": ("plane", "engine", "queued", "active"),
+        "optional": (),
+        "doc": "engine entered drain mode (stop-admission)"},
+    "engine_added": {
+        "required": ("plane", "router", "engine", "pool_size"),
+        "optional": (),
+        "doc": "router grew the pool (autoscale / add_engine)"},
+    "engine_removed": {
+        "required": ("plane", "router", "engine", "state", "pool_size"),
+        "optional": (),
+        "doc": "router removed a drained/degraded engine"},
+    "router_failover": {
+        "required": ("plane", "router", "request", "source", "target"),
+        "optional": ("trace", "hop"),
+        "journey": True,
+        "doc": "request rerouted off a degraded engine (tokens "
+               "bit-identical by contract)"},
+    "router_rebalance": {
+        "required": ("plane", "router", "source", "target", "moved",
+                     "requests"),
+        "optional": (),
+        "doc": "queued requests moved between engines at step time"},
+    "router_handoff": {
+        "required": ("plane", "router", "request", "source", "target",
+                     "blocks"),
+        "optional": ("trace", "hop"),
+        "journey": True,
+        "doc": "router moved a prefilled package to a serving engine"},
+    "autoscale_decision": {
+        "required": ("plane", "router", "action"),
+        "optional": ("t", "p99_s", "engines", "target_p99_s",
+                     "backlog", "occupancy"),
+        "doc": "autoscaler acted on the SLO loop "
+               "(scale_up/scale_down/drain/shed_mode/restore_policy)"},
+    # ---- observability plane -------------------------------------------
+    "metrics_snapshot": {
+        "required": ("snapshot",),
+        "optional": ("plane", "note"),
+        "doc": "full registry snapshot embedded as an event "
+               "(obs.log_metrics_snapshot) — self-contained JSONL"},
+    "incident_dump": {
+        "required": ("incident", "bundle", "component", "trigger_kind",
+                     "events_in_tail"),
+        "optional": (),
+        "doc": "the flight recorder wrote a post-mortem bundle "
+               "(ISSUE 11; obs_report's incidents section)"},
+}
+
+
+def required_fields(kind: str) -> tuple:
+    """Fields every record of `kind` must carry (empty for unknown
+    kinds — the schema stays open at runtime)."""
+    return tuple(EVENT_KINDS.get(kind, {}).get("required", ()))
+
+
+def seat_kinds() -> tuple:
+    """Kinds that open a journey hop on an engine, in registry order
+    (obs/journey.py's SEAT_KINDS)."""
+    return tuple(k for k, v in EVENT_KINDS.items() if v.get("seat"))
+
+
+def validate_record(rec: dict) -> list:
+    """Problems with one parsed event record against EVENT_KINDS:
+    unknown kind, or a registered kind missing required fields. Empty
+    list = conformant. Pure host-side; obs_report uses it to flag
+    schema drift in a JSONL file."""
+    kind = rec.get("kind")
+    if kind not in EVENT_KINDS:
+        return [f"unknown kind {kind!r}"]
+    missing = [f for f in required_fields(kind) if f not in rec]
+    if missing:
+        return [f"kind {kind!r} missing required field(s): "
+                + ", ".join(missing)]
+    return []
 
 
 class EventLog:
@@ -192,7 +341,10 @@ def _jsonable(o):
 
 def read_jsonl(path: str) -> List[dict]:
     """Parse a JSONL event file; a torn final line (crash mid-write)
-    is dropped, not an error."""
+    is dropped, not an error. Record conformance is judged against
+    the EVENT_KINDS registry above — run each record through
+    `validate_record` (obs_report does) rather than keeping a local
+    kind list."""
     out = []
     with open(path) as f:
         for line in f:

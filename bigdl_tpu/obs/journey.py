@@ -39,14 +39,18 @@ from __future__ import annotations
 import json
 from typing import Dict, List, Optional
 
+from bigdl_tpu.obs.events import seat_kinds
+
 __all__ = ["SEAT_KINDS", "build_journeys", "summarize_journeys",
            "journeys_json", "to_perfetto"]
 
 # the event kinds that SEAT a request on an engine — each opens a hop
 # (request_submit covers initial dispatch, failover resubmission and
 # rebalance moves; handoff_import seats a disaggregated-prefill
-# package on its decode engine)
-SEAT_KINDS = ("request_submit", "handoff_import")
+# package on its decode engine). Derived from the machine-readable
+# EVENT_KINDS registry (obs/events.py, ISSUE 13) — the `seat` flag
+# there is the single source of truth, not a hand-maintained list.
+SEAT_KINDS = seat_kinds()
 
 def _new_hop(hop: int) -> dict:
     return {"hop": hop, "engine": None, "tp": None, "role": None,

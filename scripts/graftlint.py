@@ -2,14 +2,31 @@
 
     python scripts/graftlint.py                     # full tree, text
     python scripts/graftlint.py --format json       # machine-readable
+    python scripts/graftlint.py --format sarif      # editor/CI ingest
     python scripts/graftlint.py bigdl_tpu/ops       # subtree / files
     python scripts/graftlint.py --rules trace-env-read,telemetry-bypass
+    python scripts/graftlint.py --changed-only HEAD # pre-commit: lint
+                                                    # files changed
+                                                    # since a git ref
     python scripts/graftlint.py --no-baseline       # ignore allowlist
     python scripts/graftlint.py --write-baseline    # snapshot findings
 
 Exit codes: 0 clean (modulo baseline), 1 findings (or stale baseline
 entries — the baseline may only shrink, so an entry matching nothing
 is itself an error), 2 usage/parse trouble.
+
+Two-pass engine (ISSUE 13): per-file rules check each file alone;
+cross-module ProjectRules (event-kind-contract, metric-family-contract,
+donation-flow, lock-discipline) check a ProjectContext built once from
+the whole tree. `--changed-only` keeps ALL rules armed — per-file
+rules run on the changed files only, while the project pass covers the
+full tree (one cheap parse pass) and reports its findings WHEREVER
+they anchor: a changed file can break a contract whose finding lands
+in an unchanged file, and against a gate-clean HEAD any project
+finding is caused by the change. A bare path-subset run
+(`graftlint.py bigdl_tpu/ops`) skips project rules: a subset cannot
+answer cross-module questions. Full-tree mode remains the tier-1
+gate.
 
 Rules, suppression syntax and baseline policy: README "Static
 analysis". The tier-1 gate (tests/test_graftlint.py) runs the same
@@ -29,6 +46,76 @@ from bigdl_tpu.analysis import (BASELINE_PATH, RULES, apply_baseline,
                                 format_baseline, iter_python_files,
                                 load_baseline, run_lint)
 from bigdl_tpu.analysis.engine import BaselineEntry
+
+
+def _changed_files(root: str, ref: str):
+    """Repo-relative lintable .py files changed since `ref` (committed
+    or working-tree diffs, plus untracked) — the --changed-only set.
+    Raises ValueError on a bad ref so main exits 2."""
+    import subprocess
+
+    def git(*args):
+        proc = subprocess.run(["git", *args], cwd=root,
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise ValueError(
+                f"git {' '.join(args)} failed: "
+                f"{proc.stderr.strip() or proc.stdout.strip()}")
+        return [ln.strip() for ln in proc.stdout.splitlines()
+                if ln.strip()]
+
+    changed = set(git("diff", "--name-only", ref, "--"))
+    changed |= set(git("ls-files", "--others", "--exclude-standard"))
+    lintable = set(iter_python_files(root))
+    return sorted(changed & lintable)
+
+
+def _sarif(findings, stale, baseline_path: str) -> dict:
+    """Minimal SARIF 2.1.0 document — one run, one result per finding
+    (stale baseline entries ride along under a synthetic rule id)."""
+    from bigdl_tpu.analysis.engine import _ensure_rules_loaded
+    _ensure_rules_loaded()
+    rules = [{"id": name,
+              "shortDescription": {"text": RULES[name].description},
+              "defaultConfiguration": {
+                  "level": RULES[name].severity}}
+             for name in sorted(RULES)]
+    rules.append({"id": "stale-baseline",
+                  "shortDescription": {
+                      "text": "baseline entry matching no finding — "
+                              "the baseline only shrinks"},
+                  "defaultConfiguration": {"level": "error"}})
+    results = [{
+        "ruleId": f.rule,
+        "level": f.severity,
+        "message": {"text": f.message},
+        "locations": [{"physicalLocation": {
+            "artifactLocation": {"uri": f.path},
+            "region": {"startLine": f.line,
+                       "startColumn": f.col}}}],
+    } for f in findings]
+    for e in stale:
+        results.append({
+            "ruleId": "stale-baseline",
+            "level": "error",
+            "message": {"text": f"stale baseline entry ({e.rule} @ "
+                                f"{e.path} x{e.count}) — the finding "
+                                f"is fixed; DELETE the entry"},
+            "locations": [{"physicalLocation": {
+                "artifactLocation": {"uri": baseline_path}}}],
+        })
+    return {
+        "version": "2.1.0",
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "runs": [{
+            # informationUri omitted: SARIF requires an absolute URI
+            # and this repo has no canonical public URL — README
+            # "Static analysis" is the reference
+            "tool": {"driver": {"name": "graftlint",
+                                "rules": rules}},
+            "results": results,
+        }],
+    }
 
 
 def _resolve_paths(root: str, args_paths):
@@ -59,10 +146,14 @@ def main(argv=None) -> int:
     ap.add_argument("--root", default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), ".."),
         help="repo root (default: this script's parent)")
-    ap.add_argument("--format", choices=("text", "json"),
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
                     default="text")
     ap.add_argument("--rules", default=None,
                     help="comma-separated subset of rules to run")
+    ap.add_argument("--changed-only", metavar="GIT_REF", default=None,
+                    help="lint only files changed since GIT_REF (fast "
+                         "pre-commit mode; cross-module rules still "
+                         "see the full tree)")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline file (default: {BASELINE_PATH})")
@@ -85,15 +176,33 @@ def main(argv=None) -> int:
     rule_names = [r.strip() for r in args.rules.split(",")] \
         if args.rules else None
     try:
-        paths = _resolve_paths(root, args.paths)
-        findings = run_lint(root, paths=paths, rule_names=rule_names)
+        if args.changed_only:
+            if args.paths:
+                raise ValueError(
+                    "--changed-only and explicit paths are mutually "
+                    "exclusive")
+            paths = _changed_files(root, args.changed_only)
+            if not paths:
+                print("graftlint: no lintable files changed since "
+                      f"{args.changed_only}")
+                return 0
+            # per-file rules on the changed set; the project pass
+            # covers the full tree and reports wherever its findings
+            # anchor — all 12 rules stay armed in pre-commit mode
+            findings = run_lint(root, paths=paths,
+                                rule_names=rule_names,
+                                project_scope="full")
+        else:
+            paths = _resolve_paths(root, args.paths)
+            findings = run_lint(root, paths=paths,
+                                rule_names=rule_names)
     except ValueError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
 
     baseline_path = args.baseline or os.path.join(root, BASELINE_PATH)
     if args.write_baseline:
-        if args.paths or args.rules:
+        if args.paths or args.rules or args.changed_only:
             # a subset run sees a subset of findings — writing it out
             # would silently drop every grandfathered entry outside
             # the subset
@@ -115,13 +224,16 @@ def main(argv=None) -> int:
     if not args.no_baseline:
         baseline = load_baseline(baseline_path)
         findings, stale = apply_baseline(findings, baseline)
-        if args.paths or args.rules:
-            # a partial run (path/rule subset) cannot see every
+        if args.paths or args.rules or args.changed_only:
+            # a partial run (path/rule/changed subset) cannot see every
             # finding, so absent ones are not evidence an entry is
             # stale — only the full default run enforces shrink-only
             stale = []
 
-    if args.format == "json":
+    if args.format == "sarif":
+        print(json.dumps(_sarif(findings, stale, baseline_path),
+                         indent=2))
+    elif args.format == "json":
         print(json.dumps({
             "findings": [f.as_dict() for f in findings],
             "stale_baseline": [vars(e) for e in stale],

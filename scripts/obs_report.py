@@ -52,6 +52,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 # engine.health()'s or bench-row provenance
 from bigdl_tpu.obs.registry import (quantile_from_buckets,  # noqa: E402
                                     series_key)
+# the machine-readable kind registry (ISSUE 13): the report flags any
+# kind outside it instead of keeping its own hand-maintained list
+from bigdl_tpu.obs.events import (EVENT_KINDS,  # noqa: E402
+                                  validate_record)
 
 
 def summarize(events: List[dict]) -> Dict[str, object]:
@@ -62,6 +66,15 @@ def summarize(events: List[dict]) -> Dict[str, object]:
     for e in events:
         by_kind[e.get("kind", "?")] = by_kind.get(e.get("kind", "?"), 0) + 1
     out["by_kind"] = dict(sorted(by_kind.items()))
+    unknown = sorted(k for k in by_kind if k not in EVENT_KINDS)
+    if unknown:
+        # schema drift: a producer emitted kinds the EVENT_KINDS
+        # registry does not know (graftlint pins committed code, but a
+        # JSONL file may come from anywhere)
+        out["unknown_kinds"] = unknown
+    nonconformant = sum(1 for e in events if validate_record(e))
+    if nonconformant:
+        out["nonconformant_records"] = nonconformant
 
     steps = [e for e in events if e.get("kind") == "train_step"]
     if steps:
@@ -370,7 +383,9 @@ def render(events: List[dict], tail: int = 15) -> str:
     s = summarize(events)
     lines = [f"telemetry report — {s['total_events']} events"]
     lines.append("\nevents by kind:")
-    lines.append(_fmt_table(sorted(s["by_kind"].items())))
+    lines.append(_fmt_table(
+        [(k + ("" if k in EVENT_KINDS else " [unregistered]"), n)
+         for k, n in sorted(s["by_kind"].items())]))
     if "training" in s:
         t = s["training"]
         lines.append("\ntraining:")

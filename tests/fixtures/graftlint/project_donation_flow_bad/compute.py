@@ -1,0 +1,25 @@
+# graftlint project fixture: donation-flow — the donating side. A
+# factory returning a jit with donate_argnums (the make_*_step
+# pattern) and a decorated donating callable.
+import functools
+
+import jax
+
+
+def make_step():
+    def step(params, batch):
+        return params
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def apply_grads(grads, opt_state):
+    return opt_state
+
+
+def make_named_step():
+    def named_step(params, batch):
+        return params
+
+    return jax.jit(named_step, donate_argnames=("params",))

@@ -1,0 +1,47 @@
+# graftlint project fixture: donation-flow TRUE POSITIVES — buffers
+# donated to a jitted call (via the cross-file factory, and via the
+# decorated callable) read again in the caller's scope.
+import jax
+
+from .compute import apply_grads, make_named_step, make_step
+
+
+def run(params, batches):
+    step = make_step()
+    out = None
+    for b in batches:
+        new_params = step(params, b)
+        out = params["w"]  # BAD
+        params = new_params
+    return out
+
+
+def update(grads, opt_state):
+    new_state = apply_grads(grads, opt_state)
+    stale = opt_state  # BAD
+    return new_state, stale
+
+
+def inline(params, batch):
+    step = jax.jit(lambda p, b: p, donate_argnums=(0,))
+    fresh = step(params, batch)
+    return fresh, params  # BAD
+
+
+def run_named(params, batch):
+    step = make_named_step()
+    new_params = step(params, batch)
+    stale = params  # BAD (donate_argnames resolves to position 0)
+    return new_params, stale
+
+
+class Trainer:
+    # the setup-in-__init__, call-elsewhere shape: the binding is a
+    # CLASS attribute, resolved across methods
+    def __init__(self):
+        self._step = make_step()
+
+    def advance(self, params, batch):
+        new_params = self._step(params, batch)
+        stale = params  # BAD
+        return new_params, stale

@@ -1,0 +1,33 @@
+# graftlint project fixture: donation-flow clean side — same donating
+# factory/callable shapes as the bad variant.
+import functools
+
+import jax
+
+
+def make_step():
+    def step(params, batch):
+        return params
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def apply_grads(grads, opt_state):
+    return opt_state
+
+
+def make_named_step():
+    def named_step(params, batch):
+        return params
+
+    return jax.jit(named_step, donate_argnames=("params",))
+
+
+def wrap_model(model):
+    """NOT a donating factory: only the inner helper returns a jit —
+    nested defs are pruned, so callers of wrap_model stay unchecked."""
+    def _unused_jit_builder():
+        return jax.jit(lambda p, b: p, donate_argnums=(0,))
+
+    return model

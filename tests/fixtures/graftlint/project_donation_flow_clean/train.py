@@ -1,0 +1,52 @@
+# graftlint project fixture: donation-flow FALSE-POSITIVE guard — the
+# sanctioned patterns: rebinding the donated name from the call's own
+# result (`state = step(state, b)`), copying BEFORE dispatch (the
+# donation-aware retry), and reads that happen before the call.
+import jax
+import jax.numpy as jnp
+
+from .compute import apply_grads, make_named_step, make_step, \
+    wrap_model
+
+
+def run(params, batches):
+    step = make_step()
+    for b in batches:
+        params = step(params, b)
+    return params
+
+
+def update_with_retry(grads, opt_state):
+    saved = jax.tree_util.tree_map(jnp.copy, opt_state)
+    new_state = apply_grads(grads, opt_state)
+    return new_state, saved
+
+
+def read_before_call(params, batch):
+    step = make_step()
+    norm = params["w"]
+    new_params = step(params, batch)
+    return new_params, norm
+
+
+def run_named(params, batch):
+    step = make_named_step()
+    params = step(params, batch)
+    return params
+
+
+class Trainer:
+    def __init__(self):
+        self._step = make_step()
+
+    def advance(self, params, batch):
+        params = self._step(params, batch)
+        return params
+
+
+def use_wrapped(params, batch):
+    # wrap_model's INNER helper returns a donating jit, but the outer
+    # function donates nothing — callers must stay clean
+    fn = wrap_model(lambda p, b: p)
+    out = fn(params, batch)
+    return out, params

@@ -1,0 +1,19 @@
+# graftlint project fixture: event-kind-contract TRUE POSITIVES,
+# consumer side — kind literals no producer can ever emit.
+
+
+def drill_asserts(log):
+    finished = log.events("job_finished")  # BAD
+    retried = log.events("job_retry")
+    return finished, retried
+
+
+def fold(events):
+    out = []
+    for e in events:
+        kind = e.get("kind")
+        if kind == "job_axed":  # BAD
+            continue
+        if e["kind"] in ("job_done", "job_killed"):  # BAD
+            out.append(e)
+    return out

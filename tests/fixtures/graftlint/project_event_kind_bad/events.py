@@ -1,0 +1,7 @@
+# graftlint project fixture: the mini-package's EVENT_KINDS registry
+# (the single source of truth the rule pins producers/consumers to).
+EVENT_KINDS = {
+    "job_done": {"required": ("job", "status"),
+                 "optional": ("duration_s",)},
+    "job_retry": {"required": ("job",), "optional": ()},
+}

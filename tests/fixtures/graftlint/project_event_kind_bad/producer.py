@@ -1,0 +1,11 @@
+# graftlint project fixture: event-kind-contract TRUE POSITIVES,
+# producer side (cross-file: the registry lives in events.py).
+from bigdl_tpu import obs
+
+
+def finish(job):
+    obs.emit_event("job_started", job=job)  # BAD
+    obs.emit_event("job_done", job=job)  # BAD
+    obs.emit_event("job_done", job=job, status="ok", color="red")  # BAD
+    obs.emit_event("job_done", job=job, status="ok", duration_s=1.0)
+    obs.emit_event("job_retry", **job.fields())

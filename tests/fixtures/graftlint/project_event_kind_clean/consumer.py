@@ -1,0 +1,30 @@
+# graftlint project fixture: event-kind-contract FALSE-POSITIVE guard,
+# consumer side — registered kinds only, plus the shapes the rule must
+# NOT confuse with event kinds: a metric-family snapshot's "kind" key
+# and a module-local `kind` variable that never aliases an event.
+
+
+def drill_asserts(log):
+    return log.events("job_done"), log.events(kind="job_retry")
+
+
+def fold(events):
+    out = []
+    for e in events:
+        kind = e.get("kind")
+        # graftlint: disable=event-kind-contract (suppression-with-why demo)
+        if kind == "job_axed":
+            pass
+        if e["kind"] in ("job_done", "job_retry"):
+            out.append(e)
+    return out
+
+
+def histogram_families(snapshot):
+    return [name for name, fam in snapshot["metrics"].items()
+            if fam["kind"] == "histogram"]
+
+
+def spec_kind(spec):
+    kind = spec["__kind__"]
+    return kind == "leaf"

@@ -1,0 +1,6 @@
+# graftlint project fixture: clean variant registry.
+EVENT_KINDS = {
+    "job_done": {"required": ("job", "status"),
+                 "optional": ("duration_s",)},
+    "job_retry": {"required": ("job",), "optional": ()},
+}

@@ -1,0 +1,10 @@
+# graftlint project fixture: event-kind-contract FALSE-POSITIVE guard,
+# producer side — registered kinds, declared fields, required fields
+# present (or hidden behind a **splat, which waives the static check).
+from bigdl_tpu import obs
+
+
+def finish(job):
+    obs.emit_event("job_done", job=job, status="ok")
+    obs.emit_event("job_done", job=job, status="ok", duration_s=1.0)
+    obs.emit_event("job_retry", **job.fields())

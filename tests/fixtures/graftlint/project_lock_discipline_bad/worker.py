@@ -1,0 +1,56 @@
+# graftlint project fixture: lock-discipline TRUE POSITIVES — a
+# Thread-entrypoint method writing shared attributes outside the lock,
+# and main-path methods touching them bare.
+import threading
+
+
+class Collector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self.dropped = 0
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        while True:
+            self._items.append(1)  # BAD
+            with self._lock:
+                self.dropped += 1
+
+    def drain(self):
+        out = list(self._items)  # BAD
+        self._items.clear()  # BAD
+        with self._lock:
+            n = self.dropped
+        return out, n
+
+
+class StepRunner:
+    # closure-entry shape (the watchdog pattern): only the closure
+    # runs on the thread — the HOST method is main-path and its bare
+    # read races the closure's write
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._results = []
+
+    def step(self, x):
+        def work():
+            self._results.append(x)  # BAD
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        return list(self._results)  # BAD
+
+
+class Listener:
+    def __init__(self, log):
+        self._lock = threading.Lock()
+        self._tail = []
+        log.add_listener(self._on_event)
+
+    def _on_event(self, rec):
+        self._tail.append(rec)  # BAD
+
+    def snapshot(self):
+        return list(self._tail)  # BAD

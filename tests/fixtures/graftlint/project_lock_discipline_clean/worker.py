@@ -1,0 +1,55 @@
+# graftlint project fixture: lock-discipline FALSE-POSITIVE guard —
+# every shared write/read under the lock (directly, or in a helper
+# whose only call sites hold it), synchronized containers exempt,
+# __init__ writes exempt (they precede the thread), and a justified
+# bare read carrying a suppression with its why.
+import queue
+import threading
+
+
+class Collector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._q = queue.Queue()
+        self.dropped = 0
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self._items.append(1)
+                self._flush()
+            self._q.put(1)
+
+    def _flush(self):
+        # only ever called with the lock held — effectively locked
+        self.dropped += 1
+
+    def drain(self):
+        with self._lock:
+            out = list(self._items)
+            self._items.clear()
+            n = self.dropped
+        # GIL-atomic len() of a list, advisory only — safe bare
+        depth = len(self._items)  # graftlint: disable=lock-discipline
+        return out, n, depth
+
+
+class StepRunner:
+    # closure-entry shape, done right: write AND host-side read both
+    # under the lock
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._results = []
+
+    def step(self, x):
+        def work():
+            with self._lock:
+                self._results.append(x)
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        with self._lock:
+            return list(self._results)

@@ -1,0 +1,11 @@
+# graftlint project fixture: metric-family-contract TRUE POSITIVES,
+# cross-file — a second registration of a family worker_metrics.py
+# already owns, and a by-name fetch of a family nobody registers.
+from bigdl_tpu import obs
+
+
+def report():
+    reg = obs.get_registry()
+    dup = reg.counter("worker_jobs_total", "duplicate owner")  # BAD
+    ghost = reg.get("worker_never_registered_total")  # BAD
+    return dup, ghost

@@ -1,0 +1,18 @@
+# graftlint project fixture: metric-family-contract TRUE POSITIVES —
+# label drift, an orphan family, and a bump through a metric binding
+# (`_m_*` convention) nobody ever registered.
+from bigdl_tpu import obs
+
+
+class Worker:
+    def __init__(self):
+        reg = obs.get_registry()
+        self._m_jobs = reg.counter(
+            "worker_jobs_total", "jobs finished",
+            labelnames=("queue",))
+        self._m_orphan = reg.gauge(  # BAD
+            "worker_orphan_depth", "registered but never bumped")
+
+    def bump(self, queue):
+        self._m_jobs.labels(queue=queue, shard="0").inc()  # BAD
+        self._m_ghost.inc()  # BAD

@@ -1,0 +1,12 @@
+# graftlint project fixture: metric-family-contract FALSE-POSITIVE
+# guard, cross-file — a by-name fetch of a family worker_metrics.py
+# registers (this is the sanctioned way to read a family another
+# module owns; re-registering it would be the violation).
+from bigdl_tpu import obs
+
+
+def report():
+    reg = obs.get_registry()
+    fam = reg.get("worker_jobs_total")
+    retries = reg.get("worker_retries_total")  # matches the keyed map
+    return fam, retries

@@ -1,0 +1,32 @@
+# graftlint project fixture: metric-family-contract FALSE-POSITIVE
+# guard — one registration per family, matching label sets, keyed
+# family maps, a chained-child binding, and an inline
+# register-and-observe chain (the checkpoint pattern).
+from bigdl_tpu import obs
+
+
+class Worker:
+    def __init__(self):
+        reg = obs.get_registry()
+        self._m_jobs = reg.counter(
+            "worker_jobs_total", "jobs finished",
+            labelnames=("queue",))
+        self._m_ops = {
+            key: reg.counter(f"worker_{key}_total", help_,
+                             labelnames=("queue",)
+                             ).labels(queue="default")
+            for key, help_ in {"retries": "job retries"}.items()}
+        self._m_depth = reg.gauge(
+            "worker_queue_depth", "queued jobs",
+            labelnames=("queue",)).labels(queue="default")
+
+    def bump(self, queue, n):
+        self._m_jobs.labels(queue=queue).inc()
+        self._m_ops["retries"].inc(n)
+        self._m_depth.set(n)
+
+
+def observe_once(reg, seconds):
+    reg.histogram("worker_save_seconds", "save wall seconds",
+                  labelnames=("mode",)).labels(mode="sync") \
+        .observe(seconds)

@@ -1,15 +1,22 @@
-"""graftlint tier-1 gate + rule/engine mechanics (ISSUE 6).
+"""graftlint tier-1 gate + rule/engine mechanics (ISSUE 6 + 13).
 
-Three layers:
+Four layers:
 
-* fixtures — every rule has a known-bad snippet (must fire, on exactly
-  the `# BAD`-marked lines) and a known-clean snippet (false-positive
-  guard), judged under a fake path inside the rule's scope;
+* fixtures — every per-file rule has a known-bad snippet (must fire,
+  on exactly the `# BAD`-marked lines) and a known-clean snippet
+  (false-positive guard), judged under a fake path inside the rule's
+  scope;
+* project fixtures (ISSUE 13) — every cross-module ProjectRule has a
+  `project_*_bad` / `project_*_clean` mini-package tree (producer /
+  consumer / registration split across files) checked the same way;
+  the coverage pin makes a 13th rule without fixtures fail;
 * mechanics — inline suppressions, baseline parse/format/apply,
-  shrink-only staleness;
+  shrink-only staleness, the single-parse/single-build contract of the
+  two-pass engine;
 * the GATE — the full tree must lint clean modulo the committed
-  baseline, the baseline may only shrink (stale entries fail), and the
-  full-tree pass must stay under the ~10 s budget on the 1-core host.
+  baseline with ALL rules armed, the baseline may only shrink (stale
+  entries fail), and the full-tree two-pass run must stay under the
+  ~10 s budget on the 1-core host.
 """
 
 from __future__ import annotations
@@ -51,6 +58,15 @@ RULE_FIXTURES = {
                                "bigdl_tpu/serving/fixture.py"),
 }
 
+# ProjectRule -> fixture mini-package stem: tests/fixtures/graftlint/
+# <stem>_bad/ and <stem>_clean/ hold a multi-file project tree each
+PROJECT_RULE_FIXTURES = {
+    "event-kind-contract": "project_event_kind",
+    "metric-family-contract": "project_metric_family",
+    "donation-flow": "project_donation_flow",
+    "lock-discipline": "project_lock_discipline",
+}
+
 
 def _fixture(stem: str, kind: str) -> str:
     with open(os.path.join(FIXTURES, f"{stem}_{kind}.py")) as f:
@@ -68,8 +84,15 @@ def _expected_lines(source: str):
 
 class TestRuleFixtures:
     def test_every_rule_has_a_fixture(self):
-        # adding a rule without fixture coverage fails here
-        assert set(RULE_FIXTURES) == set(RULES)
+        # adding a rule without fixture coverage fails here: per-file
+        # rules need a bad/clean snippet pair, ProjectRules a
+        # project_* bad/clean mini-package pair — a 13th rule with
+        # neither fails this pin
+        from bigdl_tpu.analysis import ProjectRule
+        project = {n for n, r in RULES.items()
+                   if isinstance(r, ProjectRule)}
+        assert set(PROJECT_RULE_FIXTURES) == project
+        assert set(RULE_FIXTURES) == set(RULES) - project
 
     @pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
     def test_true_positives_fire_at_marked_lines(self, rule):
@@ -94,6 +117,72 @@ class TestRuleFixtures:
         src = _fixture("missing_reference_docstring", "bad")
         assert _lint_with("missing-reference-docstring",
                           "bigdl_tpu/serving/fixture.py", src) == []
+
+
+def _project_fixture_paths(stem: str, kind: str):
+    d = os.path.join(FIXTURES, f"{stem}_{kind}")
+    return sorted(
+        os.path.relpath(os.path.join(d, f), ROOT).replace(os.sep, "/")
+        for f in os.listdir(d) if f.endswith(".py"))
+
+
+def _project_expected(paths):
+    out = set()
+    for rel in paths:
+        with open(os.path.join(ROOT, rel)) as f:
+            for i, line in enumerate(f, start=1):
+                if "# BAD" in line:
+                    out.add((rel, i))
+    return out
+
+
+class TestProjectRuleFixtures:
+    """ISSUE 13: each cross-module rule fires on its bad mini-package
+    at exactly the `# BAD` lines (across files) and stays silent on
+    the clean variant."""
+
+    @pytest.mark.parametrize("rule", sorted(PROJECT_RULE_FIXTURES))
+    def test_true_positives_fire_at_marked_lines(self, rule):
+        stem = PROJECT_RULE_FIXTURES[rule]
+        paths = _project_fixture_paths(stem, "bad")
+        expected = _project_expected(paths)
+        assert expected, f"{stem}_bad has no # BAD markers"
+        findings = run_lint(ROOT, paths=paths, rule_names=[rule],
+                            project_scope=paths)
+        assert {(f.path, f.line) for f in findings} == expected, \
+            "\n".join(f.text() for f in findings)
+        assert all(f.rule == rule and f.severity == "error"
+                   for f in findings)
+
+    @pytest.mark.parametrize("rule", sorted(PROJECT_RULE_FIXTURES))
+    def test_clean_fixture_is_clean(self, rule):
+        stem = PROJECT_RULE_FIXTURES[rule]
+        paths = _project_fixture_paths(stem, "clean")
+        findings = run_lint(ROOT, paths=paths, rule_names=[rule],
+                            project_scope=paths)
+        assert findings == [], "\n".join(f.text() for f in findings)
+
+    def test_bare_subset_run_skips_project_rules(self):
+        # without an explicit project_scope, a path-subset run must
+        # not judge cross-module questions it cannot answer
+        paths = _project_fixture_paths("project_event_kind", "bad")
+        findings = run_lint(ROOT, paths=paths,
+                            rule_names=["event-kind-contract"])
+        assert findings == []
+
+    def test_project_findings_not_filtered_to_path_subset(self):
+        # the --changed-only contract: a changed file can break a
+        # cross-module contract whose finding anchors in an UNCHANGED
+        # file (edit only the registry → orphaned emit sites
+        # elsewhere fire) — project findings are reported wherever
+        # they land, never filtered to the `paths` subset
+        all_paths = _project_fixture_paths("project_event_kind", "bad")
+        registry_only = [p for p in all_paths if p.endswith("events.py")]
+        findings = run_lint(ROOT, paths=registry_only,
+                            rule_names=["event-kind-contract"],
+                            project_scope=all_paths)
+        assert {(f.path, f.line) for f in findings} \
+            == _project_expected(all_paths)
 
 
 class TestSuppressions:
@@ -214,13 +303,27 @@ class TestBaseline:
 
 
 class TestFullTreeGate:
-    """THE tier-1 contract: tree clean modulo baseline, baseline only
-    shrinks, pass stays inside the runtime budget."""
+    """THE tier-1 contract: tree clean modulo baseline with all 12
+    rules armed, baseline only shrinks, the two-pass run parses every
+    file exactly once and builds ONE ProjectContext, and the pass
+    stays inside the runtime budget."""
 
     def test_full_tree_clean_and_budget(self):
-        t0 = time.perf_counter()
-        findings = run_lint(ROOT)
-        elapsed = time.perf_counter() - t0
+        from bigdl_tpu.analysis import engine as eng
+        from bigdl_tpu.analysis import project as prj
+        parse_counts: dict = {}
+        builds = []
+        eng.PARSE_OBSERVERS.append(
+            lambda p: parse_counts.__setitem__(
+                p, parse_counts.get(p, 0) + 1))
+        prj.BUILD_OBSERVERS.append(builds.append)
+        try:
+            t0 = time.perf_counter()
+            findings = run_lint(ROOT)
+            elapsed = time.perf_counter() - t0
+        finally:
+            eng.PARSE_OBSERVERS.pop()
+            prj.BUILD_OBSERVERS.pop()
         baseline = load_baseline(os.path.join(ROOT, BASELINE_PATH))
         left, stale = apply_baseline(findings, baseline)
         assert left == [], "unbaselined graftlint findings:\n" + \
@@ -229,9 +332,29 @@ class TestFullTreeGate:
             "stale baseline entries (finding fixed -> DELETE the "
             "entry; the baseline only shrinks): " +
             ", ".join(f"{e.rule}@{e.path}" for e in stale))
-        # ~10 s contract for the full-tree pass on the 1-core host
-        # (pure ast walk; measured ~1.5 s — 10 s leaves load headroom)
+        # the shared-single-parse contract (ISSUE 13): pass 2 reuses
+        # pass 1's FileContexts — no file is ever parsed twice, and
+        # exactly one ProjectContext is built per run
+        multi = {p: n for p, n in parse_counts.items() if n != 1}
+        assert not multi, f"files parsed more than once: {multi}"
+        assert parse_counts, "parse observer saw no files"
+        assert len(builds) == 1, \
+            f"ProjectContext built {len(builds)}x (expected once)"
+        assert len(builds[0].files) == len(parse_counts)
+        # ~10 s contract for the full-tree two-pass run on the 1-core
+        # host with all 12 rules armed (pure ast walk; measured ~4 s —
+        # 10 s leaves load headroom)
         assert elapsed < 10.0, f"graftlint full tree took {elapsed:.1f}s"
+
+    def test_all_twelve_rules_armed(self):
+        # the gate means nothing if a rule silently fell out of the
+        # registry: 8 per-file rules (ISSUE 6) + 4 ProjectRules
+        # (ISSUE 13)
+        from bigdl_tpu.analysis import ProjectRule
+        project = {n for n, r in RULES.items()
+                   if isinstance(r, ProjectRule)}
+        assert len(RULES) == 12
+        assert project == set(PROJECT_RULE_FIXTURES)
 
     def test_baseline_entries_reference_real_rules(self):
         baseline = load_baseline(os.path.join(ROOT, BASELINE_PATH))
@@ -265,6 +388,44 @@ class TestCli:
         assert mod.main(["--write-baseline", "bigdl_tpu/ops"]) == 2
         assert mod.main(["--write-baseline",
                          "--rules", "telemetry-bypass"]) == 2
+
+    def test_cli_sarif_format(self):
+        # SARIF over a subtree (fast): valid 2.1.0 skeleton, every
+        # registered rule advertised, zero results on clean code
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "scripts",
+                                          "graftlint.py"),
+             "bigdl_tpu/obs", "--format", "sarif"],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert set(RULES) <= rule_ids
+        assert run["results"] == []
+
+    def test_cli_changed_only(self):
+        # against HEAD the changed set is whatever the working tree
+        # carries — a clean tree must stay clean (and an empty set
+        # short-circuits); a bad ref is usage trouble (exit 2)
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "scripts",
+                                          "graftlint.py"),
+             "--changed-only", "HEAD"],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=ROOT)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "scripts",
+                                          "graftlint.py"),
+             "--changed-only", "no-such-ref-xyz"],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=ROOT)
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+        assert "Traceback" not in proc.stderr
 
     def test_cli_missing_path_exits_two(self):
         # usage trouble is the documented exit code 2, not a traceback
