@@ -920,10 +920,15 @@ def bench_lm_decode_batched(on_tpu, context=512, new_tokens=None,
 
     res = eng.run(wave(0))                      # warmup: all compiles
 
-    def steady(seed):
+    def steady(seed, extra=None):
+        # `extra` runs inside the timed window AFTER the wave's final
+        # token fetch (eng.run fences internally) — the ISSUE 14
+        # sampler/alert work is charged to the wave that arms it
         steps0 = eng.stats["decode_steps"]
         t0 = time.perf_counter()
         r = eng.run(wave(seed))
+        if extra is not None:
+            extra()
         dt = time.perf_counter() - t0
         return r, dt, eng.stats["decode_steps"] - steps0
 
@@ -934,7 +939,12 @@ def bench_lm_decode_batched(on_tpu, context=512, new_tokens=None,
     # ISSUE 11 re-measures with the NEW layers armed too: journey
     # tracing is always-on event fields, and the telemetry-on wave
     # additionally runs under an installed FlightRecorder — the <1%
-    # bar now covers the whole observability plane
+    # bar now covers the whole observability plane.
+    # ISSUE 14 arms the live SLO plane on top: a MetricsSampler and an
+    # AlertEngine with a (never-firing) p99 objective run inside the
+    # telemetry-on timed window — sample + evaluate are charged to the
+    # on-wave, so telemetry_overhead_frac now prices the whole ops
+    # loop (events + recorder + sampler + alerting)
     prev = obs.set_enabled(False)
     try:
         res_off, dt_off, steps_off = steady(100)
@@ -943,11 +953,22 @@ def bench_lm_decode_batched(on_tpu, context=512, new_tokens=None,
     import tempfile
 
     from bigdl_tpu.obs.flightrecorder import FlightRecorder
+    from bigdl_tpu.obs.slo import AlertEngine, AlertRule, SLOObjective
+    from bigdl_tpu.obs.timeseries import MetricsSampler
 
     recorder = FlightRecorder(
         tempfile.mkdtemp(prefix="bench_flightrec_")).install()
+    sampler = MetricsSampler(interval_s=0.0)    # sample on every tick
+    aeng = AlertEngine(sampler, [AlertRule(
+        name="decode_p99", kind="threshold",
+        objective=SLOObjective(
+            name="decode_p99", kind="latency_quantile",
+            metric="serving_decode_step_seconds", target=60.0,
+            labels={"engine": eng.obs_name, "tp": str(eng.tp)}))])
+    sampler.sample()                            # open the window
     try:
-        res, dt, steps = steady(200)            # telemetry + recorder on
+        res, dt, steps = steady(                # telemetry + SLO on
+            200, extra=lambda: (sampler.tick(), aeng.evaluate()))
     finally:
         recorder.close()
     total = sum(len(r.tokens) for r in res)
@@ -972,6 +993,9 @@ def bench_lm_decode_batched(on_tpu, context=512, new_tokens=None,
         "journey_tracing": "on",
         "flight_recorder": "armed",
         "flight_recorder_bundles": len(recorder.bundles),
+        "slo_plane": "armed",
+        "slo_samples": len(sampler),
+        "slo_alerts_firing": len(aeng.firing()),
         "telemetry": _obs_provenance("serving_"),
     }), flush=True)
 

@@ -12,7 +12,12 @@ distinguish from a real regression.
 The scope covers the whole fleet plane (ISSUE 7): serving/router.py
 and serving/autoscaler.py via the serving/ prefix, plus the loadgen
 traffic harness — its two-runs-identical-JSON acceptance dies the
-moment a wall-clock read or global RNG draw sneaks in. The ISSUE-9
+moment a wall-clock read or global RNG draw sneaks in. ISSUE 14 adds
+`bigdl_tpu/obs/slo.py`: alert evaluation is a pure function of (the
+sampler's window, the injected clock) by contract — the slo_alert
+drill pins firing AND resolution byte-for-byte, bundle bytes
+included, which a `time.time()` in a state transition would break the
+same way it breaks the loadgen report. The ISSUE-9
 elastic-training legs (preempt_resume / ckpt_async_torn / torn_shard
 / worldsize_resume) are covered by the scripts/fault_drill.py entry:
 their kill/torn-save steps must come from a FaultPlan schedule
@@ -52,6 +57,7 @@ class NondeterministicDrill(Rule):
     description = ("wall clock / unseeded RNG in drill or serving "
                    "code — use the injectable clock / seeded streams")
     scope = ("bigdl_tpu/serving/", "bigdl_tpu/utils/faults.py",
+             "bigdl_tpu/obs/slo.py",
              "scripts/fault_drill.py", "scripts/loadgen.py")
 
     def check(self, ctx):

@@ -10,6 +10,13 @@ training loop and the serving engine report into:
 * `spans`    — host-side span tracer emitting Chrome-trace/Perfetto
   JSON, aligned with `utils/profiler` device traces
 
+ISSUE 14 adds the LIVE layer on top: `timeseries` (bounded ring of
+registry samples, windowed rate/delta/quantile queries — the
+autoscaler's windowing now lives here), `slo` (declarative
+SLOObjective + deterministic AlertRule/AlertEngine; alert_firing is a
+flight-recorder trigger), and `exposition` (stdlib-HTTP scrape
+endpoint: /metrics Prometheus text, /health + /alerts JSON).
+
 Hard contracts (tests/test_obs.py):
 * telemetry NEVER touches jitted code: zero new compiles with it on
   (the serving #buckets+1 guard passes with telemetry enabled);
@@ -33,6 +40,7 @@ from typing import Optional
 
 from bigdl_tpu.obs.events import (EventLog, get_event_log, read_jsonl,
                                   set_event_log)
+from bigdl_tpu.obs.exposition import ScrapeServer
 from bigdl_tpu.obs.flightrecorder import FlightRecorder, default_trigger
 from bigdl_tpu.obs.journey import (build_journeys, journeys_json,
                                    summarize_journeys, to_perfetto)
@@ -40,7 +48,9 @@ from bigdl_tpu.obs.registry import (DEFAULT_LATENCY_BUCKETS, Counter,
                                     Gauge, Histogram, MetricsRegistry,
                                     get_registry, series_key,
                                     set_registry)
+from bigdl_tpu.obs.slo import AlertEngine, AlertRule, SLOObjective
 from bigdl_tpu.obs.spans import SpanTracer, get_tracer, set_tracer
+from bigdl_tpu.obs.timeseries import HistogramWindow, MetricsSampler
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -50,6 +60,8 @@ __all__ = [
     "FlightRecorder", "default_trigger",
     "build_journeys", "journeys_json", "summarize_journeys",
     "to_perfetto",
+    "MetricsSampler", "HistogramWindow",
+    "SLOObjective", "AlertRule", "AlertEngine", "ScrapeServer",
     "enabled", "set_enabled", "emit_event", "log_metrics_snapshot",
     "provenance", "reset_all",
 ]

@@ -187,7 +187,7 @@ EVENT_KINDS: Dict[str, dict] = {
     "autoscale_decision": {
         "required": ("plane", "router", "action"),
         "optional": ("t", "p99_s", "engines", "target_p99_s",
-                     "backlog", "occupancy"),
+                     "backlog", "occupancy", "objective", "q"),
         "doc": "autoscaler acted on the SLO loop "
                "(scale_up/scale_down/drain/shed_mode/restore_policy)"},
     # ---- observability plane -------------------------------------------
@@ -202,6 +202,25 @@ EVENT_KINDS: Dict[str, dict] = {
         "optional": (),
         "doc": "the flight recorder wrote a post-mortem bundle "
                "(ISSUE 11; obs_report's incidents section)"},
+    "alert_firing": {
+        "required": ("plane", "alert", "objective", "value", "target",
+                     "window_s"),
+        "optional": ("rule_kind", "burn", "long_value", "short_value",
+                     "pending_s"),
+        "doc": "an AlertRule crossed into firing (ISSUE 14, "
+               "obs/slo.py): value vs target over the window_s that "
+               "breached (burn-rate rules name the long window and "
+               "carry long/short values + the burn multiple); a "
+               "flight-recorder trigger — an SLO burn dumps a "
+               "slo_burn post-mortem bundle"},
+    "alert_resolved": {
+        "required": ("plane", "alert", "objective", "value", "target",
+                     "firing_s"),
+        "optional": ("rule_kind", "window_s"),
+        "doc": "a firing alert measured healthy for its clear_s "
+               "streak and resolved (ISSUE 14; firing_s = time spent "
+               "firing — obs_report's firing→resolved timeline and "
+               "compliance table read it)"},
 }
 
 

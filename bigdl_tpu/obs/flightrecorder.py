@@ -28,7 +28,11 @@ any engine degradation (`engine_degraded`), a poisoned request or a
 pool-exhausted finish (`request_terminal`), a worker preemption
 (`preempted`, emitted by the optimizer loops when a Preempted
 propagates — plus the injected `fault_injected fault=preempt`), and
-checkpoint corruption (`checkpoint_corrupt_skipped`).
+checkpoint corruption (`checkpoint_corrupt_skipped`). ISSUE 14 adds
+SLO burns: an `alert_firing` event (obs/slo.py) dumps a `slo_burn`
+bundle whose trigger record names the alert, objective, and the
+window that breached — the post-mortem exists the moment the page
+does.
 
 Contracts (the standing obs rules, tests/test_journey.py):
 * BIGDL_OBS=off kills it — the listener early-outs on `obs.enabled()`
@@ -83,6 +87,11 @@ def default_trigger(rec: dict) -> Optional[str]:
         return "preempted"
     if kind == "checkpoint_corrupt_skipped":
         return "checkpoint_corrupt"
+    if kind == "alert_firing":
+        # ISSUE 14: an SLO burn is an incident — the bundle's trigger
+        # record names the alert, its objective, and the window that
+        # breached; resolution is not an incident
+        return "slo_burn"
     return None
 
 

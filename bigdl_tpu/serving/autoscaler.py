@@ -28,6 +28,16 @@ Every decision is a pure function of registry state and the injected
 clock — `decisions` records them, and the fleet_autoscale drill
 (scripts/fault_drill.py) replays identical traffic twice asserting
 identical decision sequences and identical load reports.
+
+ISSUE 14: the windowed-p99 math moved to the shared time-series API —
+`obs/timeseries.HistogramWindow` is the exact
+evaluation-to-evaluation cumulative-bucket-delta windowing the old
+private `_window_p99` hand-rolled (same snapshot points, same shared
+estimator ⇒ decisions bit-identical, pinned by fleet_autoscale), and
+`objective=` lets the scaler consume the SAME `obs/slo.SLOObjective`
+the alert engine watches: at max_engines the shed-mode decision asks
+the objective, not local threshold math — one definition of "missing
+the SLO" across scaling and alerting.
 """
 
 from __future__ import annotations
@@ -36,7 +46,7 @@ import logging
 from typing import Dict, List, Optional
 
 from bigdl_tpu import obs
-from bigdl_tpu.obs.registry import quantile_from_buckets
+from bigdl_tpu.obs.timeseries import HistogramWindow
 from bigdl_tpu.serving.router import EngineRouter
 
 logger = logging.getLogger("bigdl_tpu.serving")
@@ -57,17 +67,43 @@ class Autoscaler:
     evaluation — no sample retention, deterministic under the
     injected clock."""
 
-    def __init__(self, router: EngineRouter, *, target_p99_s: float,
+    def __init__(self, router: EngineRouter, *,
+                 target_p99_s: Optional[float] = None,
                  evaluate_every_s: float = 1.0, min_engines: int = 1,
                  max_engines: int = 4, backlog_high: float = 4.0,
                  occupancy_low: float = 0.25,
-                 flip_overload_policy: bool = True):
-        if target_p99_s <= 0:
-            raise ValueError("target_p99_s must be > 0")
+                 flip_overload_policy: bool = True, objective=None):
+        if objective is not None:
+            # ISSUE 14: one SLO definition for scaling AND alerting —
+            # the scaler takes its target AND quantile from the shared
+            # objective and defers threshold judgement to it below.
+            # What it measures stays the router's OWN request-latency
+            # window (evaluation-to-evaluation, HistogramWindow): the
+            # objective's metric/labels select the alert engine's
+            # time-series view of the same router histogram; a scaler
+            # can only ever judge the pool it scales.
+            if objective.kind != "latency_quantile":
+                raise ValueError(
+                    "Autoscaler consumes a latency_quantile objective "
+                    f"(got kind={objective.kind!r})")
+            if target_p99_s is not None \
+                    and target_p99_s != objective.target:
+                # a silently diverging pair would make the recorded
+                # target lie about the threshold actually applied
+                raise ValueError(
+                    f"target_p99_s={target_p99_s} disagrees with "
+                    f"objective {objective.name!r} target "
+                    f"{objective.target} — pass one or make them "
+                    "equal")
+            target_p99_s = objective.target
+        if target_p99_s is None or target_p99_s <= 0:
+            raise ValueError(
+                "target_p99_s must be > 0 (or pass objective=)")
         if not 1 <= min_engines <= max_engines:
             raise ValueError("need 1 <= min_engines <= max_engines")
         self.router = router
         self.target_p99_s = target_p99_s
+        self.objective = objective
         self.evaluate_every_s = evaluate_every_s
         self.min_engines = min_engines
         self.max_engines = max_engines
@@ -76,21 +112,21 @@ class Autoscaler:
         self.flip_overload_policy = flip_overload_policy
         self._clock = router._clock
         self._last_eval: Optional[float] = None
-        self._last_counts: Optional[List[int]] = None
+        # the shared evaluation-to-evaluation windowing
+        # (obs/timeseries.py) — what _window_p99 used to hand-roll
+        self._window = HistogramWindow(router.request_latency)
         self._saved_policies: Optional[Dict[int, str]] = None
         self._draining = None             # the one engine mid-drain
         self.decisions: List[dict] = []
 
     # ------------------------------------------------------------ signals
-    def _window_p99(self) -> Optional[float]:
-        """p99 of requests completed since the last evaluation, from
-        the cumulative-bucket delta (None with no completions)."""
-        child = self.router.request_latency
-        counts = list(child.counts)
-        prev = self._last_counts or [0] * len(counts)
-        self._last_counts = counts
-        delta = [c - p for c, p in zip(counts, prev)]
-        return quantile_from_buckets(child.buckets, delta, 0.99)
+    def _misses_target(self, p99: Optional[float]) -> bool:
+        """Whether a measured windowed p99 misses the SLO (None — no
+        completions — never misses): the shared objective when one is
+        installed, the local threshold otherwise."""
+        if self.objective is not None:
+            return self.objective.violated(p99)
+        return p99 is not None and p99 > self.target_p99_s
 
     # ------------------------------------------------------------ actions
     def _scale_up(self) -> str:
@@ -156,16 +192,17 @@ class Autoscaler:
                 self._draining = None
                 return self._record(now, "scale_down", None)
             return self._record(now, "draining", None)
-        p99 = self._window_p99()
+        p99 = self._window.quantile(
+            self.objective.q if self.objective is not None else 0.99)
         healthy = self.router.healthy_engines()
         n = len(healthy)
         slots = sum(e.slots for e in healthy)
         backlog = sum(e.queue_depth for e in healthy)
         occupancy = (sum(e.slots_active for e in healthy)
                      / max(slots, 1))
-        over = ((p99 is not None and p99 > self.target_p99_s)
+        over = (self._misses_target(p99)
                 or (n > 0 and backlog / n > self.backlog_high))
-        under = ((p99 is None or p99 <= self.target_p99_s)
+        under = ((p99 is None or not self._misses_target(p99))
                  and backlog == 0
                  and occupancy < self.occupancy_low)
         if over:
@@ -177,7 +214,7 @@ class Autoscaler:
             else:
                 action = "hold"
         elif self._saved_policies is not None \
-                and p99 is not None and p99 <= self.target_p99_s:
+                and p99 is not None and not self._misses_target(p99):
             action = self._restore_policies()
         elif under and n > self.min_engines:
             action = self._start_drain()
@@ -192,6 +229,14 @@ class Autoscaler:
              "p99_s": None if p99 is None else round(p99, 6),
              "engines": len(self.router.engines),
              "target_p99_s": self.target_p99_s, **extra}
+        if self.objective is not None:
+            # record which shared SLO drove the decision — and its
+            # quantile, since "p99_s" then actually holds the
+            # objective's q-quantile (absent in threshold mode: the
+            # pre-ISSUE-14 record shape is pinned bit-for-bit by the
+            # fleet_autoscale drill)
+            d["objective"] = self.objective.name
+            d["q"] = self.objective.q
         self.decisions.append(d)
         if action in ("scale_up", "scale_down", "drain", "shed_mode",
                       "restore_policy"):

@@ -116,6 +116,15 @@ bigdl_tpu/serving/router.py + autoscaler.py):
                     reconstructed cross-layout journey per rerouted
                     request, zero lost hops, transitional 'failed'
                     terminals superseded
+    slo_alert       (ISSUE 14) the live SLO plane: a queueing burst
+                    against a 1-engine pool burns a p99 objective
+                    under a virtual clock — the burn-rate alert fires
+                    deterministically (alert_firing), the installed
+                    FlightRecorder dumps ONE slo_burn bundle naming
+                    the breached window, a recovery trickle measures
+                    healthy through clear_s and the alert resolves
+                    (alert_resolved); two runs byte-identical in
+                    report AND bundle bytes
     fleet_journey   (ISSUE 11) the observability plane against the
                     full fleet: disaggregated prefill (pf0) + tp=2
                     'e0' + unsharded 'e1' under one virtual clock
@@ -1213,6 +1222,133 @@ def drill_fleet_autoscale(workdir):
             "events": auto_ev}
 
 
+def drill_slo_alert(workdir):
+    """ISSUE 14: the live SLO plane end to end, twice. A 12-request
+    burst against a 1-engine router under a virtual clock grossly
+    violates a 2-virtual-second p99 objective: the MetricsSampler's
+    windows see the burn on both the long (4 s) and short (1 s)
+    window, the burn-rate AlertRule walks inactive→firing exactly once
+    (alert_firing event naming value/target/window), and the installed
+    FlightRecorder dumps ONE slo_burn post-mortem bundle whose trigger
+    record names the breached window. A recovery trickle of fast
+    requests then measures healthy; flap suppression (clear_s=2.0)
+    holds the alert through the streak and it resolves exactly once
+    (alert_resolved with the firing duration). Pins: one firing, one
+    resolution, one bundle, all requests done — and TWO invocations
+    are byte-identical in the leg digest AND in bundle file bytes
+    (the whole plane is a pure function of the event sequence and the
+    injected clock)."""
+    from bigdl_tpu import obs
+    from bigdl_tpu.obs.flightrecorder import FlightRecorder
+    from bigdl_tpu.obs.slo import AlertEngine, AlertRule, SLOObjective
+    from bigdl_tpu.obs.timeseries import MetricsSampler
+    from bigdl_tpu.serving import EngineRouter
+
+    target = 2.0
+    burst = [dict(prompt=[i + 1, i + 2, i + 3], max_new_tokens=4,
+                  temperature=0.7, seed=90 + i) for i in range(12)]
+    trickle = [dict(prompt=[40 + i], max_new_tokens=1, seed=200 + i)
+               for i in range(8)]
+
+    def run(outdir):
+        clk = {"t": 0.0}
+
+        def c():
+            return clk["t"]
+
+        with _telemetry(clock=c) as log:
+            eng = _engine(obs_label="s0", clock=c)
+            router = EngineRouter([eng], clock=c, obs_label="r0")
+            sampler = MetricsSampler(interval_s=0.5, capacity=256,
+                                     clock=c)
+            obj = SLOObjective(
+                name="p99", kind="latency_quantile",
+                metric="router_request_latency_seconds",
+                target=target, q=0.99, labels={"router": "r0"})
+            rule = AlertRule(name="p99_burn", objective=obj,
+                             kind="burn_rate", long_window_s=4.0,
+                             short_window_s=1.0, clear_s=2.0)
+            aeng = AlertEngine(sampler, [rule], clock=c)
+            rec = FlightRecorder(outdir, clock=c)
+            rec.register_health_source("s0", eng.health)
+            rec.install()
+            got = {}
+
+            def rounds_until(done, limit):
+                n = 0
+                while not done():
+                    n += 1
+                    if n > limit:
+                        raise RuntimeError(
+                            "slo_alert drill stalled "
+                            f"({len(got)} settled)")
+                    clk["t"] += 0.5
+                    for res in router.step():
+                        got[res.id] = res
+                    sampler.tick()
+                    aeng.evaluate()
+
+            # phase 1: the burn — 12 queued requests serialize through
+            # 2 slots, completed-latency p99 blows past the target on
+            # both windows while the backlog drains
+            ids = [router.submit(_req(**s)) for s in burst]
+            rounds_until(lambda: len(got) >= len(ids), limit=300)
+            # phase 2: recovery — each 1-token request completes in
+            # ~1 virtual second, the windows measure healthy, and the
+            # clear_s streak resolves the alert
+            for s in trickle:
+                rid = router.submit(_req(**s))
+                rounds_until(lambda: rid in got, limit=50)
+            rec.close()
+            firing = log.events("alert_firing")
+            resolved = log.events("alert_resolved")
+            digest = json.dumps(
+                {"events": log.counts_by_kind(), "firing": firing,
+                 "resolved": resolved,
+                 "alerts_final": aeng.alerts()}, sort_keys=True)
+        return (got, firing, resolved, rec, digest,
+                _bundle_bytes(outdir))
+
+    got1, firing1, resolved1, rec1, d1, b1 = run(
+        os.path.join(workdir, "run1"))
+    _, _, _, _, d2, b2 = run(os.path.join(workdir, "run2"))
+
+    fired_rec = firing1[0] if firing1 else {}
+    manifest = {}
+    if rec1.bundles:
+        import json as _json
+
+        with open(os.path.join(workdir, "run1", rec1.bundles[0],
+                               "manifest.json")) as f:
+            manifest = _json.load(f)
+    names_window = (manifest.get("incident") == "slo_burn"
+                    and manifest.get("trigger", {}).get("window_s")
+                    == 4.0
+                    and manifest.get("trigger", {}).get("alert")
+                    == "p99_burn")
+    ok = (all(r.status == "done" for r in got1.values())
+          and len(firing1) == 1 and len(resolved1) == 1
+          and fired_rec.get("value") is not None
+          and fired_rec.get("value") > target
+          and resolved1[0].get("firing_s", 0) > 0
+          and len(rec1.bundles) == 1
+          and rec1.bundles[0].endswith("slo_burn")
+          and names_window
+          and d1 == d2
+          and bool(b1) and b1 == b2)
+    return {"ok": bool(ok),
+            "fired": len(firing1), "resolved": len(resolved1),
+            "firing_value": fired_rec.get("value"),
+            "target": target,
+            "firing_s": resolved1[0].get("firing_s")
+            if resolved1 else None,
+            "bundles": rec1.bundles,
+            "bundle_names_window": names_window,
+            "report_byte_identical": d1 == d2,
+            "bundles_byte_identical": bool(b1) and b1 == b2,
+            "events": json.loads(d1)["events"]}
+
+
 def _bundle_bytes(outdir):
     """{relative path: file bytes} over a flight-recorder output dir —
     the byte-identity surface the journey leg compares across runs."""
@@ -1393,6 +1529,7 @@ SERVING_LEGS = {
     "fleet_autoscale": drill_fleet_autoscale,
     "fleet_tp_failover": drill_fleet_tp_failover,
     "fleet_journey": drill_fleet_journey,
+    "slo_alert": drill_slo_alert,
 }
 
 LEGS = {**TRAINING_LEGS, **SERVING_LEGS}

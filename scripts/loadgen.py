@@ -27,11 +27,21 @@ are independent of completion order. Size `prompt_len_choices`,
 `max_new`, turns, and the engine's prefill buckets together: a
 session's final-turn prompt must still fit the largest bucket.
 
+SLO mode (ISSUE 14): `--slo-target-p99` / `--slo-goodput` attach
+declarative objectives (obs/slo.py) to the run — a MetricsSampler
+ticks once per scheduling round on the same virtual clock, burn-rate/
+threshold alerts evaluate deterministically, and the report gains an
+"slo" section (per-objective compliance over the whole run + alert
+counts and final states). Byte-identity is preserved: the SLO plane
+is a pure function of the trace.
+
 Usage (CPU, reproducible):
     JAX_PLATFORMS=cpu python scripts/loadgen.py --requests 32 \
         --engines 2 --arrival bursty --seed 0
     JAX_PLATFORMS=cpu python scripts/loadgen.py --requests 32 \
         --autoscale --target-p99 8.0 --max-engines 3
+    JAX_PLATFORMS=cpu python scripts/loadgen.py --requests 32 \
+        --slo-target-p99 6.0 --slo-goodput 0.95
 """
 
 from __future__ import annotations
@@ -149,16 +159,19 @@ def _pctl(xs: List[float], q: float) -> Optional[float]:
 
 
 def replay(router, trace: dict, *, clock: Dict[str, float],
-           step_dt: float = 0.25, autoscaler=None,
+           step_dt: float = 0.25, autoscaler=None, observer=None,
            max_rounds: int = 200_000) -> dict:
     """Replay `trace` against `router` on the virtual clock.
 
     `clock` is the {"t": float} cell the router AND every engine (and
     the autoscaler's router) were built over (`clock=lambda:
     clk["t"]`) — replay advances it by `step_dt` per scheduling round
-    and jumps idle gaps to the next arrival. Returns the load report
-    (see _report); deterministic for a fixed (router config, trace,
-    step_dt)."""
+    and jumps idle gaps to the next arrival. `observer` (ISSUE 14) is
+    called once per scheduling round after the step and the autoscale
+    evaluation — the SLO plane's tick point (sampler.tick() +
+    alert_engine.evaluate()), on the same virtual clock so two runs
+    stay byte-identical. Returns the load report (see _report);
+    deterministic for a fixed (router config, trace, step_dt)."""
     from bigdl_tpu.serving import NoHealthyEngine, OverloadError
 
     from bigdl_tpu.serving import Request
@@ -203,6 +216,8 @@ def replay(router, trace: dict, *, clock: Dict[str, float],
         out = router.step()
         if autoscaler is not None:
             autoscaler.observe()
+        if observer is not None:
+            observer()
         for res in out:
             results[res.id] = res
             a = owner.get(res.id)
@@ -383,6 +398,18 @@ def main(argv=None) -> int:
     ap.add_argument("--autoscale", action="store_true")
     ap.add_argument("--target-p99", type=float, default=8.0)
     ap.add_argument("--max-engines", type=int, default=4)
+    ap.add_argument("--slo-target-p99", type=float, default=None,
+                    help="attach a p99-latency SLOObjective (virtual "
+                         "seconds) to the run (ISSUE 14): a burn-rate "
+                         "alert watches it per round and the report "
+                         "gains an 'slo' section (compliance + alert "
+                         "counts); two runs stay byte-identical")
+    ap.add_argument("--slo-goodput", type=float, default=None,
+                    help="attach a goodput error-budget objective: at "
+                         "least this fraction of requests must finish "
+                         "'done' (e.g. 0.95 -> bad-terminal budget "
+                         "0.05); threshold alert + report section as "
+                         "above")
     ap.add_argument("--json", default=None,
                     help="also write the report to this path")
     args = ap.parse_args(argv)
@@ -430,8 +457,62 @@ def main(argv=None) -> int:
         autoscale=args.autoscale,
         target_p99_s=args.target_p99, max_engines=args.max_engines,
         tp=args.tp)
+    # SLO plane (ISSUE 14): a sampler ticking once per scheduling
+    # round plus declarative objectives/alerts over the same virtual
+    # clock — pure function of the trace, so the byte-identical
+    # acceptance extends to the new section
+    slo = None
+    if args.slo_target_p99 is not None or args.slo_goodput is not None:
+        from bigdl_tpu.obs.slo import (AlertEngine, AlertRule,
+                                       SLOObjective)
+        from bigdl_tpu.obs.timeseries import MetricsSampler
+
+        sampler = MetricsSampler(interval_s=args.step_dt,
+                                 capacity=8192,
+                                 clock=lambda: clk["t"])
+        rules = []
+        if args.slo_target_p99 is not None:
+            rules.append(AlertRule(
+                name="latency_p99_burn",
+                objective=SLOObjective(
+                    name="latency_p99", kind="latency_quantile",
+                    metric="router_request_latency_seconds",
+                    target=args.slo_target_p99, q=0.99,
+                    labels={"router": router._obs_name}),
+                kind="burn_rate",
+                long_window_s=20 * args.step_dt,
+                short_window_s=5 * args.step_dt,
+                clear_s=5 * args.step_dt))
+        if args.slo_goodput is not None:
+            rules.append(AlertRule(
+                name="goodput_budget",
+                objective=SLOObjective(
+                    name="goodput", kind="error_budget",
+                    metric="serving_requests_total",
+                    target=round(1.0 - args.slo_goodput, 9)),
+                kind="threshold", window_s=20 * args.step_dt,
+                for_s=2 * args.step_dt, clear_s=5 * args.step_dt))
+        aeng = AlertEngine(sampler, rules, clock=lambda: clk["t"])
+        slo = (sampler, aeng)
+
+    def slo_observer():
+        sampler.tick()
+        aeng.evaluate()
+
     report = replay(router, trace, clock=clk, step_dt=args.step_dt,
-                    autoscaler=asc)
+                    autoscaler=asc,
+                    observer=slo_observer if slo else None)
+    if slo:
+        sampler, aeng = slo
+        sampler.sample()              # close the run-wide window
+        report["slo"] = {
+            "objectives": aeng.compliance(),   # whole-run window
+            "alerts": {
+                "fired": aeng.fired, "resolved": aeng.resolved,
+                "final": {a["alert"]: a["state"]
+                          for a in aeng.alerts()},
+            },
+        }
     if args.tp:
         report["pool"]["tp"] = args.tp
     # journey rollup (ISSUE 11): the CLI runs with the default event

@@ -19,6 +19,10 @@ Input: a file written by the structured event log
   visited, seat kind per hop, per-hop dwell (the cross-engine TTFT
   attribution), terminal outcome; `--perfetto PATH` exports one
   Perfetto track per request
+* alerts / SLO section (ISSUE 14): per-objective compliance table and
+  the firing→resolved timeline reconstructed from the
+  `alert_firing`/`alert_resolved` events (obs/slo.py), cross-linked
+  to the slo_burn incident bundles those firings dumped
 * incidents section (ISSUE 11): flight-recorder bundles indexed by
   their `incident_dump` events (obs/flightrecorder.py)
 * metrics tables + latency percentiles, when the file carries a
@@ -108,6 +112,9 @@ def summarize(events: List[dict]) -> Dict[str, object]:
     journeys = _journeys_section(events)
     if journeys:
         out["journeys"] = journeys
+    alerts = _alerts_section(events)
+    if alerts:
+        out["alerts"] = alerts
     incidents = _incidents_section(events)
     if incidents:
         out["incidents"] = incidents
@@ -228,6 +235,86 @@ def _journeys_section(events: List[dict]) -> Optional[dict]:
             "lost_hops": j["lost_hops"],
         })
     return {"summary": summarize_journeys(journeys), "table": table}
+
+
+def _alerts_section(events: List[dict]) -> Optional[dict]:
+    """Alerts / SLO digest (ISSUE 14): the firing→resolved timeline
+    reconstructed from `alert_firing`/`alert_resolved` events
+    (obs/slo.py), per-objective compliance over the run (time spent
+    firing vs the event span), and cross-links to the flight-recorder
+    bundles those firings dumped (incident_dump events whose
+    trigger_kind is alert_firing)."""
+    firing = [e for e in events if e.get("kind") == "alert_firing"]
+    resolved = [e for e in events if e.get("kind") == "alert_resolved"]
+    if not (firing or resolved):
+        return None
+    ts = [e["ts"] for e in events
+          if isinstance(e.get("ts"), (int, float))]
+    span = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+    timeline: List[dict] = []
+    open_by_alert: Dict[str, dict] = {}
+    for e in sorted(firing + resolved, key=lambda r: r.get("seq", 0)):
+        if e["kind"] == "alert_firing":
+            rec = {"alert": e.get("alert"),
+                   "objective": e.get("objective"),
+                   "fired_ts": e.get("ts"), "value": e.get("value"),
+                   "target": e.get("target"),
+                   "window_s": e.get("window_s"),
+                   "rule_kind": e.get("rule_kind"),
+                   "resolved_ts": None, "firing_s": None}
+            timeline.append(rec)
+            open_by_alert[e.get("alert")] = rec
+        else:
+            rec = open_by_alert.pop(e.get("alert"), None)
+            if rec is not None:
+                rec["resolved_ts"] = e.get("ts")
+                rec["firing_s"] = e.get("firing_s")
+    per_obj: Dict[str, dict] = {}
+    intervals: Dict[str, List[tuple]] = {}
+    for rec in timeline:
+        key = rec["objective"] or "?"
+        o = per_obj.setdefault(key, {
+            "alerts": 0, "time_firing_s": 0.0, "still_firing": 0})
+        o["alerts"] += 1
+        if rec["resolved_ts"] is None and rec["firing_s"] is None:
+            o["still_firing"] += 1
+        if isinstance(rec["fired_ts"], (int, float)) and ts:
+            # an open firing burns budget up to the log's end
+            end = rec["resolved_ts"] \
+                if isinstance(rec["resolved_ts"], (int, float)) \
+                else max(ts)
+            intervals.setdefault(key, []).append(
+                (rec["fired_ts"], max(end, rec["fired_ts"])))
+    for key, ivs in intervals.items():
+        # UNION the firing intervals: two rules over one objective
+        # (the standard burn_rate + threshold pairing) firing together
+        # must not double-count budget and drive compliance negative
+        total, cur_lo, cur_hi = 0.0, None, None
+        for lo, hi in sorted(ivs):
+            if cur_hi is None or lo > cur_hi:
+                if cur_hi is not None:
+                    total += cur_hi - cur_lo
+                cur_lo, cur_hi = lo, hi
+            else:
+                cur_hi = max(cur_hi, hi)
+        if cur_hi is not None:
+            total += cur_hi - cur_lo
+        per_obj[key]["time_firing_s"] = total
+    for o in per_obj.values():
+        o["time_firing_s"] = round(o["time_firing_s"], 6)
+        o["compliant_frac"] = (
+            round(max(0.0, 1.0 - o["time_firing_s"] / span), 4)
+            if span > 0 else None)
+    out = {"firing_events": len(firing),
+           "resolved_events": len(resolved),
+           "objectives": dict(sorted(per_obj.items())),
+           "timeline": timeline}
+    bundles = [e.get("bundle") for e in events
+               if e.get("kind") == "incident_dump"
+               and e.get("trigger_kind") == "alert_firing"]
+    if bundles:
+        out["bundles"] = bundles
+    return out
 
 
 def _incidents_section(events: List[dict]) -> Optional[dict]:
@@ -456,6 +543,30 @@ def render(events: List[dict], tail: int = 15) -> str:
         if len(s["journeys"]["table"]) > 20:
             rows.append(("...",
                          f"{len(s['journeys']['table']) - 20} more"))
+        lines.append(_fmt_table(rows))
+    if "alerts" in s:
+        al = s["alerts"]
+        lines.append("\nalerts / SLO:")
+        rows = []
+        for obj, o in al["objectives"].items():
+            comp = ("-" if o["compliant_frac"] is None
+                    else f"{o['compliant_frac']:.2%}")
+            extra = (f", {o['still_firing']} still firing"
+                     if o["still_firing"] else "")
+            rows.append((obj, f"{o['alerts']} alert(s), "
+                              f"{o['time_firing_s']}s firing, "
+                              f"compliant {comp}{extra}"))
+        for rec in al["timeline"]:
+            state = ("resolved after "
+                     f"{rec['firing_s']}s" if rec["firing_s"]
+                     is not None else "STILL FIRING")
+            rows.append((
+                f"{rec['alert']} @ {rec['fired_ts']}",
+                f"{rec['objective']} value {rec['value']} > target "
+                f"{rec['target']} (window {rec['window_s']}s, "
+                f"{rec['rule_kind']}) -> {state}"))
+        for b in al.get("bundles", []):
+            rows.append((b, "post-mortem bundle (slo_burn)"))
         lines.append(_fmt_table(rows))
     if "incidents" in s:
         inc = s["incidents"]

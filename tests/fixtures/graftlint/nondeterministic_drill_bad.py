@@ -37,3 +37,11 @@ def schedule_preempt(n_steps):
     kill_step = np.random.randint(2, n_steps)  # BAD
     torn_at = random.randrange(n_steps)  # BAD
     return f"preempt@{kill_step},ckpt_async_torn@{torn_at}"
+
+
+def alert_evaluate(rule, window_s):
+    # ISSUE 14: an alert engine stamping transitions off the wall
+    # clock — firing times (and therefore the slo_alert drill's
+    # report and bundle bytes) drift run to run
+    fired_at = time.time()  # BAD
+    return {"alert": rule, "fired_at": fired_at, "window_s": window_s}

@@ -44,3 +44,15 @@ def schedule_preempt(n_steps, seed):
     rng = np.random.RandomState(seed)
     kill_step = int(rng.randint(2, n_steps))
     return f"preempt@{kill_step},ckpt_async_torn@{n_steps - 1}"
+
+
+class AlertEngine:
+    # ISSUE 14: alert transitions are stamped from the INJECTED clock
+    # (the sampler's virtual cell in a drill) — evaluation stays a
+    # pure function of (window contents, clock)
+    def __init__(self, clock=time.monotonic):  # injection point
+        self._clock = clock
+
+    def evaluate(self, rule, window_s):
+        return {"alert": rule, "fired_at": self._clock(),
+                "window_s": window_s}

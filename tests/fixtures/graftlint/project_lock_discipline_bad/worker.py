@@ -54,3 +54,22 @@ class Listener:
 
     def snapshot(self):
         return list(self._tail)  # BAD
+
+
+class ScrapeServer:
+    # ISSUE 14 shape: the scrape endpoint's daemon serving thread
+    # shares scrape bookkeeping with the main path — both sides bare
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._scrapes = 0
+        self._last_body = b""
+        self._t = threading.Thread(target=self._serve, daemon=True)
+        self._t.start()
+
+    def _serve(self):
+        while True:
+            self._scrapes += 1  # BAD
+            self._last_body = b"metrics"  # BAD
+
+    def health_view(self):
+        return {"scrapes": self._scrapes}  # BAD

@@ -53,3 +53,24 @@ class StepRunner:
         t.start()
         with self._lock:
             return list(self._results)
+
+
+class ScrapeServer:
+    # ISSUE 14 shape, done right: the serving thread's scrape
+    # bookkeeping and the main path's health view share one lock
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._scrapes = 0
+        self._last_body = b""
+        self._t = threading.Thread(target=self._serve, daemon=True)
+        self._t.start()
+
+    def _serve(self):
+        while True:
+            with self._lock:
+                self._scrapes += 1
+                self._last_body = b"metrics"
+
+    def health_view(self):
+        with self._lock:
+            return {"scrapes": self._scrapes}

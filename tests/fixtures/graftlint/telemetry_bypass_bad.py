@@ -22,3 +22,18 @@ def dump_bundle(outdir, manifest):
 def build_journeys(events):
     print(len(events), "events")  # BAD
     return []
+
+
+# ISSUE 14: the scrape endpoint serves exposition BYTES over HTTP — a
+# print() in its render path would interleave operator chatter with
+# the bench/drill JSON on stdout and bypass the BIGDL_OBS kill switch
+def scrape_metrics(registry):
+    text = registry.render_prometheus()
+    print(text)  # BAD
+    return text.encode()
+
+
+def health_view(alert_engine):
+    firing = alert_engine.firing()
+    print("firing:", firing)  # BAD
+    return {"firing": firing}

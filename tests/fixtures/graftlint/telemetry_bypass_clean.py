@@ -22,3 +22,18 @@ def emit_event(emit_event_fn, step):
 def dump_bundle(emit_event_fn, outdir, slug):
     logger.info("flight recorder dumped %s to %s", slug, outdir)
     emit_event_fn("incident_dump", incident=slug, bundle=outdir)
+
+
+# ISSUE 14: the scrape endpoint hands the exposition bytes back to its
+# HTTP handler and logs through the bigdl_tpu logger — stdout stays
+# untouched for the bench/drill JSON consumers
+def scrape_metrics(registry):
+    text = registry.render_prometheus()
+    logger.debug("scrape served %d bytes", len(text))
+    return text.encode()
+
+
+def health_view(alert_engine):
+    firing = alert_engine.firing()
+    logger.info("alerts firing: %s", firing)
+    return {"firing": firing}

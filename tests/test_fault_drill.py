@@ -70,15 +70,17 @@ def test_elastic_drill_leg(tmp_path, leg):
                                  "fleet_failover", "fleet_drain",
                                  "fleet_autoscale",
                                  "fleet_tp_failover",
-                                 "fleet_journey"])
+                                 "fleet_journey", "slo_alert"])
 def test_serving_drill_leg(tmp_path, leg):
-    """ISSUE 4 + ISSUE 7 + ISSUE 10 + ISSUE 11: the serving-plane
-    reliability drills (poisoned co-batch, overload shed, deadline
-    expiry, retry-then-succeed, watchdog trip), the fleet drills
-    (failover bit-identity — including across sharding layouts, drain,
-    SLO autoscaling) and the observability drill (request journeys
-    across handoff/failover with byte-identical flight-recorder
-    bundles) run bit-deterministically on every tier-1 pass.
+    """ISSUE 4 + ISSUE 7 + ISSUE 10 + ISSUE 11 + ISSUE 14: the
+    serving-plane reliability drills (poisoned co-batch, overload
+    shed, deadline expiry, retry-then-succeed, watchdog trip), the
+    fleet drills (failover bit-identity — including across sharding
+    layouts, drain, SLO autoscaling), the observability drill (request
+    journeys across handoff/failover with byte-identical
+    flight-recorder bundles) and the live-SLO drill (burn-rate alert
+    fires and resolves deterministically with a byte-identical
+    slo_burn bundle) run bit-deterministically on every tier-1 pass.
     Legs must actually DRILL here: the CPU-mesh conftest gives them 8
     devices, so the device-count skip escape is asserted shut."""
     fd = _load_drill()
