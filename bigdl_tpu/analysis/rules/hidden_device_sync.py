@@ -37,6 +37,16 @@ journey/record/dump/bundle/flight functions: the flight recorder runs
 INSIDE emit (an EventLog listener), so a sync in a dump path would
 stall the decode loop once per incident-adjacent event — everything it
 records must be an already-emitted host dict.
+
+ISSUE 15 widens the hot-name set to the speculative-decoding paths:
+verify/rollback/mirror/spec functions (`serving/speculative.py` —
+already inside the `serving/` scope). The verify dispatch carries the
+round's ONE suppressed target fetch and each draft chain step its
+bounded draft fetch (the chain is sequential by construction); the
+acceptance loop, rollback (a pure table/length edit) and mirror
+seating run BETWEEN every verify round, so a stealth sync there
+stalls the whole batch once per round — same bar as the block-table
+surgery paths.
 """
 
 from __future__ import annotations
@@ -54,7 +64,8 @@ _SYNC_METHODS = {"item", "block_until_ready", "tolist", "__array__"}
 _HOT_FN = re.compile(
     r"(decode|prefill|dispatch|step|sample|work|emit|observe"
     r"|lookup|insert|evict|alloc|handoff|place"
-    r"|journey|record|dump|bundle|flight)")
+    r"|journey|record|dump|bundle|flight"
+    r"|verify|rollback|mirror|spec)")
 
 
 @register

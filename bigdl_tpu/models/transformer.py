@@ -703,7 +703,25 @@ class TransformerLM(Module):
         full attention output (and _dense_ffn the full mlp hidden) so
         every downstream contraction keeps its unsharded extent and
         the logits come out replicated AND bitwise identical to
-        tp=1."""
+        tp=1.
+
+        Speculative verify (ISSUE 15): this step doubles as the
+        target's k+1-position scoring entry — serving/speculative.py
+        batches a slot's chain positions pos..pos+k as k+1 ROWS of
+        one call, every row pointing at the SAME slot's table. Each
+        layer writes all rows' k/v (write_decode_blocks, distinct
+        (block, offset) destinations) before any row's attention
+        gathers the pool, so row j SEES rows < j's writes — and
+        because every op here is per-row with the full-table
+        attention extent, a verify row's logits are BITWISE the
+        logits the sequential one-row call computes for that position
+        (per-row bits are batch-extent-independent on this backend;
+        verified at the tiny and 43M shapes). Scoring positions as
+        Q=1 rows rather than as a Q=k+1 prefill is deliberate: Q=1
+        and Q>=2 gemms lower to different kernels (ops/kv_cache.py),
+        so a prefill-shaped verify would score in the wrong regime
+        and the spec-vs-target-only token identity would be luck, not
+        construction."""
         from bigdl_tpu.ops.kv_cache import (paged_attention,
                                             write_decode_blocks)
 

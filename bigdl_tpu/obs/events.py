@@ -149,6 +149,23 @@ EVENT_KINDS: Dict[str, dict] = {
         "optional": ("trace", "hop"),
         "journey": True, "seat": True,
         "doc": "serving engine seated a disaggregated-prefill package"},
+    "spec_verify": {
+        "required": ("plane", "engine", "draft_engine", "step",
+                     "active", "proposed", "accepted", "emitted"),
+        "optional": (),
+        "doc": "one speculative draft-verify round (ISSUE 15): the "
+               "draft proposed `proposed` tokens across `active` "
+               "slots, the target's coupled samples accepted "
+               "`accepted` of them, and `emitted` tokens (accepted + "
+               "per-slot mismatch/bonus samples) left the engine"},
+    "spec_fallback": {
+        "required": ("plane", "engine", "draft_engine", "reason"),
+        "optional": (),
+        "doc": "the SpeculativeEngine lost its draft (watchdog trip / "
+               "dispatch failure / pool exhaustion) and degraded to "
+               "target-only decode — tokens bit-identical by "
+               "construction (ISSUE 15; the draft's own "
+               "engine_degraded event rides alongside)"},
     # ---- serving plane: fleet ------------------------------------------
     "engine_degraded": {
         "required": ("plane", "engine", "reason"),

@@ -18,6 +18,7 @@ from bigdl_tpu.serving.prefix_cache import RadixPrefixCache
 from bigdl_tpu.serving.router import (EngineRouter, NoHealthyEngine,
                                       ROUTER_LATENCY_BUCKETS)
 from bigdl_tpu.serving.sampler import filter_logits, sample_logits
+from bigdl_tpu.serving.speculative import SpeculativeEngine
 from bigdl_tpu.serving.tp import (TPServingLM, gather_serving_params,
                                   shard_serving_params,
                                   tp_serving_model, tp_serving_specs)
@@ -27,6 +28,7 @@ __all__ = [
     "OverloadError", "StepTimeout", "EngineDegraded", "EngineDraining",
     "HandoffPackage", "EngineRouter", "NoHealthyEngine",
     "ROUTER_LATENCY_BUCKETS",
+    "SpeculativeEngine",
     "TPServingLM", "tp_serving_model", "tp_serving_specs",
     "gather_serving_params", "shard_serving_params",
     "Autoscaler", "BlockPool", "RadixPrefixCache",

@@ -195,6 +195,39 @@ class StepTimeout(RuntimeError):
     remote-device model — the axon tunnel blocking indefinitely)."""
 
 
+def _watchdog_call(fn, timeout_s: Optional[float]):
+    """Run a dispatch+fetch closure under an optional wall-clock budget
+    on a daemon thread — the watchdog pattern shared by the engine's
+    decode step and the SpeculativeEngine's draft/verify dispatches
+    (ISSUE 15). `timeout_s=None` runs inline. Raises StepTimeout when
+    the budget passes with the thread still alive (the hung-tunnel
+    model: the device call blocks instead of erroring); other
+    exceptions propagate unchanged. The daemon thread suffices because
+    steady-state PJRT dispatch/fetch releases the GIL while it waits —
+    backend INIT does not, which utils/tpu_probe guards instead."""
+    if timeout_s is None:
+        return fn()
+    box: Dict[str, object] = {}
+
+    def boxed():
+        try:
+            box["r"] = fn()
+        except BaseException as e:      # noqa: BLE001
+            box["e"] = e
+
+    th = threading.Thread(target=boxed, daemon=True,
+                          name="bigdl-serving-step")
+    th.start()
+    th.join(timeout_s)
+    if th.is_alive():
+        raise StepTimeout(
+            f"decode dispatch+fetch exceeded {timeout_s} s watchdog "
+            "budget")
+    if "e" in box:
+        raise box["e"]                  # type: ignore[misc]
+    return box["r"]                     # type: ignore[misc]
+
+
 class EngineDegraded(RuntimeError):
     """The engine quiesced after a watchdog trip or exhausted step
     retries; build a fresh engine (executables are shared, so the
@@ -1116,13 +1149,23 @@ class InferenceEngine:
                                ttft_s=ttft, latency_s=latency)
         self._observe_terminal(req, reason, status,
                                len(self._gen[slot]), ttft, latency)
+        self._meta.pop(req.id, None)
+        self._clear_slot(slot, poisoned=(status == "poisoned"))
+        self._bump(_STATUS_COUNTER[status])
+        return res
+
+    def _clear_slot(self, slot: int, poisoned: bool = False) -> None:
+        """Release one slot's per-slot state and blocks with ZERO
+        request-lifecycle side effects — the shared tail of _finish
+        and the SpeculativeEngine's shadow-mirror release (ISSUE 15;
+        quiesce's per-slot sibling: a mirror is not a request, so its
+        teardown must never emit a terminal or bump a status
+        counter). Keeps the slot-release field list in exactly one
+        place."""
         self._req[slot] = None
         self._gen[slot] = []
         self._temp[slot] = 0.0
-        self._meta.pop(req.id, None)
-        self._release_slot(slot, poisoned=(status == "poisoned"))
-        self._bump(_STATUS_COUNTER[status])
-        return res
+        self._release_slot(slot, poisoned=poisoned)
 
     def _release_slot(self, slot: int, poisoned: bool = False) -> None:
         """Return a finished slot's blocks: shared prefix refs drop
@@ -1174,6 +1217,73 @@ class InferenceEngine:
         consumed the buffers are."""
         return any(getattr(leaf, "is_deleted", lambda: False)()
                    for leaf in jax.tree_util.tree_leaves(self.pool))
+
+    def quiesce(self, reason: str, watchdog: bool = False) -> None:
+        """Degrade WITHOUT touching any request lifecycle — the
+        wrapper hook (ISSUE 15). A SpeculativeEngine owns its requests
+        through its TARGET engine; when the DRAFT engine's dispatch
+        trips the watchdog, the draft must refuse further work,
+        surface 'degraded' health and emit engine_degraded for the
+        fleet/flight-recorder plane — but its seated rows are shadow
+        mirrors, not requests, so _degrade()'s fail-everything path
+        would emit terminal events for requests that live (and keep
+        decoding, target-only) elsewhere. Idempotent."""
+        if self._degraded:
+            return
+        if watchdog:
+            self._bump("watchdog_trips")
+        self._degraded = reason
+        logger.error("serving engine quiesced: %s", reason)
+        obs.emit_event("engine_degraded", plane="serving",
+                       engine=self._obs_name, reason=reason)
+
+    def _emit_multi(self, slot: int, tokens: List[int],
+                    finites: List[bool], now: float
+                    ) -> List[GenerationResult]:
+        """Apply one scheduling round's sampled tokens to `slot` —
+        ONE code path for the classic single-token step and the
+        speculative multi-token round (ISSUE 15). Per token, in the
+        exact order the single-token step always used: advance the
+        sampling-stream clock, evict on a non-finite logits row
+        (status 'poisoned', earlier tokens kept), finish on a stop id
+        (the stop token is not emitted), append + TTFT-stamp, then
+        max_tokens / deadline / cache_full checks, else advance the
+        row clock so the token's successor is decoded next. A
+        terminal mid-list discards the remaining tokens — exactly
+        what a single-token engine would never have sampled. All
+        tokens share this round's `now` (a speculative round emits
+        several tokens in one step, so TTL expiry is checked once per
+        round rather than once per token — the conservative direction
+        is unchanged: expiry can only fire earlier in wall time,
+        never later, than the equivalent single-token rounds)."""
+        done: List[GenerationResult] = []
+        req = self._req[slot]
+        for tok, fin in zip(tokens, finites):
+            self._nout[slot] += 1
+            if not fin:
+                # eviction scrubs the poisoned request's freed
+                # exclusive blocks (never a shared one) — _release_slot
+                done.append(self._finish(slot, "poisoned", "poisoned"))
+                return done
+            if tok in req.stop_ids:
+                done.append(self._finish(slot, "stop_id"))
+                return done
+            self._gen[slot].append(tok)
+            if len(self._gen[slot]) == 1 and req.id in self._meta:
+                self._meta[req.id]["t_first"] = now   # TTFT stamp
+            if len(self._gen[slot]) >= req.max_new_tokens:
+                done.append(self._finish(slot, "max_tokens"))
+                return done
+            elif now >= self._deadline_at(req):
+                done.append(self._finish(slot, "expired", "expired"))
+                return done
+            elif self._pos[slot] + 1 >= self.cache_len:
+                done.append(self._finish(slot, "cache_full"))
+                return done
+            else:
+                self._pos[slot] += 1
+                self._tok[slot] = tok
+        return done
 
     def _degrade(self, reason: str) -> List[GenerationResult]:
         """Quiesce: fail every in-flight and queued request, refuse new
@@ -1230,32 +1340,13 @@ class InferenceEngine:
             # tunnel) and runs inside the watchdog budget above
             return np.asarray(nxt), np.asarray(finite), pools  # graftlint: disable=hidden-device-sync
 
-        if self.step_timeout_s is None or not watchdog:
-            nxt, finite, pools = work()
-        else:
-            box: Dict[str, object] = {}
-
-            def boxed():
-                try:
-                    box["r"] = work()
-                except BaseException as e:      # noqa: BLE001
-                    box["e"] = e
-
-            th = threading.Thread(target=boxed, daemon=True,
-                                  name="bigdl-serving-step")
-            th.start()
-            th.join(self.step_timeout_s)
-            if th.is_alive():
-                raise StepTimeout(
-                    f"decode dispatch+fetch exceeded "
-                    f"{self.step_timeout_s} s watchdog budget")
-            if "e" in box:
-                raise box["e"]                  # type: ignore[misc]
-            nxt, finite, pools = box["r"]       # type: ignore[misc]
+        nxt, finite, pools = _watchdog_call(
+            work, self.step_timeout_s if watchdog else None)
         self.pool = pools
         return nxt, finite
 
-    def _ensure_blocks(self) -> List[GenerationResult]:
+    def _ensure_blocks(self, horizons=None, exhaust: str = "finish"
+                       ) -> Optional[List[GenerationResult]]:
         """Pre-dispatch block growth: a row whose next write position
         crossed into an uncovered block gets a fresh one appended to
         its table (copy-on-write — generated tokens never extend into
@@ -1263,21 +1354,68 @@ class InferenceEngine:
         eviction, the request finishes 'pool_exhausted' (status done,
         partial tokens kept — the block-pool sibling of cache_full).
         With the default pool sizing this cannot happen: worst-case
-        zero-sharing demand is exactly slots * blocks_per_slot."""
+        zero-sharing demand is exactly slots * blocks_per_slot.
+
+        `horizons` (ISSUE 15, speculative decoding): optional per-slot
+        int lookahead — the table must also cover positions
+        pos..pos+horizon, so a verify round's k+1 position-rows (and
+        the draft chain's writes) land in owned blocks. Default (None)
+        is the classic single-position behavior.
+
+        `exhaust='abort'` (the speculative wrapper's DRAFT mode):
+        exhaustion returns None instead of finishing the slot — a
+        shadow mirror must never emit a request_terminal (the quiesce
+        contract); blocks already granted stay registered on their
+        slots and release with them."""
         done: List[GenerationResult] = []
         for i, req in enumerate(self._req):
             if req is None:
                 continue
-            bi = int(self._pos[i]) // self.block_size
-            if self._table[i, bi] != 0:
-                continue
-            new = self._alloc_blocks(1)
-            if new is None:
-                done.append(self._finish(i, "pool_exhausted"))
-                continue
-            self._table[i, bi] = new[0]
-            self._slot_blocks[i][1].append(new[0])
+            h = 0 if horizons is None else int(horizons[i])
+            lo = int(self._pos[i]) // self.block_size
+            hi = (int(self._pos[i]) + h) // self.block_size
+            for bi in range(lo, hi + 1):
+                if self._table[i, bi] != 0:
+                    continue
+                new = self._alloc_blocks(1)
+                if new is None:
+                    if exhaust == "abort":
+                        return None
+                    done.append(self._finish(i, "pool_exhausted"))
+                    break
+                self._table[i, bi] = new[0]
+                self._slot_blocks[i][1].append(new[0])
         return done
+
+    def rollback_slot(self, slot: int) -> int:
+        """Cache rollback hook (ISSUE 15): detach and free the slot's
+        exclusive table blocks strictly beyond the block containing the
+        next write position (`_pos[slot]`). A pure block-TABLE/length
+        edit, never a scrub: a rejected draft suffix's k/v sit at
+        positions beyond the row clock in EXCLUSIVE blocks (the PR-8
+        COW cap keeps every decode-era write out of shared blocks), so
+        they are masked on read and overwritten in place — only whole
+        lookahead blocks past the current block are returned to the
+        pool here, restoring the engine-wide invariant that a table
+        never extends beyond its clock's block between rounds. Entries
+        past the clock's block are exclusively owned by construction
+        (the shared hit chain ends at the COW cap, which the clock has
+        already passed). Returns the number of blocks freed."""
+        bi = int(self._pos[slot]) // self.block_size
+        row = self._table[slot]
+        own = self._slot_blocks[slot][1]
+        freed = 0
+        for j in range(bi + 1, row.shape[0]):
+            b = int(row[j])
+            if not b:
+                continue
+            own.remove(b)
+            self._pool_mgr.unref([b])
+            row[j] = 0
+            freed += 1
+        if freed:
+            self._update_pool_gauge()
+        return freed
 
     # -------------------------------------------- disaggregated prefill
     def _step_prefill(self) -> List[GenerationResult]:
@@ -1522,28 +1660,8 @@ class InferenceEngine:
         for i, req in enumerate(self._req):
             if req is None:
                 continue
-            self._nout[i] += 1
-            if not bool(finite[i]):
-                # eviction scrubs the poisoned request's freed
-                # exclusive blocks (never a shared one) — _release_slot
-                done.append(self._finish(i, "poisoned", "poisoned"))
-                continue
-            tok = int(nxt[i])
-            if tok in req.stop_ids:
-                done.append(self._finish(i, "stop_id"))
-                continue
-            self._gen[i].append(tok)
-            if len(self._gen[i]) == 1 and req.id in self._meta:
-                self._meta[req.id]["t_first"] = now   # TTFT stamp
-            if len(self._gen[i]) >= req.max_new_tokens:
-                done.append(self._finish(i, "max_tokens"))
-            elif now >= self._deadline_at(req):
-                done.append(self._finish(i, "expired", "expired"))
-            elif self._pos[i] + 1 >= self.cache_len:
-                done.append(self._finish(i, "cache_full"))
-            else:
-                self._pos[i] += 1
-                self._tok[i] = tok
+            done.extend(self._emit_multi(i, [int(nxt[i])],
+                                         [bool(finite[i])], now))
         return done
 
     def run(self, requests: Optional[Sequence[Request]] = None

@@ -20,6 +20,21 @@ and cannot perturb any co-batched row. The engine discards the token:
 the per-row finite flag (utils/anomaly.rows_finite) is computed on the
 logits BEFORE sampling and rides back beside the tokens, turning the
 row into a 'poisoned' eviction with no extra host sync.
+
+Coupling property (ISSUE 15, the speculative-decoding operand):
+`sample_logits` is a PURE FUNCTION of (logits, key) — no carried
+sampler state, no global RNG — and the engine derives each key as
+fold_in(PRNGKey(request.seed), output_index). So the token the target
+emits at output index n is fully determined by (target logits at n,
+key_n), whoever computes it: a speculative verify row that holds the
+target's logits for position n and the same fold_in key reproduces
+the target-only token BITWISE, greedy and sampled alike. The draft
+proposes with the SAME keys over its own logits (common random
+numbers — a well-matched draft's sample agrees often), acceptance is
+proposal == target-sample equality, and the emitted stream is the
+target sampler's verbatim — exactness by construction rather than by
+the classic rejection-sampling argument (which is exact only in
+distribution and would break the repo's bitwise discipline).
 """
 
 from __future__ import annotations

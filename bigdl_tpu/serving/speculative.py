@@ -1,0 +1,701 @@
+"""Speculative decoding — draft-verify serving with EXACT acceptance
+(ISSUE 15; ROADMAP item 2).
+
+The decode step is weight-streaming-bound (~172 MB/token fp32 on the
+43M; PROFILE_r07): one expensive weight pass emits ONE token per slot.
+`SpeculativeEngine` wraps a cheap DRAFT `InferenceEngine` and an
+expensive TARGET engine behind the same submit()/run()/step()/health()
+surface the EngineRouter already drives. Per scheduling round the
+draft decodes k tokens ahead on its own paged cache, then the target
+scores all k+1 positions in ONE batched call, so the expensive model's
+weight traffic amortizes across every accepted token.
+
+Exactness construction (the repo's bit-identity discipline)
+-----------------------------------------------------------
+The verify call is the target's own paged decode executable with the
+k+1 chain positions riding the BATCH axis: row (slot, j) carries
+token_j at position pos+j through the slot's own block table. Every
+op in `decode_step_paged` is per-row (LN / gemm rows / full-table-
+extent `paged_attention` with mask <= pos+j), each layer WRITES all
+rows' k/v before any row's attention reads, and per-row bits are
+independent of the batch extent on this backend — verified bitwise at
+both the tiny and the 43M shape: a verify row's logits are EXACTLY the
+logits the sequential Q=1 decode step computes for that position. The
+repo's documented Q=1-vs-Q>=2 kernel asymmetry (ops/kv_cache.py) is
+exactly why verify batches positions as Q=1 ROWS rather than as a
+Q=k+1 prefill: the prefill-shaped call would score position 0 in the
+other gemm regime and the bitwise pin would be luck, not construction.
+
+Acceptance is then COUPLED sampling, not probabilistic rejection: the
+engine's sampler is a pure function of (logits, fold_in(seed, n))
+(serving/sampler.py), so verify row j's sample IS the token the
+target-only engine would emit at output index n0+j — greedy and
+seeded sampling alike. The draft's proposal for that index (sampled
+from the draft's logits with the SAME fold_in key — common random
+numbers, so a well-matched draft agrees often) is accepted iff it
+EQUALS the target's sample; the first mismatch emits the target's own
+sample and discards the rest; a fully-matched chain emits the bonus
+k+1-th sample. Emitted tokens are therefore the target-only token
+stream VERBATIM — bitwise identity per seed, which is strictly
+stronger than the classic rejection-sampling guarantee (exact in
+distribution only) and is what lets the serve_spec drill pin
+spec-vs-target-only byte equality. Draft quality moves ONLY the
+accept rate (i.e. throughput), never a token.
+
+Cache discipline
+----------------
+Verify rows write their k/v at pos..pos+k into the slot's EXCLUSIVE
+blocks (the PR-8 COW cap keeps decode-era writes out of shared
+blocks; `_ensure_blocks(horizons=...)` pre-grows the table). A
+rejected suffix needs NO scrub: its positions sit beyond the rolled-
+back row clock, masked on read and overwritten in place by later
+rounds; whole lookahead blocks past the clock's block detach via
+`rollback_slot` (a table/length edit). The draft keeps a shadow of
+the SAME accepted sequence on its own paged cache — a fully-accepted
+round leaves the draft one position behind (the bonus token was never
+proposed), which the next round repairs with one catch-up step before
+proposing again.
+
+Compile contract: #prefill buckets per MODEL (draft + target) + one
+draft decode executable (B rows) + ONE verify executable (B*(k+1)
+rows) — all through the module-level jitted steps in engine.py, so a
+second engine pair over the same models compiles NOTHING
+(tests/test_speculative.py pins it).
+
+Reliability: the draft is expendable — a draft watchdog trip/dispatch
+failure quiesces the draft (engine_degraded, no request terminals:
+`InferenceEngine.quiesce`) and the wrapper falls back to driving the
+target's own step() with tokens bit-identical to an undisturbed
+target-only run (the serve_spec drill). Verify dispatch failures use
+the target's own watchdog/retry/degrade machinery, faults and all.
+
+All knobs are CONSTRUCTOR args, never env (graftlint trace-env-read).
+Fleet story: draft and target may be different tp layouts — both
+engines' steps are layout-blind behind their models, handoff imports
+mirror into the draft by re-prefilling (prefill bits are tp-invariant,
+ISSUE 10), and the router drives the wrapper exactly like any engine.
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import obs
+from bigdl_tpu.serving.engine import (GenerationResult, InferenceEngine,
+                                      Request, StepTimeout, _decode_step,
+                                      _watchdog_call)
+from bigdl_tpu.utils import faults
+
+
+class SpeculativeEngine:
+    """Draft-verify wrapper over two `InferenceEngine`s.
+
+    >>> spec = SpeculativeEngine(draft_eng, target_eng, k=4)
+    >>> spec.submit(Request(prompt=[1, 2, 3], max_new_tokens=16))
+    >>> results = spec.run()        # tokens == target-only, faster
+
+    Requests live in the TARGET engine (queue, slots, deadlines,
+    overload, lifecycle events all under the target's label); the
+    draft holds per-slot shadow mirrors of the same sequences. `k` is
+    the draft lookahead per round (constructor arg, never env). The
+    wrapper exposes the full router-driven engine surface; `health()`
+    adds a "speculative" section (accept rate, draft overhead,
+    fallback state) and the draft engine's health rides under
+    ["speculative"]["draft"].
+    """
+
+    def __init__(self, draft: InferenceEngine, target: InferenceEngine,
+                 k: int = 4):
+        if k < 1:
+            raise ValueError("k must be >= 1 (the draft proposes at "
+                             "least one token per round)")
+        for name, eng in (("draft", draft), ("target", target)):
+            if eng.role == "prefill":
+                raise ValueError(f"{name} engine has role='prefill': "
+                                 "speculation happens on the decode "
+                                 "path")
+            if eng.degraded:
+                raise ValueError(f"{name} engine is already degraded "
+                                 f"({eng.degraded})")
+        if draft is target:
+            raise ValueError("draft and target must be distinct "
+                             "engines (self-speculation would pay the "
+                             "target's weight traffic per proposal)")
+        if draft.model.cfg.vocab_size != target.model.cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab {draft.model.cfg.vocab_size} != target "
+                f"vocab {target.model.cfg.vocab_size}: proposals and "
+                "samples must share one token space")
+        if draft.slots != target.slots:
+            raise ValueError(
+                f"draft slots {draft.slots} != target slots "
+                f"{target.slots}: the draft shadows the target's "
+                "slot table one-to-one")
+        if draft.cache_len != target.cache_len \
+                or draft.buckets != target.buckets:
+            raise ValueError(
+                "draft and target must share cache length and prefill "
+                f"buckets (draft {draft.cache_len}/{draft.buckets} vs "
+                f"target {target.cache_len}/{target.buckets}): every "
+                "admission the target accepts must mirror into the "
+                "draft")
+        self._d = draft
+        self._t = target
+        self.k = k
+        # draft fallback reason (None while speculating); a degraded
+        # draft turns every subsequent step() into target.step() —
+        # tokens stay bit-identical because the target's row state is
+        # by construction the state a target-only run would hold
+        self._fallback: Optional[str] = None
+        # per-slot shadow bookkeeping: which request id each draft
+        # slot mirrors, and whether the draft trails the target by one
+        # position (the post-bonus lag a catch-up step repairs)
+        self._mirror_ids: List[Optional[int]] = [None] * target.slots
+        self._lag = np.zeros(target.slots, np.int32)
+        self._stats: Dict[str, int] = {
+            "spec_rounds": 0, "draft_steps": 0, "proposed": 0,
+            "accepted": 0, "wasted": 0, "emitted": 0, "fallbacks": 0,
+        }
+        reg = obs.get_registry()
+        labels = dict(engine=target.obs_name, draft=draft.obs_name)
+        self._m_accepted = reg.counter(
+            "serving_spec_accepted_tokens_total",
+            "draft proposals the target's coupled sample confirmed",
+            labelnames=("engine", "draft")).labels(**labels)
+        self._m_wasted = reg.counter(
+            "serving_spec_wasted_draft_total",
+            "draft proposals rejected at verify (draft compute spent, "
+            "no token emitted from it)",
+            labelnames=("engine", "draft")).labels(**labels)
+
+    # ------------------------------------------------- delegated surface
+    @property
+    def model(self):
+        return self._t.model
+
+    @property
+    def slots(self) -> int:
+        return self._t.slots
+
+    @property
+    def buckets(self):
+        return self._t.buckets
+
+    @property
+    def cache_len(self) -> int:
+        return self._t.cache_len
+
+    @property
+    def max_queue(self):
+        return self._t.max_queue
+
+    @property
+    def tp(self) -> int:
+        return self._t.tp
+
+    @property
+    def role(self) -> str:
+        return self._t.role
+
+    @property
+    def completed(self) -> Dict[int, GenerationResult]:
+        return self._t.completed
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        """Target-engine counters plus the speculation tallies."""
+        d = self._t.stats
+        d.update(self._stats)
+        return d
+
+    @property
+    def degraded(self) -> Optional[str]:
+        return self._t.degraded
+
+    @property
+    def draining(self) -> bool:
+        return self._t.draining
+
+    @property
+    def idle(self) -> bool:
+        return self._t.idle
+
+    @property
+    def slots_active(self) -> int:
+        return self._t.slots_active
+
+    @property
+    def queue_depth(self) -> int:
+        return self._t.queue_depth
+
+    @property
+    def obs_name(self) -> str:
+        return self._t.obs_name
+
+    @property
+    def draft_engine(self) -> InferenceEngine:
+        return self._d
+
+    @property
+    def target_engine(self) -> InferenceEngine:
+        return self._t
+
+    @property
+    def fallback(self) -> Optional[str]:
+        """None while speculating; else why the wrapper now drives
+        the target's own single-token step."""
+        return self._fallback
+
+    def submit(self, request: Request) -> int:
+        return self._t.submit(request)
+
+    def drain(self) -> None:
+        self._t.drain()
+
+    def steal_queued(self, n: int):
+        return self._t.steal_queued(n)
+
+    def _requeue(self, request: Request, t=None) -> None:
+        self._t._requeue(request, t)
+
+    def take_handoffs(self):
+        return self._t.take_handoffs()
+
+    def cancel(self, request_id: int) -> GenerationResult:
+        slot = next((i for i, r in enumerate(self._t._req)
+                     if r is not None and r.id == request_id), None)
+        res = self._t.cancel(request_id)
+        if slot is not None:
+            self._release_mirror(slot)
+        return res
+
+    def import_handoff(self, pkg) -> bool:
+        """Seat a disaggregated-prefill package in the target, then
+        mirror the prompt into the draft by RE-PREFILLING it there
+        (the package's KV are target-layer bits — useless to the
+        draft model, whose shadow needs its own): handoff stays
+        layout-invariant because prefill bits are (ISSUE 10)."""
+        if not self._t.import_handoff(pkg):
+            return False
+        if self._fallback is None and self._d.degraded is None:
+            slot = next(i for i, r in enumerate(self._t._req)
+                        if r is not None and r.id == pkg.request.id)
+            self._mirror_slot(slot)
+        return True
+
+    def health(self) -> Dict[str, object]:
+        h = self._t.health()
+        s = self._stats
+        denom = s["proposed"]
+        h["speculative"] = {
+            "k": self.k,
+            "fallback": self._fallback,
+            "rounds": s["spec_rounds"],
+            "draft_steps": s["draft_steps"],
+            "proposed": s["proposed"],
+            "accepted": s["accepted"],
+            "wasted": s["wasted"],
+            "emitted": s["emitted"],
+            "accept_rate": (round(s["accepted"] / denom, 4)
+                            if denom else None),
+            "tokens_per_round": (round(s["emitted"] / s["spec_rounds"],
+                                       4) if s["spec_rounds"] else None),
+            # cheap-property derivation, NOT self._d.health(): this
+            # rides every router/autoscaler/scrape health() call, and
+            # a full second-engine snapshot (histogram quantiles +
+            # registry view) for one string is ops-loop waste
+            "draft": {"engine": self._d.obs_name,
+                      "state": ("degraded" if self._d.degraded
+                                else "ok"),
+                      "tp": self._d.tp},
+        }
+        return h
+
+    def run(self, requests: Optional[Sequence[Request]] = None
+            ) -> List[GenerationResult]:
+        """submit + step to drain, exactly like InferenceEngine.run."""
+        ids = [self.submit(r) for r in requests] if requests else None
+        t = self._t
+        while t._queue or any(r is not None for r in t._req):
+            for res in self.step():
+                t.completed[res.id] = res
+        if ids is None:
+            out = sorted(t.completed.values(), key=lambda r: r.id)
+            t.completed = {}
+            return out
+        return [t.completed.pop(i) for i in ids]
+
+    # --------------------------------------------------- mirror plumbing
+    def _mirror_slot(self, slot: int) -> bool:
+        """Seat a shadow of the target's slot into the SAME draft
+        slot: the draft prefills the prompt through its own radix
+        prefix cache (a shared-prompt burst amortizes draft prefill
+        too) and enters the decode loop at clock len(prompt)-1, like
+        any admission. The clone carries the request's sampling
+        fields (the draft proposes with the target's fold_in keys —
+        common random numbers) but no trace id: shadows must not
+        appear in request journeys."""
+        req = self._t._req[slot]
+        clone = Request(prompt=list(req.prompt),
+                        max_new_tokens=req.max_new_tokens,
+                        temperature=req.temperature, top_k=req.top_k,
+                        top_p=req.top_p, stop_ids=req.stop_ids,
+                        seed=req.seed, id=req.id)
+        if not self._d._admit_into(slot, clone):
+            return False
+        self._mirror_ids[slot] = req.id
+        self._lag[slot] = 0
+        return True
+
+    def _release_mirror(self, slot: int, poisoned: bool = False) -> None:
+        if self._d._req[slot] is not None:
+            # the quiet engine-side release: no terminal, no counter
+            self._d._clear_slot(slot, poisoned=poisoned)
+        self._mirror_ids[slot] = None
+        self._lag[slot] = 0
+
+    def _release_all_mirrors(self) -> None:
+        for i in range(self._t.slots):
+            self._release_mirror(i)
+
+    def _enter_fallback(self, reason: str, watchdog: bool) -> None:
+        """Quiesce the draft and hand every subsequent round to the
+        target's own step(). The target's row state at this instant is
+        bitwise the state an undisturbed target-only run holds (every
+        accepted token WAS the target's own sample, every cache write
+        its own bits), so the degradation is invisible in the token
+        stream — the serve_spec drill pins exactly this."""
+        self._fallback = reason
+        self._stats["fallbacks"] += 1
+        self._d.quiesce(reason, watchdog=watchdog)
+        self._release_all_mirrors()
+        obs.emit_event("spec_fallback", plane="serving",
+                       engine=self._t.obs_name,
+                       draft_engine=self._d.obs_name, reason=reason)
+
+    # -------------------------------------------------------- dispatches
+    def _draft_dispatch(self, tok, pos, nout, table, slow_s: float):
+        """One draft chain step over all slots (inert rows point at
+        the scratch block). Guarded by the DRAFT's watchdog budget —
+        the draft is the expendable half, so a trip here becomes
+        fallback, not an outage."""
+        d = self._d
+
+        def work():
+            if slow_s:
+                time.sleep(slow_s)    # injected straggler/hang model
+            if d._degraded is not None or self._t._degraded is not None:
+                # abandoned-thread guard (see _dispatch_and_fetch): a
+                # late dispatch nobody consumes can abort interpreter
+                # shutdown mid-XLA
+                return None
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore", message=".*[Dd]onat", category=UserWarning)
+                nxt, _, pools = _decode_step(
+                    d.model, d._params, d.pool,
+                    jnp.asarray(tok), jnp.asarray(pos),
+                    jnp.asarray(d._seed), jnp.asarray(nout),
+                    jnp.asarray(d._temp), jnp.asarray(d._topk),
+                    jnp.asarray(d._topp),
+                    jnp.asarray(np.zeros(d.slots, bool)),
+                    jnp.asarray(table))
+            # the draft half of the round's deliberate fetches: the
+            # chain is sequential by nature (step j+1's input token IS
+            # step j's sample), so one bounded host fetch per draft
+            # step is the construction, not an accident
+            return np.asarray(nxt), pools  # graftlint: disable=hidden-device-sync
+
+        out = _watchdog_call(work, d.step_timeout_s)
+        nxt, pools = out
+        d.pool = pools
+        return nxt
+
+    def _verify_dispatch(self, tok, pos, seed, nout, temp, topk, topp,
+                         poison, table, slow_s: float):
+        """The round's ONE target weight pass: B*(k+1) chain-position
+        rows through the target's shared decode executable, guarded by
+        the TARGET's watchdog budget."""
+        t = self._t
+
+        def work():
+            if slow_s:
+                time.sleep(slow_s)    # injected straggler/hang model
+            if t._degraded is not None:
+                return None
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore", message=".*[Dd]onat", category=UserWarning)
+                nxt, finite, pools = _decode_step(
+                    t.model, t._params, t.pool,
+                    jnp.asarray(tok), jnp.asarray(pos),
+                    jnp.asarray(seed), jnp.asarray(nout),
+                    jnp.asarray(temp), jnp.asarray(topk),
+                    jnp.asarray(topp), jnp.asarray(poison),
+                    jnp.asarray(table))
+            # THE one deliberate per-round target fetch: it fences the
+            # verify dispatch (block_until_ready lies through the
+            # tunnel) and runs inside the watchdog budget above
+            return np.asarray(nxt), np.asarray(finite), pools  # graftlint: disable=hidden-device-sync
+
+        nxt, finite, pools = _watchdog_call(work, t.step_timeout_s)
+        t.pool = pools
+        return nxt, finite
+
+    # ------------------------------------------------------------- step
+    def step(self) -> List[GenerationResult]:
+        """One speculative scheduling round: admit + mirror, draft k
+        ahead, verify all chain positions in one target pass, accept
+        the longest coupled-sample match, emit, roll back the rejected
+        suffix. Degrades to the target's own step() when the draft is
+        gone."""
+        t, d, k = self._t, self._d, self.k
+        if t._degraded:
+            return []
+        if self._fallback is not None:
+            return t.step()
+        if d.degraded is not None:
+            # the draft died outside our dispatch (external quiesce)
+            self._enter_fallback(f"draft degraded ({d.degraded})",
+                                 watchdog=False)
+            return t.step()
+        t._admit()
+        for i, req in enumerate(t._req):
+            if req is not None and self._mirror_ids[i] != req.id:
+                if not self._mirror_slot(i):
+                    self._enter_fallback(
+                        "draft pool exhausted mirroring admission",
+                        watchdog=False)
+                    return t.step()
+        B = t.slots
+        # per-slot horizons: how many proposals this round may verify.
+        # A lagging slot's catch-up step does NOT shrink its horizon:
+        # the catch-up consumes neither a verify row (rows = h+1) nor
+        # a proposals column (j = s - lag <= k-1), so a fully-accepted
+        # round keeps proposing k next round — capping at k - lag
+        # would starve the high-accept regime (and stall speculation
+        # entirely at k=1)
+        horizons = np.zeros(B, np.int32)
+        for i, req in enumerate(t._req):
+            if req is None:
+                continue
+            head = t.cache_len - 1 - int(t._pos[i])
+            remaining = req.max_new_tokens - len(t._gen[i])
+            horizons[i] = max(0, min(k, head, remaining))
+        done = t._ensure_blocks(horizons)
+        for i in range(B):
+            if t._req[i] is None and self._mirror_ids[i] is not None:
+                self._release_mirror(i)       # pool_exhausted evictee
+                horizons[i] = 0
+        if all(r is None for r in t._req):
+            return done
+        # draft lookahead blocks: the chain writes cover
+        # draft_pos..target_pos+h-1 (catch-up included)
+        draft_h = np.maximum(horizons + self._lag - 1, 0)
+        draft_h[[i for i in range(B) if t._req[i] is None]] = 0
+        # exhaust='abort': a mirror must never finish 'pool_exhausted'
+        # (that emits a request_terminal for a request that keeps
+        # living in the target) — draft pool pressure means fallback
+        if d._ensure_blocks(draft_h, exhaust="abort") is None:
+            self._enter_fallback("draft pool exhausted growing "
+                                 "lookahead blocks", watchdog=False)
+            return done + t.step()
+
+        plan = faults.get_plan()
+        active = [i for i in range(B) if t._req[i] is not None]
+
+        # ---- draft chain: lag catch-up steps, then proposals -------
+        proposals = np.zeros((B, k), np.int32)
+        steps_per_slot = np.zeros(B, np.int32)
+        for i in active:
+            steps_per_slot[i] = int(self._lag[i]) + int(horizons[i])
+        ctok = d._tok.copy()
+        cpos = d._pos.copy()
+        nsteps = int(steps_per_slot.max()) if len(active) else 0
+        for s in range(nsteps):
+            tok_op = np.zeros(B, np.int32)
+            pos_op = np.zeros(B, np.int32)
+            nout_op = np.zeros(B, np.int32)
+            table_op = np.zeros_like(d._table)
+            live = [i for i in active if s < steps_per_slot[i]]
+            for i in live:
+                tok_op[i] = ctok[i]
+                pos_op[i] = cpos[i]
+                nout_op[i] = int(t._nout[i]) + max(s - int(self._lag[i]),
+                                                   0)
+                table_op[i] = d._table[i]
+            dstep = d._stats["decode_steps"]
+            slow_s = 0.0
+            if plan.fires("serve_slow", dstep):
+                slow_s = (d.step_timeout_s or 0.05) * 5
+            try:
+                plan.maybe_raise("serve_err", dstep)
+                nxt = self._draft_dispatch(tok_op, pos_op, nout_op,
+                                           table_op, slow_s)
+            except StepTimeout as e:
+                self._enter_fallback(
+                    f"draft watchdog trip at draft step {dstep}: {e}",
+                    watchdog=True)
+                return done + t.step()
+            except Exception as e:              # noqa: BLE001
+                self._enter_fallback(
+                    f"draft step {dstep} failed: {e}", watchdog=False)
+                return done + t.step()
+            d._bump("decode_steps")
+            self._stats["draft_steps"] += 1
+            for i in live:
+                if s < int(self._lag[i]):
+                    # catch-up wrote the already-known token; the
+                    # chain resumes from the target's current
+                    ctok[i] = int(t._tok[i])
+                    cpos[i] = int(t._pos[i])
+                else:
+                    j = s - int(self._lag[i])
+                    proposals[i, j] = int(nxt[i])
+                    ctok[i] = int(nxt[i])
+                    cpos[i] = cpos[i] + 1
+
+        # ---- verify: all chain positions as rows of ONE target pass
+        Bv = B * (k + 1)
+        vtok = np.zeros(Bv, np.int32)
+        vpos = np.zeros(Bv, np.int32)
+        vseed = np.zeros(Bv, np.int32)
+        vnout = np.zeros(Bv, np.int32)
+        vtemp = np.zeros(Bv, np.float32)
+        vtopk = np.zeros(Bv, np.int32)
+        vtopp = np.ones(Bv, np.float32)
+        vpoison = np.zeros(Bv, bool)
+        vtable = np.zeros((Bv, t._table.shape[1]), np.int32)
+        for i in active:
+            base = i * (k + 1)
+            for j in range(int(horizons[i]) + 1):
+                r = base + j
+                vtok[r] = int(t._tok[i]) if j == 0 \
+                    else int(proposals[i, j - 1])
+                vpos[r] = int(t._pos[i]) + j
+                vseed[r] = t._seed[i]
+                vnout[r] = int(t._nout[i]) + j
+                vtemp[r] = t._temp[i]
+                vtopk[r] = t._topk[i]
+                vtopp[r] = t._topp[i]
+                vtable[r] = t._table[i]
+        stepno = t._stats["decode_steps"]
+        if plan.fires("serve_nan", stepno):
+            vpoison[active[0] * (k + 1)] = True   # lowest active slot
+        for attempt in range(t.step_retries + 1):
+            try:
+                plan.maybe_raise("serve_err", stepno)
+                slow_s = 0.0
+                if plan.fires("serve_slow", stepno):
+                    slow_s = (t.step_timeout_s or 0.05) * 5
+                tc0 = t._clock()
+                nxt, finite = self._verify_dispatch(
+                    vtok, vpos, vseed, vnout, vtemp, vtopk, vtopp,
+                    vpoison, vtable, slow_s)
+                t._m_lat.observe(t._clock() - tc0)
+                if obs.enabled():
+                    tracer = obs.get_tracer()
+                    if tracer.enabled:
+                        tracer.complete(
+                            "spec_verify", "serving", tc0, t._clock(),
+                            args={"step": stepno, "active": len(active),
+                                  "k": k})
+                break
+            except StepTimeout as e:
+                t._bump("watchdog_trips")
+                self._release_all_mirrors()
+                return done + t._degrade(
+                    f"watchdog trip at verify step {stepno}: {e}")
+            except Exception as e:              # noqa: BLE001
+                if t._cache_consumed():
+                    self._release_all_mirrors()
+                    return done + t._degrade(
+                        f"verify step {stepno} failed after cache "
+                        f"donation (buffers consumed, not "
+                        f"retryable): {e}")
+                if attempt >= t.step_retries:
+                    self._release_all_mirrors()
+                    return done + t._degrade(
+                        f"verify step {stepno} failed after "
+                        f"{attempt + 1} attempt(s): {e}")
+                t._bump("retries")
+                if t.retry_backoff_s:
+                    time.sleep(t.retry_backoff_s * (2 ** attempt))
+        t._bump("decode_steps")
+        self._stats["spec_rounds"] += 1
+
+        # ---- coupled acceptance + multi-token emit + rollback ------
+        now = t._clock()
+        round_prop = round_acc = round_emit = 0
+        for i in active:
+            req = t._req[i]
+            if req is None:
+                continue
+            h = int(horizons[i])
+            base = i * (k + 1)
+            toks: List[int] = []
+            fins: List[bool] = []
+            matched = 0
+            for j in range(h + 1):
+                g = int(nxt[base + j])
+                fin = bool(finite[base + j])
+                toks.append(g)
+                fins.append(fin)
+                if not fin:
+                    break
+                if j < h and g != int(proposals[i, j]):
+                    break
+                if j < h:
+                    matched += 1
+            t0_tok = int(t._tok[i])
+            gen0 = len(t._gen[i])
+            res = t._emit_multi(i, toks, fins, now)
+            done.extend(res)
+            round_prop += h
+            round_acc += matched
+            # count tokens that actually LEFT the engine (the
+            # spec_verify contract): a terminal mid-list discards the
+            # rest — stop_id/poisoned rows emit nothing themselves
+            if t._req[i] is None:
+                round_emit += (len(res[-1].tokens) - gen0) if res else 0
+                # terminal mid-round: the mirror follows its request
+                pois = bool(res and res[-1].status == "poisoned")
+                self._release_mirror(i, poisoned=pois)
+                continue
+            round_emit += len(t._gen[i]) - gen0
+            # surviving slot: _emit_multi advanced the target to
+            # (pos0+m, e_m); truncate lookahead blocks past the clock
+            # and re-point the draft shadow at the accepted sequence
+            m = len(toks)
+            t.rollback_slot(i)
+            if m == h + 1:
+                # fully accepted (+ bonus): the draft never proposed
+                # the bonus, so its cache trails by one — catch up
+                # next round
+                self._lag[i] = 1
+                d._pos[i] = int(t._pos[i]) - 1
+                d._tok[i] = int(proposals[i, h - 1]) if h else t0_tok
+            else:
+                self._lag[i] = 0
+                d._pos[i] = int(t._pos[i])
+                d._tok[i] = int(t._tok[i])
+            d._nout[i] = int(t._nout[i])
+            d.rollback_slot(i)
+        self._stats["proposed"] += round_prop
+        self._stats["accepted"] += round_acc
+        self._stats["wasted"] += round_prop - round_acc
+        self._stats["emitted"] += round_emit
+        if obs.enabled():
+            self._m_accepted.inc(round_acc)
+            self._m_wasted.inc(round_prop - round_acc)
+        obs.emit_event("spec_verify", plane="serving",
+                       engine=t.obs_name, draft_engine=d.obs_name,
+                       step=stepno, active=len(active),
+                       proposed=round_prop, accepted=round_acc,
+                       emitted=round_emit)
+        return done

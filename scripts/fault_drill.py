@@ -601,6 +601,24 @@ def _engine(**kw):
     return InferenceEngine(_serve_lm(), **kw)
 
 
+# a second tiny LM for the speculative leg's DRAFT engine — shared for
+# the same compile-once reason as _SERVE_LM
+_SERVE_DRAFT_LM = None
+
+
+def _serve_draft_lm():
+    global _SERVE_DRAFT_LM
+    if _SERVE_DRAFT_LM is None:
+        import jax
+
+        from bigdl_tpu.models.transformer import build_lm
+
+        _SERVE_DRAFT_LM = build_lm(vocab_size=50, dim=16, num_heads=2,
+                                   num_layers=1, max_len=64)
+        _SERVE_DRAFT_LM.build(jax.random.PRNGKey(7))
+    return _SERVE_DRAFT_LM
+
+
 def _req(**kw):
     from bigdl_tpu.serving import Request
 
@@ -982,6 +1000,88 @@ def drill_serve_prefix(workdir):
             "events": {"warm": log1.counts_by_kind(),
                        "evict": log2.counts_by_kind(),
                        "poison": log3.counts_by_kind()}}
+
+
+def drill_serve_spec(workdir):
+    """ISSUE 15: speculative decoding loses its draft mid-burst,
+    twice. A 6-request burst (greedy + seeded sampling) runs through a
+    SpeculativeEngine — tiny draft engine (watchdog armed, 50 ms) over
+    the shared tiny target. serve_slow@3 hangs a DRAFT chain dispatch
+    past its budget on round 2: the draft quiesces (ONE
+    engine_degraded event, ZERO request terminals from it — the
+    requests live in the target), a spec_fallback event records the
+    degradation, and the wrapper finishes every request target-only
+    with tokens BIT-IDENTICAL to an undisturbed target-only run. Zero
+    requests lost; accept-rate provenance from the rounds that DID
+    speculate; two runs byte-identical in the leg digest (event
+    counts, statuses, tokens, speculation tallies)."""
+    from bigdl_tpu.serving import InferenceEngine, SpeculativeEngine
+
+    specs = [dict(prompt=[i + 1, i + 2, i + 3], max_new_tokens=6,
+                  temperature=(0.8 if i % 2 else 0.0), seed=50 + i)
+             for i in range(6)]
+    ref = _engine(slots=2).run([_req(**s) for s in specs])
+
+    def run():
+        fm = _plan("serve_slow@3")
+        try:
+            with _telemetry() as log:
+                draft = InferenceEngine(_serve_draft_lm(), slots=2,
+                                        prefill_buckets=(8,),
+                                        step_timeout_s=0.05,
+                                        obs_label="spec_d")
+                target = _engine(obs_label="spec_t")
+                eng = SpeculativeEngine(draft, target, k=3)
+                got = eng.run([_req(**s) for s in specs])
+                h = eng.health()["speculative"]
+                digest = json.dumps({
+                    "events": log.counts_by_kind(),
+                    "statuses": [r.status for r in got],
+                    "tokens": [r.tokens for r in got],
+                    "spec": {k: h[k] for k in
+                             ("rounds", "proposed", "accepted",
+                              "wasted", "emitted", "accept_rate")},
+                }, sort_keys=True)
+                degraded_ev = log.events("engine_degraded")
+                fallback_ev = log.events("spec_fallback")
+                failed_ev = log.events("request_terminal",
+                                       status="failed")
+                done_ev = log.events("request_terminal", status="done")
+        finally:
+            fm.set_plan(None)
+        return eng, got, digest, (degraded_ev, fallback_ev, failed_ev,
+                                  done_ev)
+
+    eng1, got1, d1, (degraded_ev, fallback_ev, failed_ev, done_ev) \
+        = run()
+    _, _, d2, _ = run()
+
+    bit_identical = [g.tokens for g in got1] == [r.tokens for r in ref]
+    h1 = eng1.health()["speculative"]
+    ok = (eng1.fallback is not None and "watchdog" in eng1.fallback
+          and eng1.draft_engine.degraded is not None
+          and eng1.draft_engine.stats["watchdog_trips"] == 1
+          and all(g.status == "done" for g in got1)
+          and bit_identical
+          and len(degraded_ev) == 1
+          and degraded_ev[0]["engine"] == "spec_d"
+          and len(fallback_ev) == 1
+          and fallback_ev[0]["engine"] == "spec_t"
+          and len(failed_ev) == 0               # zero requests lost
+          and len(done_ev) == 6
+          and h1["rounds"] >= 1                 # it DID speculate first
+          and h1["accept_rate"] is not None
+          and d1 == d2)
+    return {"ok": bool(ok),
+            "statuses": [g.status for g in got1],
+            "bit_identical_to_target_only": bit_identical,
+            "fallback": eng1.fallback,
+            "draft_degraded": eng1.draft_engine.degraded,
+            "rounds_before_trip": h1["rounds"],
+            "accept_rate": h1["accept_rate"],
+            "requests_lost": len(failed_ev),
+            "report_byte_identical": d1 == d2,
+            "events": json.loads(d1)["events"]}
 
 
 # ------------------------------------------------------------ fleet legs
@@ -1524,6 +1624,7 @@ SERVING_LEGS = {
     "serve_retry": drill_serve_retry,
     "serve_watchdog": drill_serve_watchdog,
     "serve_prefix": drill_serve_prefix,
+    "serve_spec": drill_serve_spec,
     "fleet_failover": drill_fleet_failover,
     "fleet_drain": drill_fleet_drain,
     "fleet_autoscale": drill_fleet_autoscale,

@@ -303,7 +303,8 @@ def build_fleet(engines: int = 1, *, slots: int = 4,
                 clock: Optional[Dict[str, float]] = None,
                 autoscale: bool = False, target_p99_s: float = 8.0,
                 max_engines: int = 4, evaluate_every_s: float = 1.0,
-                tp: Optional[int] = None, tp_axis: str = "model"):
+                tp: Optional[int] = None, tp_axis: str = "model",
+                spec_draft: bool = False, spec_k: int = 4):
     """Tiny-LM fleet for the CLI and the drills: a routed pool over
     ONE model object (engines share executables — #buckets+1 compiles
     total however large the pool grows), every clock the same virtual
@@ -313,7 +314,14 @@ def build_fleet(engines: int = 1, *, slots: int = 4,
     `tp` devices — one shared serving/tp.py wrapper, so the pool-wide
     compile contract is unchanged and the emitted tokens are bitwise
     the tp=None tokens. Needs `tp` devices (the 8-device XLA_FLAGS)
-    and tp must divide the tiny model's 2 heads."""
+    and tp must divide the tiny model's 2 heads.
+
+    `spec_draft` (ISSUE 15) fronts every pool engine with a
+    SpeculativeEngine over a shared even-tinier draft model — same
+    virtual clock, same pool-wide compile discipline (one draft model
+    object), tokens bitwise the spec_draft=False tokens (coupled
+    acceptance, serving/speculative.py); `spec_k` is the per-round
+    draft lookahead."""
     import jax
 
     from bigdl_tpu.models.transformer import build_lm
@@ -334,15 +342,29 @@ def build_fleet(engines: int = 1, *, slots: int = 4,
                 f"{jax.device_count()} (run with XLA_FLAGS="
                 "--xla_force_host_platform_device_count=8)")
         mesh = make_mesh({tp_axis: tp}, devices=jax.devices()[:tp])
+    draft_model = None
+    if spec_draft:
+        draft_model = build_lm(vocab_size=50, dim=16, num_heads=2,
+                               num_layers=1, max_len=max_len)
+        draft_model.build(jax.random.PRNGKey(1))
 
     def factory():
-        return InferenceEngine(model, slots=slots,
-                               prefill_buckets=prefill_buckets,
-                               block_size=block_size,
-                               max_queue=max_queue,
-                               overload_policy=overload_policy,
-                               clock=lambda: clk["t"],
-                               tp_mesh=mesh, tp_axis=tp_axis)
+        eng = InferenceEngine(model, slots=slots,
+                              prefill_buckets=prefill_buckets,
+                              block_size=block_size,
+                              max_queue=max_queue,
+                              overload_policy=overload_policy,
+                              clock=lambda: clk["t"],
+                              tp_mesh=mesh, tp_axis=tp_axis)
+        if not spec_draft:
+            return eng
+        from bigdl_tpu.serving import SpeculativeEngine
+
+        draft = InferenceEngine(draft_model, slots=slots,
+                                prefill_buckets=prefill_buckets,
+                                block_size=block_size,
+                                clock=lambda: clk["t"])
+        return SpeculativeEngine(draft, eng, k=spec_k)
 
     router = EngineRouter([factory() for _ in range(engines)],
                           engine_factory=factory,
@@ -395,6 +417,15 @@ def main(argv=None) -> int:
                          "many devices (ISSUE 10; needs the 8-device "
                          "XLA_FLAGS and must divide the tiny model's "
                          "2 heads — tokens stay bitwise == unsharded)")
+    ap.add_argument("--spec-draft", action="store_true",
+                    help="front every engine with a SpeculativeEngine "
+                         "over a shared tiny draft model (ISSUE 15): "
+                         "tokens stay bitwise the non-spec tokens "
+                         "(coupled acceptance) and the report gains a "
+                         "'spec' section (accept rate, draft-overhead "
+                         "share); two runs stay byte-identical")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft lookahead per speculative round")
     ap.add_argument("--autoscale", action="store_true")
     ap.add_argument("--target-p99", type=float, default=8.0)
     ap.add_argument("--max-engines", type=int, default=4)
@@ -456,7 +487,7 @@ def main(argv=None) -> int:
         block_size=args.block_size,
         autoscale=args.autoscale,
         target_p99_s=args.target_p99, max_engines=args.max_engines,
-        tp=args.tp)
+        tp=args.tp, spec_draft=args.spec_draft, spec_k=args.spec_k)
     # SLO plane (ISSUE 14): a sampler ticking once per scheduling
     # round plus declarative objectives/alerts over the same virtual
     # clock — pure function of the trace, so the byte-identical
@@ -515,6 +546,29 @@ def main(argv=None) -> int:
         }
     if args.tp:
         report["pool"]["tp"] = args.tp
+    if args.spec_draft:
+        # speculation rollup (ISSUE 15): tallies straight from the
+        # wrappers' host-side stats — deterministic, so the section
+        # rides the byte-identical acceptance like everything else
+        from bigdl_tpu.serving import SpeculativeEngine
+
+        agg = {"k": args.spec_k, "rounds": 0, "proposed": 0,
+               "accepted": 0, "wasted": 0, "emitted": 0,
+               "fallbacks": 0}
+        for e in router.engines:
+            if not isinstance(e, SpeculativeEngine):
+                continue
+            s = e.stats
+            agg["rounds"] += s["spec_rounds"]
+            for key in ("proposed", "accepted", "wasted", "emitted",
+                        "fallbacks"):
+                agg[key] += s[key]
+        agg["accept_rate"] = (round(agg["accepted"] / agg["proposed"],
+                                    4) if agg["proposed"] else None)
+        agg["draft_overhead_share"] = (
+            round(agg["wasted"] / agg["proposed"], 4)
+            if agg["proposed"] else None)
+        report["spec"] = agg
     # journey rollup (ISSUE 11): the CLI runs with the default event
     # log armed, so the trace/hop stamps are already there — report
     # how many requests moved between engines (rebalance/failover/

@@ -121,6 +121,9 @@ def summarize(events: List[dict]) -> Dict[str, object]:
     prefix = _prefix_section(events)
     if prefix:
         out["prefix"] = prefix
+    spec = _speculation_section(events)
+    if spec:
+        out["speculation"] = spec
     faults = [e for e in events if e.get("kind") == "fault_injected"]
     if faults:
         out["faults"] = [f'{e["fault"]}@{e["step"]}' for e in faults]
@@ -388,6 +391,44 @@ def _prefix_section(events: List[dict]) -> Optional[dict]:
     return out or None
 
 
+def _speculation_section(events: List[dict]) -> Optional[dict]:
+    """Speculative-decoding digest (ISSUE 15): per-engine accept rate
+    and draft-overhead share from the `spec_verify` round events, plus
+    any `spec_fallback` degradations. `draft_overhead_share` is the
+    fraction of draft proposals whose compute bought no token (wasted
+    / proposed) — the price of misprediction; `tokens_per_round` is
+    the amortization the verify pass achieved (1.0 = no better than
+    target-only decode)."""
+    rounds = [e for e in events if e.get("kind") == "spec_verify"]
+    fallbacks = [e for e in events if e.get("kind") == "spec_fallback"]
+    if not (rounds or fallbacks):
+        return None
+    per_engine: Dict[str, dict] = {}
+    for e in rounds:
+        eng = per_engine.setdefault(e.get("engine", "?"), {
+            "draft": e.get("draft_engine"), "rounds": 0, "proposed": 0,
+            "accepted": 0, "emitted": 0})
+        eng["rounds"] += 1
+        eng["proposed"] += e.get("proposed", 0)
+        eng["accepted"] += e.get("accepted", 0)
+        eng["emitted"] += e.get("emitted", 0)
+    for eng in per_engine.values():
+        prop = eng["proposed"]
+        eng["accept_rate"] = (round(eng["accepted"] / prop, 4)
+                              if prop else None)
+        eng["draft_overhead_share"] = (
+            round((prop - eng["accepted"]) / prop, 4) if prop else None)
+        eng["tokens_per_round"] = (round(eng["emitted"] / eng["rounds"],
+                                         4) if eng["rounds"] else None)
+    out: dict = {"per_engine": dict(sorted(per_engine.items()))}
+    if fallbacks:
+        out["fallbacks"] = [{"engine": e.get("engine"),
+                             "draft": e.get("draft_engine"),
+                             "reason": e.get("reason")}
+                            for e in fallbacks]
+    return out
+
+
 def _checkpoint_section(events: List[dict]) -> Optional[dict]:
     """Checkpoint digest (ISSUE 9): save cadence and durations from
     the enriched `checkpoint_save` events (`async`/`duration_s`/
@@ -585,6 +626,23 @@ def render(events: List[dict], tail: int = 15) -> str:
         if "pool_blocks_in_use" in p:
             rows += [(f"pool in use [{eng}]", v)
                      for eng, v in p["pool_blocks_in_use"].items()]
+        lines.append(_fmt_table(rows))
+    if "speculation" in s:
+        sp = s["speculation"]
+        lines.append("\nspeculative decoding:")
+        rows = []
+        for eng, d in sp["per_engine"].items():
+            ar = "-" if d["accept_rate"] is None \
+                else f"{d['accept_rate']:.2%}"
+            oh = "-" if d["draft_overhead_share"] is None \
+                else f"{d['draft_overhead_share']:.2%}"
+            rows.append((f"{eng} (draft {d['draft']})",
+                         f"{d['rounds']} rounds, accept {ar}, "
+                         f"{d['tokens_per_round']} tok/round, "
+                         f"draft overhead {oh}"))
+        for f in sp.get("fallbacks", []):
+            rows.append((f"{f['engine']} FALLBACK",
+                         f"draft {f['draft']} lost: {f['reason']}"))
         lines.append(_fmt_table(rows))
     if "faults" in s:
         lines.append("\ninjected faults: " + ", ".join(s["faults"]))

@@ -59,3 +59,18 @@ def dump_bundle(outdir, tail, gauge_leaf):
 
 def record_event(ring, rec, value):
     ring.append(float(np.asarray(value)))  # BAD
+
+
+# ISSUE 15: the speculative verify/rollback/mirror paths run between
+# every draft-verify round — a stealth sync there stalls the whole
+# batch once per round
+def verify_round(nxt, finite):
+    return np.asarray(nxt), finite.item()  # BAD
+
+
+def rollback_slot(table, pos_leaf):
+    return int(pos_leaf.item())  # BAD
+
+
+def mirror_slot(draft_pool, pkg):
+    return jax.device_get(draft_pool)  # BAD

@@ -81,3 +81,28 @@ def dump_bundle(write_fn, tail, health_sources):
     # bundle content = host dicts only (events, health snapshots)
     write_fn("events.jsonl", list(tail))
     write_fn("health.json", {k: fn() for k, fn in health_sources})
+
+
+# ISSUE 15 speculative paths: acceptance/rollback consume the round's
+# ONE already-fetched verify result; the dispatch carries the fence
+def verify_dispatch(step_fn, operands):
+    nxt = step_fn(*operands)
+    # THE one deliberate per-round target fetch, justified + suppressed:
+    return np.asarray(nxt)  # graftlint: disable=hidden-device-sync
+
+
+def accept_and_rollback(host_samples, host_proposals, table_row):
+    # coupled acceptance + table truncation: plain host ints
+    matched = 0
+    for g, d in zip(host_samples, host_proposals):
+        if int(g) != int(d):
+            break
+        matched += 1
+    for j in range(matched + 1, len(table_row)):
+        table_row[j] = 0
+    return matched
+
+
+def mirror_slot(draft, slot, prompt):
+    # shadow seat = host bookkeeping + the draft's own prefill path
+    return draft.admit(slot, list(prompt))

@@ -127,6 +127,32 @@ def test_noise_widens_threshold_but_2x_still_flags(bc):
     assert not v2["ok"]
 
 
+def test_lmdecode_spec_row_parses_and_gates(bc):
+    """ISSUE 15: the sentinel picks the new speculative-decoding row
+    up — a bench line shaped like bench_lm_decode_spec's output parses
+    into a metric row (extra provenance fields preserved), a
+    within-tolerance wobble passes, and a 2x goodput collapse (e.g. a
+    broken draft pinning accept_rate to 0) flags exactly that row."""
+    spec_metric = ("transformer_lm_43m_decode_spec_goodput"
+                   "_tokens_per_sec[cpu]")
+    line = json.dumps({
+        "metric": spec_metric, "value": 120.0, "unit": "tokens/sec",
+        "vs_baseline": None, "target_only_tokens_per_sec": 60.0,
+        "speedup_vs_target_only": 2.0, "k": 4, "accept_rate": 0.7,
+        "tokens_bit_identical_to_target_only": True})
+    rows = bc.rows_from_text("some warmup noise\n" + line + "\n")
+    assert spec_metric in rows
+    assert rows[spec_metric]["accept_rate"] == 0.7
+    hist = [("r1", rows)]
+    wobble = {spec_metric: {"metric": spec_metric, "value": 100.0}}
+    assert bc.compare(hist, wobble)["ok"]      # -17% < the 25% floor
+    collapsed = {spec_metric: {"metric": spec_metric, "value": 60.0}}
+    verdict = bc.compare(hist, collapsed)
+    assert not verdict["ok"]
+    assert [r["metric"] for r in verdict["regressions"]] \
+        == [spec_metric]
+
+
 # ----------------------------------------------------------------- CLI
 
 def test_cli_fresh_latest_exits_zero(bc, capsys):

@@ -1,0 +1,507 @@
+"""Speculative decoding (ISSUE 15): the exactness claims.
+
+The load-bearing bar: a SpeculativeEngine's emitted tokens are the
+TARGET-ONLY token stream verbatim — greedy AND seeded sampling —
+whatever the draft proposes, because acceptance compares the draft's
+proposal against the target's own coupled sample (sample_logits is a
+pure function of (logits, fold_in(seed, n)), and a verify row's logits
+are bitwise the sequential Q=1 decode logits: positions ride the batch
+axis through the same per-row ops, full-table-extent attention
+included). Draft quality moves the accept rate, never a token.
+
+Also pinned here: the rollback/block-table truncation invariants (a
+rejected suffix is a length/table edit, never a scrub), the compile
+contract with the spec pair armed (#buckets per model + draft decode +
+ONE verify executable; zero new compiles on wave 2 and for a second
+pair over the same models), and the draft-loss fallback (quiesce +
+target-only continue, no request terminals from the draft)."""
+
+import jax
+import numpy as np
+import pytest
+
+from bigdl_tpu.models.transformer import build_lm
+from bigdl_tpu.serving import (InferenceEngine, Request,
+                               SpeculativeEngine)
+from bigdl_tpu.utils import faults
+
+
+_TARGET_LM = None
+_DRAFT_LM = None
+
+
+def _target_lm():
+    global _TARGET_LM
+    if _TARGET_LM is None:
+        _TARGET_LM = build_lm(vocab_size=50, dim=32, num_heads=2,
+                              num_layers=2, max_len=64)
+        _TARGET_LM.build(jax.random.PRNGKey(0))
+    return _TARGET_LM
+
+
+def _draft_lm():
+    global _DRAFT_LM
+    if _DRAFT_LM is None:
+        _DRAFT_LM = build_lm(vocab_size=50, dim=16, num_heads=2,
+                             num_layers=1, max_len=64)
+        _DRAFT_LM.build(jax.random.PRNGKey(1))
+    return _DRAFT_LM
+
+
+def _tgt(**kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("prefill_buckets", (8, 16))
+    return InferenceEngine(_target_lm(), **kw)
+
+
+def _drf(**kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("prefill_buckets", (8, 16))
+    return InferenceEngine(_draft_lm(), **kw)
+
+
+def _spec(k=3, draft_kw=None, target_kw=None):
+    return SpeculativeEngine(_drf(**(draft_kw or {})),
+                             _tgt(**(target_kw or {})), k=k)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    faults.set_plan(None)
+    yield
+    faults.set_plan(None)
+
+
+class TestGreedyIdentity:
+    def test_tokens_identical_across_both_buckets(self):
+        """Greedy spec tokens == target-only tokens for ragged
+        prompts spanning both prefill buckets, with slot eviction and
+        reuse in both engines."""
+        specs = [dict(prompt=[1, 2, 3], max_new_tokens=10, seed=1),
+                 dict(prompt=list(range(1, 12)), max_new_tokens=8,
+                      seed=2),                        # bucket 16
+                 dict(prompt=[7, 3], max_new_tokens=12, seed=3),
+                 dict(prompt=[9, 9, 2, 4, 1, 6, 2, 8, 3], seed=4,
+                      max_new_tokens=6),              # bucket 16
+                 dict(prompt=[5] * 5, max_new_tokens=9, seed=5)]
+        ref = _tgt().run([Request(**s) for s in specs])
+        got = _spec(k=3).run([Request(**s) for s in specs])
+        assert [r.tokens for r in got] == [r.tokens for r in ref]
+        assert [r.finish_reason for r in got] \
+            == [r.finish_reason for r in ref]
+        assert all(r.status == "done" for r in got)
+
+    def test_warm_and_cold_prefix_cache_identical(self):
+        """Spec decode through a WARM radix prefix cache (draft and
+        target mirrors both hit) emits the same tokens as the cold
+        spec run and as cold target-only — the PR-8 warm==cold bar
+        carried onto the speculative path."""
+        share = [5, 9, 3, 7, 2, 8, 4, 6]
+        A = dict(prompt=share + [11, 12], max_new_tokens=8, seed=7)
+        B = dict(prompt=share + [13, 14, 15], max_new_tokens=8, seed=8)
+        ref = _tgt().run([Request(**A), Request(**B)])
+        eng = _spec(k=3, draft_kw=dict(block_size=4, max_len=32),
+                    target_kw=dict(block_size=4, max_len=32))
+        cold = eng.run([Request(**A)])[0]          # seeds both trees
+        warm = eng.run([Request(**A), Request(**B)])
+        assert cold.tokens == ref[0].tokens
+        assert [r.tokens for r in warm] == [r.tokens for r in ref]
+        # the mirrors really did reuse the draft-side prefix too
+        assert eng.draft_engine.stats["prefix_hits"] >= 1
+        assert eng.target_engine.stats["prefix_hits"] >= 1
+
+    def test_full_accept_bonus_and_lag_path(self):
+        """A same-model draft accepts every proposal: rounds emit k+1
+        tokens (bonus included), the draft trails by one position and
+        catches up next round — tokens still identical and accept
+        rate exactly 1."""
+        specs = [dict(prompt=[1, 2, 3], max_new_tokens=12, seed=1),
+                 dict(prompt=[4, 5, 6, 7], max_new_tokens=11, seed=2)]
+        ref = _tgt().run([Request(**s) for s in specs])
+        eng = SpeculativeEngine(_tgt(), _tgt(), k=3)
+        got = eng.run([Request(**s) for s in specs])
+        assert [r.tokens for r in got] == [r.tokens for r in ref]
+        h = eng.health()["speculative"]
+        assert h["accept_rate"] == 1.0
+        assert h["tokens_per_round"] > 3.0     # k+1 amortization real
+
+    def test_k1_keeps_full_horizon_after_bonus(self):
+        """Regression (review): a fully-accepted round leaves the
+        draft lagging one position, but the catch-up step must not
+        shrink the next round's proposal horizon — at k=1 a `k - lag`
+        cap would stall speculation permanently after the first
+        bonus."""
+        kw = dict(prompt=[1, 2, 3], max_new_tokens=10, seed=1)
+        ref = _tgt(slots=1).run([Request(**kw)])[0]
+        eng = SpeculativeEngine(_tgt(slots=1), _tgt(slots=1), k=1)
+        got = eng.run([Request(**kw)])[0]
+        assert got.tokens == ref.tokens
+        h = eng.health()["speculative"]
+        assert h["accept_rate"] == 1.0
+        assert h["tokens_per_round"] == 2.0   # every round k+1 tokens
+
+    def test_emitted_counts_only_tokens_that_left(self):
+        """Regression (review): a stop_id landing on the round's first
+        sample discards the whole accepted chain — `emitted` must
+        count what actually left the engine, not the verify rows."""
+        kw = dict(prompt=[1, 2, 3], max_new_tokens=10, seed=9)
+        free = _tgt().run([Request(**kw)])[0]
+        stop = free.tokens[0]                 # stops before any emit
+        eng = SpeculativeEngine(_tgt(), _tgt(), k=3)
+        got = eng.run([Request(**kw, stop_ids=(stop,))])[0]
+        assert got.tokens == [] and got.finish_reason == "stop_id"
+        h = eng.health()["speculative"]
+        assert h["emitted"] == 0, h
+
+    def test_stop_id_mid_chain(self):
+        """A stop id landing inside an accepted chain truncates
+        exactly where target-only stops (the stop token unemitted,
+        later accepted tokens discarded)."""
+        kw = dict(prompt=[1, 2, 3], max_new_tokens=10, seed=9)
+        free = _tgt().run([Request(**kw)])[0]
+        stop = free.tokens[4]
+        cut = free.tokens.index(stop)
+        ref = _tgt().run([Request(**kw, stop_ids=(stop,))])[0]
+        got = SpeculativeEngine(_tgt(), _tgt(), k=3).run(
+            [Request(**kw, stop_ids=(stop,))])[0]
+        assert ref.finish_reason == "stop_id"
+        assert got.finish_reason == "stop_id"
+        assert got.tokens == ref.tokens == free.tokens[:cut]
+
+
+class TestSamplingExactness:
+    def test_seeded_streams_identical(self):
+        """Seeded sampling: spec emits bitwise the target-only sampled
+        stream for every seed — the coupled-acceptance construction
+        makes the output the target sampler's verbatim, which is
+        strictly stronger than distribution-exactness (identical per
+        seed ⇒ identical in law)."""
+        eng_ref = _tgt()
+        eng_spec = _spec(k=3)
+        for seed in range(10):
+            kw = dict(prompt=[2 + seed % 5, 7, 1], max_new_tokens=8,
+                      temperature=1.0, seed=seed)
+            ref = eng_ref.run([Request(**kw)])[0]
+            got = eng_spec.run([Request(**kw)])[0]
+            assert got.tokens == ref.tokens, seed
+
+    def test_filtered_sampling_identical(self):
+        """top-k / top-p filters ride the verify rows as per-row
+        operands exactly like the decode step's."""
+        specs = [dict(prompt=[3, 1, 4], max_new_tokens=9,
+                      temperature=0.8, top_k=7, seed=21),
+                 dict(prompt=[1, 5, 9, 2], max_new_tokens=9,
+                      temperature=1.2, top_p=0.85, seed=22),
+                 dict(prompt=[6, 2], max_new_tokens=9, temperature=0.6,
+                      top_k=12, top_p=0.7, seed=23)]
+        ref = _tgt().run([Request(**s) for s in specs])
+        got = _spec(k=2).run([Request(**s) for s in specs])
+        assert [r.tokens for r in got] == [r.tokens for r in ref]
+
+
+class TestRollback:
+    def test_table_never_extends_past_clock_between_rounds(self):
+        """The rollback invariant: after every speculative round, no
+        slot's block table extends beyond the block holding its next
+        write position, and the pool's accounting balances — a
+        rejected suffix is a table/length edit, not a leak."""
+        eng = _spec(k=3, draft_kw=dict(block_size=4, max_len=32),
+                    target_kw=dict(block_size=4, max_len=32))
+        t = eng.target_engine
+        for s in (dict(prompt=[1, 2, 3], max_new_tokens=10, seed=1),
+                  dict(prompt=[9, 8, 7, 6, 5], max_new_tokens=10,
+                       seed=2)):
+            eng.submit(Request(**s))
+        rounds = 0
+        while not eng.idle:
+            eng.step()
+            rounds += 1
+            assert rounds < 100
+            for i, req in enumerate(t._req):
+                if req is None:
+                    continue
+                bi = int(t._pos[i]) // t.block_size
+                assert all(t._table[i, j] == 0
+                           for j in range(bi + 1, t._table.shape[1])), \
+                    (i, bi, t._table[i])
+            st = t._pool_mgr.stats()
+            assert st["free"] + st["active"] + st["cached"] \
+                == st["total"]
+        # everything released at drain (prefix blocks may stay cached)
+        assert all(r is None for r in t._req)
+        assert t._pool_mgr.stats()["active"] == 0
+
+    def test_rollback_slot_frees_lookahead_blocks(self):
+        """Direct hook check: grow a slot's table past its clock, then
+        rollback_slot detaches exactly the beyond-clock blocks and
+        returns them to the pool."""
+        eng = _tgt(block_size=4, max_len=32)
+        eng.submit(Request(prompt=[1, 2, 3], max_new_tokens=4))
+        eng._admit()
+        free0 = eng._pool_mgr.free_count
+        assert not eng._ensure_blocks(horizons=[9, 0])   # pos 2 + 9
+        grown = [int(b) for b in eng._table[0] if b]
+        assert len(grown) >= 3                 # blocks 0..2 covered
+        freed = eng.rollback_slot(0)
+        assert freed == len(grown) - 1         # only the clock's stays
+        # net vs post-admission: the lookahead block went back AND the
+        # beyond-clock prefill pad block was detached too
+        assert eng._pool_mgr.free_count == free0 + 1
+        assert [int(b) for b in eng._table[0] if b] == grown[:1]
+        # the engine still decodes to the same tokens as untouched
+        ref = _tgt().run([Request(prompt=[1, 2, 3], max_new_tokens=4)])
+        out = eng.run()
+        assert out[0].tokens == ref[0].tokens
+
+
+class TestCompileContract:
+    def test_spec_pair_compiles_bounded_then_nothing(self):
+        """Wave 1 over a fresh spec pair compiles exactly: one prefill
+        per (model, bucket) used + the draft decode executable + the
+        ONE verify executable. Wave 2 — new requests, mid-stream
+        arrivals, slot reuse — compiles NOTHING; a second engine pair
+        over the same models compiles NOTHING."""
+        from bigdl_tpu.serving.engine import _TRACES
+
+        d_lm = build_lm(vocab_size=50, dim=16, num_heads=2,
+                        num_layers=1, max_len=64)
+        d_lm.build(jax.random.PRNGKey(3))
+        t_lm = build_lm(vocab_size=50, dim=32, num_heads=2,
+                        num_layers=2, max_len=64)
+        t_lm.build(jax.random.PRNGKey(4))
+
+        def pair():
+            return SpeculativeEngine(
+                InferenceEngine(d_lm, slots=2, prefill_buckets=(8, 16)),
+                InferenceEngine(t_lm, slots=2, prefill_buckets=(8, 16)),
+                k=3)
+
+        eng = pair()
+        t0 = dict(_TRACES)
+        rng = np.random.RandomState(0)
+        wave = [Request(prompt=list(rng.randint(1, 50, n)),
+                        max_new_tokens=int(rng.randint(3, 8)),
+                        temperature=float(n % 2) * 0.8, seed=int(n))
+                for n in (3, 10, 6, 12)]
+        eng.run(wave)
+        # both buckets on both models; draft B=2 decode + verify B=8
+        assert _TRACES["prefill"] - t0["prefill"] == 4
+        assert _TRACES["decode"] - t0["decode"] == 2
+        t1 = dict(_TRACES)
+        wave2 = [Request(prompt=list(rng.randint(1, 50, n)),
+                         max_new_tokens=3, seed=int(n))
+                 for n in (5, 11, 7)]
+        eng.run(wave2)
+        assert dict(_TRACES) == t1, "wave 2 must compile nothing"
+        pair().run([Request(prompt=[1, 2, 3], max_new_tokens=4)])
+        assert dict(_TRACES) == t1, \
+            "a second pair over the same models must compile nothing"
+
+
+class TestFallbackAndFaults:
+    def test_draft_watchdog_trip_falls_back_bit_identical(self):
+        """serve_slow against the draft's armed watchdog quiesces the
+        draft (engine_degraded, NO request terminals from it) and the
+        wrapper finishes every request target-only with tokens
+        bit-identical to an undisturbed target-only run."""
+        specs = [dict(prompt=[i + 1, i + 2, i + 3], max_new_tokens=6,
+                      temperature=0.8, seed=30 + i) for i in range(4)]
+        ref = _tgt().run([Request(**s) for s in specs])
+        eng = _spec(k=3, draft_kw=dict(step_timeout_s=0.05))
+        faults.set_plan(faults.FaultPlan("serve_slow@2"))
+        try:
+            got = eng.run([Request(**s) for s in specs])
+        finally:
+            faults.set_plan(None)
+        assert eng.fallback is not None
+        assert "watchdog" in eng.fallback
+        assert eng.draft_engine.degraded is not None
+        assert eng.draft_engine.stats["watchdog_trips"] == 1
+        # zero lost, zero failed — the fallback is invisible
+        assert all(r.status == "done" for r in got)
+        assert [r.tokens for r in got] == [r.tokens for r in ref]
+        assert eng.stats["fallbacks"] == 1
+        # quiesce never emitted terminals for the shadow mirrors
+        assert eng.draft_engine.stats["failed"] == 0
+        assert eng.draft_engine.completed == {}
+
+    def test_draft_pool_exhaustion_falls_back_without_terminals(self):
+        """Regression (review): draft pool pressure during lookahead
+        growth must fall back — never finish a shadow mirror
+        'pool_exhausted' (that would emit a request_terminal from the
+        draft for a request still living in the target, and a second
+        terminal later from the target)."""
+        from bigdl_tpu import obs
+
+        specs = [dict(prompt=[1, 2, 3], max_new_tokens=32, seed=1),
+                 dict(prompt=[4, 5, 6], max_new_tokens=32, seed=2)]
+        ref = _tgt().run([Request(**s) for s in specs])
+        # 4 usable draft blocks: 2 admissions + 2 first crossings fit,
+        # the position-32 crossing exhausts the pool mid-burst
+        draft = _drf(pool_blocks=5)
+        eng = SpeculativeEngine(draft, _tgt(), k=3)
+        log = obs.set_event_log(obs.EventLog())
+        try:
+            got = eng.run([Request(**s) for s in specs])
+            draft_terms = [e for e in log.events("request_terminal")
+                           if e["engine"] == draft.obs_name]
+        finally:
+            obs.set_event_log(None)
+        assert eng.fallback is not None and "pool" in eng.fallback
+        assert all(r.status == "done" for r in got)
+        assert [r.tokens for r in got] == [r.tokens for r in ref]
+        assert draft_terms == []               # zero phantom terminals
+        assert draft.stats["requests_done"] == 0
+        assert draft.completed == {}
+
+    def test_poison_isolation_under_speculation(self):
+        """A serve_nan row during verify evicts only its own request
+        (status poisoned); the co-batched request's tokens stay
+        bit-identical to running alone."""
+        A = dict(prompt=[1, 2, 3], max_new_tokens=6, temperature=0.8,
+                 seed=5)
+        B = dict(prompt=[4, 5, 6, 7], max_new_tokens=6,
+                 temperature=0.9, seed=9)
+        alone_b = _tgt().run([Request(**B)])[0]
+        eng = _spec(k=2)
+        faults.set_plan(faults.FaultPlan("serve_nan@1"))
+        try:
+            got_a, got_b = eng.run([Request(**A), Request(**B)])
+        finally:
+            faults.set_plan(None)
+        assert got_a.status == "poisoned"
+        assert got_b.status == "done"
+        assert got_b.tokens == alone_b.tokens
+
+    def test_draft_absorbs_inline_faults_first(self):
+        """The draft chain consults the fault plan before the verify
+        each round, so an inline serve_err lands on the draft: the
+        wrapper falls back (no retry burn, no outage) and the request
+        still finishes done, target-only."""
+        from bigdl_tpu.serving import EngineDegraded
+
+        eng = _spec(k=2)
+        faults.set_plan(faults.FaultPlan("serve_err@1"))
+        try:
+            got = eng.run([Request(prompt=[1, 2, 3],
+                                   max_new_tokens=8, seed=1)])
+        finally:
+            faults.set_plan(None)
+        assert got[0].status == "done"
+        assert eng.fallback is not None and "failed" in eng.fallback
+        with pytest.raises(EngineDegraded):
+            eng.draft_engine.submit(Request(prompt=[1]))
+
+    def test_verify_failure_degrades_target(self):
+        """A failure in the VERIFY dispatch is an outage, not a
+        fallback: with no retry budget the target degrades and the
+        request fails keeping its partial tokens — the router's
+        failover contract then applies above. Armed mid-run so the
+        fault stepno is one the draft's (always-leading) counter has
+        already passed."""
+        eng = _spec(k=2)
+        rid = eng.submit(Request(prompt=[1, 2, 3], max_new_tokens=12,
+                                 seed=1))
+        first = eng.step()                     # round 1, clean
+        assert not first
+        t = eng.target_engine
+        faults.set_plan(faults.FaultPlan(
+            f"serve_err@{t.stats['decode_steps']}"))
+        try:
+            out = []
+            while not eng.idle and t.degraded is None:
+                out.extend(eng.step())
+        finally:
+            faults.set_plan(None)
+        assert eng.degraded is not None
+        assert eng.fallback is None            # the draft was healthy
+        res = next(r for r in out if r.id == rid)
+        assert res.status == "failed"
+        assert len(res.tokens) >= 1            # round-1 tokens kept
+        # the draft mirrors were released, with no terminal events
+        assert eng.draft_engine.completed == {}
+        assert all(r is None for r in eng.draft_engine._req)
+
+
+class TestCrossLayout:
+    def test_tp_target_unsharded_draft_identical(self):
+        """Fleet story (ISSUE 15/10): a tensor-parallel TARGET behind
+        an unsharded draft — the wrapper is layout-blind, and because
+        tp decode is bitwise tp=1 decode (tp_shard_gather), the spec
+        stream is still the unsharded target-only stream verbatim."""
+        if jax.device_count() < 2:
+            pytest.skip("needs >= 2 devices (tests/conftest.py arms "
+                        "the 8-device CPU mesh)")
+        from bigdl_tpu.parallel import make_mesh
+
+        mesh = make_mesh({"model": 2}, devices=jax.devices()[:2])
+        specs = [dict(prompt=[1, 2, 3], max_new_tokens=8, seed=1),
+                 dict(prompt=[4, 5, 6, 7], max_new_tokens=8,
+                      temperature=0.8, seed=2)]
+        ref = _tgt().run([Request(**s) for s in specs])
+        eng = SpeculativeEngine(
+            _drf(), _tgt(tp_mesh=mesh), k=3)
+        got = eng.run([Request(**s) for s in specs])
+        assert [r.tokens for r in got] == [r.tokens for r in ref]
+        assert eng.tp == 2 and eng.draft_engine.tp == 1
+
+
+class TestSurfaceAndGuards:
+    def test_constructor_guards(self):
+        with pytest.raises(ValueError, match="k must be"):
+            SpeculativeEngine(_drf(), _tgt(), k=0)
+        with pytest.raises(ValueError, match="distinct"):
+            t = _tgt()
+            SpeculativeEngine(t, t)
+        with pytest.raises(ValueError, match="slots"):
+            SpeculativeEngine(_drf(slots=3), _tgt(slots=2))
+        with pytest.raises(ValueError, match="buckets"):
+            SpeculativeEngine(_drf(prefill_buckets=(8,)), _tgt())
+        big = build_lm(vocab_size=60, dim=16, num_heads=2,
+                       num_layers=1, max_len=64)
+        big.build(jax.random.PRNGKey(9))
+        with pytest.raises(ValueError, match="vocab"):
+            SpeculativeEngine(
+                InferenceEngine(big, slots=2, prefill_buckets=(8, 16)),
+                _tgt())
+
+    def test_health_and_counters(self):
+        from bigdl_tpu import obs
+
+        obs.set_registry(obs.MetricsRegistry())
+        try:
+            eng = _spec(k=3)
+            eng.run([Request(prompt=[1, 2, 3], max_new_tokens=8,
+                             seed=1)])
+            h = eng.health()
+            sp = h["speculative"]
+            assert sp["k"] == 3 and sp["fallback"] is None
+            assert sp["rounds"] >= 1
+            assert sp["proposed"] == sp["accepted"] + sp["wasted"]
+            assert sp["emitted"] == 8
+            assert sp["accept_rate"] is not None
+            assert sp["draft"]["state"] == "ok"
+            snap = obs.get_registry().snapshot()["metrics"]
+            acc = snap["serving_spec_accepted_tokens_total"]["series"]
+            was = snap["serving_spec_wasted_draft_total"]["series"]
+            assert sum(s["value"] for s in acc) == sp["accepted"]
+            assert sum(s["value"] for s in was) == sp["wasted"]
+        finally:
+            obs.set_registry(None)
+
+    def test_spec_events_registered_and_emitted(self):
+        from bigdl_tpu import obs
+        from bigdl_tpu.obs.events import EVENT_KINDS, validate_record
+
+        assert "spec_verify" in EVENT_KINDS
+        assert "spec_fallback" in EVENT_KINDS
+        log = obs.set_event_log(obs.EventLog())
+        try:
+            eng = _spec(k=2)
+            eng.run([Request(prompt=[1, 2, 3], max_new_tokens=6,
+                             seed=2)])
+            evs = log.events("spec_verify")
+            assert evs and all(not validate_record(e) for e in evs)
+            assert sum(e["emitted"] for e in evs) == 6
+        finally:
+            obs.set_event_log(None)
