@@ -1147,6 +1147,131 @@ def bench_lm_decode_prefix(on_tpu, context=None, new_tokens=None,
     }), flush=True)
 
 
+def bench_lm_decode_spill(on_tpu, context=None, new_tokens=None,
+                          slots=None, n_requests=None):
+    """Host-RAM spill-tier row (ISSUE 16): the prefix-reuse burst on a
+    43M engine whose DEVICE pool is deliberately undersized — exactly
+    one full-length sequence per slot, zero retention headroom — so
+    cached radix chains cannot stay device-resident. With the spill
+    tier armed, refcount-0 blocks park in pinned host arrays instead
+    of dying; a flush wave with a different shared prefix then pushes
+    the burst's chain fully to host, and the timed re-run of the
+    IDENTICAL burst re-admits the bytes (device_put + table patch, no
+    recompute). The row reports re-run goodput vs a cold-cache run of
+    the same trace, with tier occupancy + spill/re-admit counts as
+    provenance.
+
+    Acceptance, asserted in-row: re-run tokens bitwise == cold tokens
+    (spilled bytes are BYTES), spilled > 0 and readmitted > 0 (the
+    tier actually cycled), and the re-admission wave compiled NOTHING
+    (prefill/decode trace counts frozen across it)."""
+    import jax
+
+    from bigdl_tpu.models.transformer import TransformerConfig, TransformerLM
+    from bigdl_tpu.serving import InferenceEngine, Request
+
+    lg = _load_loadgen()
+
+    context = context or (512 if on_tpu else 256)
+    slots = slots or (8 if on_tpu else 4)
+    new_tokens = new_tokens or (16 if on_tpu else 8)
+    n_requests = n_requests or (64 if on_tpu else 32)
+    block_size = 16
+    tail = 26 if context >= 256 else max(context // 10, 4)
+    shared_len = context - tail
+    vocab, dim, layers, heads = 32000, 512, 8, 8
+    max_len = context + new_tokens + 8
+    max_len += (-max_len) % block_size
+    blocks_per_seq = max_len // block_size
+    pool_blocks = slots * blocks_per_seq + 1    # no retention headroom
+    host_blocks = 4 * pool_blocks               # tier absorbs the churn
+    buckets = (2 * block_size, context)
+    cfg = TransformerConfig(vocab_size=vocab, max_len=max_len, dim=dim,
+                            num_heads=heads, num_layers=layers)
+    model = TransformerLM(cfg)
+    variables = model.init(jax.random.PRNGKey(0))
+
+    def engine(prefix_cache, spill):
+        return InferenceEngine(model, variables, slots=slots,
+                               max_len=max_len,
+                               prefill_buckets=buckets,
+                               block_size=block_size,
+                               pool_blocks=pool_blocks,
+                               prefix_cache=prefix_cache,
+                               spill=spill,
+                               host_blocks=host_blocks if spill
+                               else None)
+
+    def burst(seed, n=None):
+        trace = lg.make_trace(
+            n or n_requests, seed=seed, arrival="bursty",
+            burst_size=n or n_requests, shared_prefix_len=shared_len,
+            shared_frac=1.0, prompt_len_choices=(tail,),
+            max_new_choices=(new_tokens,), temperature=0.0,
+            priorities=(0,), vocab=vocab)
+        return [Request(**a.spec) for a in trace["arrivals"]]
+
+    # compile both buckets + decode outside anything timed
+    warm_up = engine(True, True)
+    warm_up.run(burst(99)[:slots + 1])
+
+    eng = engine(True, True)
+    first = eng.run(burst(1))                # seeds + churns the tree
+    eng.run(burst(2, n=slots * 2))           # flush: new prefix evicts
+    traces0 = (eng.stats["prefill_traces"], eng.stats["decode_traces"])
+    spilled0 = eng.stats["kv_spill_blocks"]
+    reqs = burst(1)                          # the IDENTICAL trace
+    t0 = time.perf_counter()
+    rerun = eng.run(reqs)
+    warm_dt = time.perf_counter() - t0
+    warm_gps = sum(len(r.tokens) for r in rerun
+                   if r.status == "done") / warm_dt
+    assert (eng.stats["prefill_traces"],
+            eng.stats["decode_traces"]) == traces0, \
+        "re-admission compiled something"
+
+    cold_eng = engine(False, False)
+    t0 = time.perf_counter()
+    cold = cold_eng.run(burst(1))
+    cold_dt = time.perf_counter() - t0
+    cold_gps = sum(len(r.tokens) for r in cold
+                   if r.status == "done") / cold_dt
+    # spilled + re-admitted bytes are BYTES: the round trip is
+    # decode-invisible on the identical trace
+    assert [r.tokens for r in rerun] == [r.tokens for r in cold]
+    assert [r.tokens for r in first] == [r.tokens for r in cold]
+    s = eng.stats
+    tier = eng.health()["prefix"]
+    assert s["kv_spill_blocks"] > 0 and s["kv_readmit_blocks"] > 0, \
+        f"tier never cycled: {tier}"
+    platform = "tpu" if on_tpu else "cpu"
+    print(json.dumps({
+        "metric": f"transformer_lm_43m_decode_spill_goodput"
+                  f"_tokens_per_sec[{platform}]",
+        "value": round(warm_gps, 2), "unit": "tokens/sec",
+        "vs_baseline": None,
+        "cold_cache_tokens_per_sec": round(cold_gps, 2),
+        "speedup_vs_cold": round(warm_gps / cold_gps, 2),
+        "requests": n_requests, "context": context,
+        "shared_prompt_frac": round(shared_len / context, 3),
+        "prefix_hit_rate": round(s["prefix_hits"]
+                                 / (2 * n_requests + slots * 2), 4),
+        "spilled_blocks": s["kv_spill_blocks"],
+        "spilled_blocks_pre_rerun": spilled0,
+        "readmitted_blocks": s["kv_readmit_blocks"],
+        "host_evictions": s["kv_host_evictions"],
+        "host_blocks": host_blocks,
+        "host_blocks_in_use": tier["host_in_use"],
+        "tokens_bit_identical_to_cold": True,
+        "block_size": block_size,
+        "pool_blocks": pool_blocks,
+        "cache_slots": slots, "cache_dtype": "fp32",
+        "prefill_compiles": s["prefill_traces"],
+        "decode_compiles": s["decode_traces"],
+        "telemetry": _obs_provenance("serving_"),
+    }), flush=True)
+
+
 def bench_lm_decode_fleet(on_tpu, context=None, new_tokens=None,
                           slots=None):
     """Fleet row (ISSUE 7): a 2-engine routed pool on the 43M LM
@@ -1561,7 +1686,8 @@ def main(argv=None) -> None:
                          "inception_v1,vgg16,lenet,int8,bilstm,treelstm,"
                          "lm43m,lm186m,lmtiny (cpu),lmdecode,"
                          "lmdecode_batched,lmdecode_prefix,"
-                         "lmdecode_fleet,lmdecode_tp,lmdecode_spec")
+                         "lmdecode_spill,lmdecode_fleet,lmdecode_tp,"
+                         "lmdecode_spec")
     args = ap.parse_args(argv)
 
     # bounded backend probe: the axon tunnel's init can block forever
@@ -1640,6 +1766,8 @@ def main(argv=None) -> None:
             bench_lm_decode_batched(on_tpu)
         if sel("lmdecode_prefix"):
             bench_lm_decode_prefix(on_tpu)
+        if sel("lmdecode_spill"):
+            bench_lm_decode_spill(on_tpu)
         if sel("lmdecode_fleet"):
             bench_lm_decode_fleet(on_tpu)
         if sel("lmdecode_tp"):
@@ -1663,6 +1791,10 @@ def main(argv=None) -> None:
         # column is a full 32-request 43M prefill wave), default on TPU
         if "lmdecode_prefix" in (want or ()):
             bench_lm_decode_prefix(on_tpu)
+        # spill-tier row: explicit-only on CPU (four 43M prefill waves
+        # — seed, flush, re-run, cold — on one core), default on TPU
+        if "lmdecode_spill" in (want or ()):
+            bench_lm_decode_spill(on_tpu)
         # fleet goodput row: explicit-only on CPU (two 43M engines'
         # prefill waves would double the default run), default on TPU
         if "lmdecode_fleet" in (want or ()):

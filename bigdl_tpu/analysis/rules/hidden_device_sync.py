@@ -47,6 +47,16 @@ acceptance loop, rollback (a pure table/length edit) and mirror
 seating run BETWEEN every verify round, so a stealth sync there
 stalls the whole batch once per round — same bar as the block-table
 surgery paths.
+
+ISSUE 16 widens the hot-name set to the host spill tier:
+spill/readmit/migrate functions (prefix_cache.py tree surgery, the
+engine's spill cascade and re-admission, the router's warm-state
+migration). Spill export carries ONE suppressed batched `device_get`
+(host parking is the point — the bytes must come down) and
+re-admission/tree import their deliberate eager `device_put`-side
+placement; everything else on those paths is host bookkeeping over
+block ids and numpy arrays, so any other fetch is a stealth sync per
+eviction or per admission.
 """
 
 from __future__ import annotations
@@ -65,7 +75,8 @@ _HOT_FN = re.compile(
     r"(decode|prefill|dispatch|step|sample|work|emit|observe"
     r"|lookup|insert|evict|alloc|handoff|place"
     r"|journey|record|dump|bundle|flight"
-    r"|verify|rollback|mirror|spec)")
+    r"|verify|rollback|mirror|spec"
+    r"|spill|readmit|migrate)")
 
 
 @register

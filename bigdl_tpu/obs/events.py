@@ -136,6 +136,19 @@ EVENT_KINDS: Dict[str, dict] = {
         "required": ("plane", "engine", "blocks"),
         "optional": (),
         "doc": "LRU prefix blocks evicted under pool pressure"},
+    "kv_spill": {
+        "required": ("plane", "engine", "blocks"),
+        "optional": ("host_in_use", "host_evicted", "tp"),
+        "doc": "refcount-0 device blocks spilled to the host-RAM tier "
+               "instead of dying (ISSUE 16): `blocks` moved in one "
+               "batched transfer; `host_evicted` = host-LRU nodes "
+               "pushed to oblivion to make room"},
+    "kv_readmit": {
+        "required": ("plane", "engine", "blocks"),
+        "optional": ("host_in_use", "tp"),
+        "doc": "host-tier blocks re-admitted to device pools on a "
+               "prefix hit (ISSUE 16) — a device_put + table patch, "
+               "bytes never recomputed"},
     "handoff_export": {
         "required": ("plane", "engine", "request", "prompt_len",
                      "blocks"),
@@ -201,6 +214,13 @@ EVENT_KINDS: Dict[str, dict] = {
         "optional": ("trace", "hop"),
         "journey": True,
         "doc": "router moved a prefilled package to a serving engine"},
+    "prefix_migrate": {
+        "required": ("plane", "router", "source", "target", "blocks"),
+        "optional": ("chains",),
+        "doc": "a degraded/draining engine's radix tree migrated into "
+               "a survivor's host tier (ISSUE 16): `blocks` grafted "
+               "out of `chains` exported nodes — warm hit-rate "
+               "survives failover"},
     "autoscale_decision": {
         "required": ("plane", "router", "action"),
         "optional": ("t", "p99_s", "engines", "target_p99_s",

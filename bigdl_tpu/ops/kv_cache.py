@@ -46,6 +46,21 @@ step wrote are NEVER shared — the serving engine caps reuse and tree
 insertion at `(len(prompt) - 1) // block_size` full blocks, keeping
 the re-decoded last prompt token (and everything generated) out of
 shared blocks.
+
+Host spill tier (ISSUE 16): the bit-identity contract is what makes a
+host-RAM block tier possible at all — a tree block's content is
+immutable after its prefill (COW discipline) and position-invariant in
+the reduction, so a refcount-0 block can be fetched to pinned host
+numpy (`jax.device_get` of the per-layer k/v block rows — the
+HandoffPackage wire format), its pool slot reused, and the bytes later
+`device_put`-scattered into ANY free block with only a block-table
+patch: the re-admitted read is the same array bitwise, never a
+recomputation. The tier lives entirely above this module
+(serving/prefix_cache.py parks/re-admits nodes, serving/engine.py
+prices the one batched fetch per spill event) — nothing here reads or
+writes host state, and the warm==cold pins extend verbatim across a
+spill/re-admit round trip (tests/test_kv_pool.py TestSpillTier + the
+serve_spill drill).
 """
 
 from __future__ import annotations

@@ -379,6 +379,20 @@ class InferenceEngine:
     disables radix reuse — every admission prefills cold; the bench's
     cold-baseline column).
 
+    Host-RAM spill tier (ISSUE 16; constructor args, never env):
+    `spill=True` turns pool-pressure eviction of refcount-0 prefix
+    blocks into a SPILL to pinned host numpy arrays (the
+    HandoffPackage per-layer {'k','v'} layout) — bytes, never
+    recomputation, so warm==cold bit-identity extends across a
+    spill/re-admit round trip; `host_blocks` caps the host tier
+    (default: the device pool's capacity), whose own LRU evicts to
+    oblivion. Re-admission on a prefix hit is a host→device placement
+    plus block-table patch — zero new executables. `admit_requeue_
+    budget` bounds how many times a failed admission may requeue
+    before the request finishes 'pool_exhausted' (the admission-spin
+    bugfix — a pool that never frees must not spin a request through
+    the queue forever).
+
     Sharding knobs (ISSUE 10; constructor args, never env):
     `tp_mesh` + `tp_axis` — serve through the serving/tp.py wrapper:
     weights and KV pool shard over the mesh (pool on the head axis),
@@ -394,6 +408,9 @@ class InferenceEngine:
                  block_size: int = 16,
                  pool_blocks: Optional[int] = None,
                  prefix_cache: bool = True,
+                 spill: bool = False,
+                 host_blocks: Optional[int] = None,
+                 admit_requeue_budget: int = 64,
                  max_queue: Optional[int] = None,
                  overload_policy: str = "reject",
                  step_timeout_s: Optional[float] = None,
@@ -475,10 +492,29 @@ class InferenceEngine:
                 "+ scratch)")
         self.pool_blocks = pool_blocks
         self.prefix_cache_enabled = bool(prefix_cache)
+        # host-RAM spill tier (ISSUE 16): constructor args, never env
+        if spill and not prefix_cache:
+            raise ValueError("spill=True without prefix_cache: the "
+                             "spill tier parks radix-tree blocks — "
+                             "there is nothing to spill with the tree "
+                             "disabled")
+        if host_blocks is not None and not spill:
+            raise ValueError("host_blocks without spill=True")
+        if host_blocks is not None and host_blocks < 1:
+            raise ValueError("host_blocks must be >= 1 (or None for "
+                             "device-pool-capacity parity)")
+        self.spill_enabled = bool(spill)
+        self.host_blocks = 0 if not spill else int(
+            host_blocks if host_blocks is not None else pool_blocks)
+        if admit_requeue_budget < 1:
+            raise ValueError("admit_requeue_budget must be >= 1")
+        self.admit_requeue_budget = admit_requeue_budget
+        self._admit_fails: Dict[int, int] = {}
         self.pool = model.init_block_pool(pool_blocks, block_size,
                                           cache_dtype)
         self._pool_mgr = BlockPool(pool_blocks, block_size)
-        self._prefix = RadixPrefixCache(self._pool_mgr)
+        self._prefix = RadixPrefixCache(self._pool_mgr,
+                                        host_blocks=self.host_blocks)
         # KV bytes one token occupies across all layers (the
         # bytes-saved counter's unit), from the pool leaves themselves
         # — model-agnostic
@@ -512,6 +548,8 @@ class InferenceEngine:
             "prefix_hits": 0, "prefix_blocks_reused": 0,
             "prefix_tokens_saved": 0, "prefix_bytes_saved": 0,
             "pool_evictions": 0,
+            "kv_spill_blocks": 0, "kv_readmit_blocks": 0,
+            "kv_host_evictions": 0, "admit_requeue_exhausted": 0,
             "handoffs_out": 0, "handoffs_in": 0,
         }
         # ---- telemetry plane (ISSUE 5): every _stats increment also
@@ -553,6 +591,14 @@ class InferenceEngine:
                                   "prefix hits",
             "pool_evictions": "LRU prefix blocks evicted under pool "
                               "pressure",
+            "kv_spill_blocks": "refcount-0 KV blocks spilled to the "
+                               "host-RAM tier",
+            "kv_readmit_blocks": "host-tier KV blocks re-admitted to "
+                                 "device on a prefix hit",
+            "kv_host_evictions": "host-tier KV blocks evicted to "
+                                 "oblivion under host pressure",
+            "admit_requeue_exhausted": "admissions abandoned after "
+                                       "exhausting the requeue budget",
             "handoffs_out": "prefilled requests exported for "
                             "disaggregated decode",
             "handoffs_in": "prefilled requests imported from a "
@@ -574,6 +620,17 @@ class InferenceEngine:
             "KV pool blocks held by live requests or cached prefixes",
             labelnames=("engine", "tp")).labels(
                 engine=self._obs_name, tp=self._obs_tp)
+        # per-tier occupancy (ISSUE 16): device = in-use pool blocks
+        # (live + cached), host = parked spill-tier blocks
+        self._m_tier_gauges = {
+            tier: reg.gauge(
+                "serving_kv_tier_blocks_in_use",
+                "KV blocks resident per tier (device pool in-use vs "
+                "host-RAM spill tier)",
+                labelnames=("engine", "tier", "tp")
+                ).labels(engine=self._obs_name, tier=tier,
+                         tp=self._obs_tp)
+            for tier in ("device", "host")}
         self._m_tp_gauge = reg.gauge(
             "serving_tp_shards",
             "tensor-parallel shard count serving this engine",
@@ -730,6 +787,12 @@ class InferenceEngine:
                 "evictions": s["pool_evictions"],
                 "tree_blocks": self._prefix.num_blocks,
                 "pool": self._pool_mgr.stats(),
+                "spill": self.spill_enabled,
+                "host_blocks": self.host_blocks,
+                "host_in_use": self._prefix.host_in_use,
+                "spilled": s["kv_spill_blocks"],
+                "readmitted": s["kv_readmit_blocks"],
+                "host_evictions": s["kv_host_evictions"],
             },
             "metrics": {
                 "engine": self._obs_name,
@@ -952,6 +1015,7 @@ class InferenceEngine:
         ttft, latency = self._lifecycle_times(req)
         self._observe_terminal(req, reason, status, 0, ttft, latency)
         self._meta.pop(req.id, None)
+        self._admit_fails.pop(req.id, None)
         self._bump(_STATUS_COUNTER[status])
         res = GenerationResult(req.id, list(req.prompt), [], reason,
                                status, ttft_s=ttft, latency_s=latency)
@@ -983,12 +1047,21 @@ class InferenceEngine:
         del self._queue[best_i]
         return req
 
-    def _alloc_blocks(self, n: int) -> Optional[List[int]]:
-        """Take `n` fresh blocks, LRU-evicting cached (refcount-0)
-        prefix blocks under pressure; None when even eviction cannot
-        free enough (every block pinned by live requests)."""
+    def _alloc_blocks(self, n: int,
+                      protect: frozenset = frozenset()
+                      ) -> Optional[List[int]]:
+        """Take `n` fresh blocks. Under pool pressure, refcount-0
+        prefix blocks SPILL to the host tier (ISSUE 16 — bytes kept,
+        re-admitted on a later hit), falling back to plain LRU
+        eviction when the tier is off or cannot take them; None when
+        nothing can free enough (every block pinned by live requests).
+        `protect` excludes the chain an in-flight re-admission holds
+        from both the spill and host-eviction scans."""
         evicted = 0
         while self._pool_mgr.free_count < n:
+            if self._spill_blocks(n - self._pool_mgr.free_count,
+                                  protect):
+                continue
             b = self._prefix.evict_one()
             if b is None:
                 break
@@ -999,10 +1072,96 @@ class InferenceEngine:
                            engine=self._obs_name, blocks=evicted)
         return self._pool_mgr.alloc(n)
 
+    def _spill_blocks(self, want: int,
+                      protect: frozenset = frozenset()) -> int:
+        """Spill up to `want` LRU refcount-0 prefix blocks to the
+        host tier (ISSUE 16): ONE batched device→host fetch for the
+        whole victim set (the _export_handoff idiom — priced like a
+        handoff, never per block per layer), then pure bookkeeping —
+        each victim's bytes park on its tree node and its device
+        block returns to the free list. A full host tier first evicts
+        its LRU childless nodes to oblivion; victims the tier still
+        cannot take are left for plain eviction. Returns the number
+        spilled."""
+        if not self.spill_enabled or want <= 0:
+            return 0
+        victims = self._prefix.spill_victims(want, protect)
+        host_evicted = 0
+        room = self.host_blocks - self._prefix.host_in_use
+        while victims and room < len(victims):
+            if not self._prefix.evict_host_one(protect):
+                break
+            host_evicted += 1
+            room += 1
+        victims = victims[:max(room, 0)]
+        if host_evicted:
+            self._bump("kv_host_evictions", host_evicted)
+        if not victims:
+            return 0
+        idx = jnp.asarray([v.block for v in victims], jnp.int32)
+        data = jax.device_get(tuple(                                 # graftlint: disable=hidden-device-sync — THE deliberate spill fetch (ISSUE 16): one batched device→host transfer per spill event covering every victim block across all layers, priced like a handoff export — never per block, never per layer, and only ever under pool pressure
+            {k: leaf[idx] for k, leaf in layer.items()}
+            for layer in self.pool))
+        for j, v in enumerate(victims):
+            self._prefix.park(v, tuple(
+                {k: layer[k][j] for k in layer} for layer in data))
+        self._bump("kv_spill_blocks", len(victims))
+        obs.emit_event("kv_spill", plane="serving",
+                       engine=self._obs_name, blocks=len(victims),
+                       host_in_use=self._prefix.host_in_use,
+                       host_evicted=host_evicted, tp=self.tp)
+        self._update_pool_gauge()
+        return len(victims)
+
+    def _readmit_chain(self, nodes) -> Optional[List[int]]:
+        """Commit a matched prefix chain (ISSUE 16): ref the
+        device-resident blocks (pinning them against spill/eviction),
+        re-admit the host-tier nodes — fresh device blocks plus ONE
+        stacked host→device placement (`.at[idx].set` on concrete
+        arrays runs eagerly: placement, not compute — zero new
+        executables, the compile-guard pins it) — and return the
+        chain's device block ids in order, each holding exactly one
+        ref for this request (re-admitted blocks: alloc's ref plus
+        mark_cached, mirroring a ref'd device hit). None when the
+        pool cannot cover re-admission; the chain unwinds to cached
+        parking and the caller requeues."""
+        dev = [n.block for n in nodes if n.block is not None]
+        self._pool_mgr.ref(dev)
+        host_nodes = [n for n in nodes if n.block is None]
+        if host_nodes:
+            new = self._alloc_blocks(len(host_nodes),
+                                     protect=frozenset(nodes))
+            if new is None:
+                self._pool_mgr.unref(dev)
+                return None
+            datas = [self._prefix.readmit(nd, b)
+                     for nd, b in zip(host_nodes, new)]
+            idx = jnp.asarray(new, jnp.int32)
+            self.pool = tuple(
+                {k: leaf.at[idx].set(jnp.asarray(np.stack(
+                    [d[li][k] for d in datas])))
+                 for k, leaf in layer.items()}
+                for li, layer in enumerate(self.pool))
+            if hasattr(self.model, "place_pools"):
+                # keep the tp head-axis placement through the eager
+                # scatter, like import_handoff does
+                self.pool = self.model.place_pools(self.pool)
+            for b in new:
+                self._pool_mgr.mark_cached(b)
+            self._bump("kv_readmit_blocks", len(new))
+            obs.emit_event("kv_readmit", plane="serving",
+                           engine=self._obs_name, blocks=len(new),
+                           host_in_use=self._prefix.host_in_use,
+                           tp=self.tp)
+            self._update_pool_gauge()
+        return [n.block for n in nodes]
+
     def _update_pool_gauge(self) -> None:
         if obs.enabled():
-            self._m_pool_gauge.set(self._pool_mgr.capacity
-                                   - self._pool_mgr.free_count)
+            in_use = self._pool_mgr.capacity - self._pool_mgr.free_count
+            self._m_pool_gauge.set(in_use)
+            self._m_tier_gauges["device"].set(in_use)
+            self._m_tier_gauges["host"].set(self._prefix.host_in_use)
             # re-asserted alongside the pool gauge (not only at
             # construction) so an engine built under BIGDL_OBS=off
             # reports its layout once telemetry is switched on, like
@@ -1012,16 +1171,28 @@ class InferenceEngine:
     def _admit(self):
         self._expire_queued(self._clock())
         for slot in self._free_slots():
-            if not self._queue:
-                return
-            req = self._pop_next()
-            if not self._admit_into(slot, req):
-                # pool pressure: every evictable prefix block is gone
-                # and the free list still cannot cover the suffix —
-                # park the request at the FRONT of the line (its
-                # precedence is preserved) and stop admitting; blocks
-                # free as in-flight requests finish
+            while self._queue:
+                req = self._pop_next()
+                if self._admit_into(slot, req):
+                    self._admit_fails.pop(req.id, None)
+                    break
+                # pool pressure: every evictable/spillable prefix
+                # block is gone and the free list still cannot cover
+                # the suffix. Requeue at the FRONT of the line (its
+                # precedence is preserved) — BOUNDED (ISSUE 16
+                # bugfix): a pool that never frees (nothing in
+                # flight to release blocks) would otherwise spin the
+                # request through the queue forever with no terminal
+                # and no counter
+                fails = self._admit_fails.pop(req.id, 0) + 1
+                if fails > self.admit_requeue_budget:
+                    self._bump("admit_requeue_exhausted")
+                    self._terminal(req, "pool_exhausted", "done")
+                    continue              # try the next queued request
+                self._admit_fails[req.id] = fails
                 self._queue.appendleft(req)
+                return
+            if not self._queue:
                 return
 
     def _point_table_row(self, slot: int, hit: List[int],
@@ -1076,26 +1247,31 @@ class InferenceEngine:
         prompt = list(req.prompt)
         n = len(prompt)
         bs = self.block_size
-        hit: List[int] = []
+        nodes: List[object] = []
         start = 0
         if self.prefix_cache_enabled:
             # COW cap: reuse at most the full blocks strictly before
-            # the re-decoded last prompt token (ops/kv_cache.py)
-            hit = self._prefix.lookup(prompt, (n - 1) // bs)
-            start = len(hit) * bs
+            # the re-decoded last prompt token (ops/kv_cache.py).
+            # Tier-aware (ISSUE 16): the matched chain may hold
+            # host-tier nodes, which _readmit_chain re-admits below
+            nodes = self._prefix.lookup_nodes(prompt, (n - 1) // bs)
+            start = len(nodes) * bs
             # feasibility trim: the suffix bucket must fit the table
-            while hit and start + bucket_for(n - start,
-                                             self.buckets) \
+            while nodes and start + bucket_for(n - start,
+                                               self.buckets) \
                     > self.cache_len:
-                hit.pop()
+                nodes.pop()
                 start -= bs
         suffix = prompt[start:]
         b = bucket_for(len(suffix), self.buckets)
         nb_new = -(-b // bs)                  # blocks the suffix covers
         # pin the hit chain BEFORE allocating: the allocator's LRU
-        # eviction must never reclaim the very blocks this admission
-        # just matched (a refcount-0 cached block is fair game to it)
-        self._pool_mgr.ref(hit)
+        # spill/eviction must never reclaim the very blocks this
+        # admission just matched (a refcount-0 cached block is fair
+        # game to it) — re-admitting any host-tier links on the way
+        hit = self._readmit_chain(nodes)
+        if hit is None:
+            return False
         new = self._alloc_blocks(nb_new)
         if new is None:
             self._pool_mgr.unref(hit)         # back to cached parking
@@ -1471,6 +1647,103 @@ class InferenceEngine:
         out, self._handoffs = self._handoffs, []
         return out
 
+    # ------------------------------------- fleet-scale KV plane (ISSUE 16)
+    def prefix_match_tokens(self, prompt: Sequence[int]) -> int:
+        """Router affinity probe: prompt tokens this engine's radix
+        tree already holds (EITHER tier, COW cap applied), WITHOUT
+        touching LRU stamps — probing every pool engine must not
+        perturb anyone's eviction order. Pure host bookkeeping."""
+        n = len(prompt)
+        if not self.prefix_cache_enabled or n == 0:
+            return 0
+        return self._prefix.peek_blocks(
+            prompt, (n - 1) // self.block_size) * self.block_size
+
+    def export_tree(self) -> List[Dict[str, object]]:
+        """Export this engine's radix tree as host-side entries for
+        warm-state migration (ISSUE 16): one entry per tree node —
+        the full prefix tokens from the root plus the block's bytes
+        in the HandoffPackage per-layer {'k','v'} layout (one
+        (H, block_size, D) row per array; fp32 reference layout).
+        Device-resident blocks are fetched in ONE batched transfer;
+        host-tier blocks are already bytes. Parents precede children,
+        so a survivor can import_tree() the list in order. Safe on a
+        degraded engine (the migration trigger) — tree content is
+        immutable once inserted; returns [] when the pool buffers
+        were consumed by a failed donated dispatch."""
+        entries = self._prefix.export_entries()
+        if not entries:
+            return []
+        dev = [(toks, node) for toks, node in entries
+               if node.block is not None]
+        if dev and self._cache_consumed():
+            # the device bytes died with the donated dispatch, but
+            # host-tier nodes are plain RAM: salvage the chains whose
+            # ENTIRE ancestry is host-resident (a child below a lost
+            # device block has no graftable parent)
+            ok: set = set()
+            keep = []
+            for toks, node in entries:     # preorder: parents first
+                if node.block is not None:
+                    continue
+                parent = node.parent
+                if parent.parent is not None and id(parent) not in ok:
+                    continue
+                ok.add(id(node))
+                keep.append((toks, node))
+            entries, dev = keep, []
+            if not entries:
+                return []
+        data = None
+        if dev:
+            idx = jnp.asarray([node.block for _, node in dev],
+                              jnp.int32)
+            data = jax.device_get(tuple(                             # graftlint: disable=hidden-device-sync — THE deliberate migration fetch (ISSUE 16): one batched device→host transfer per tree export covering every exported block across all layers (the handoff-export idiom) — runs once per engine degradation/drain, never on a serving hot path
+                {k: leaf[idx] for k, leaf in layer.items()}
+                for layer in self.pool))
+        pos = {id(node): j for j, (_, node) in enumerate(dev)}
+        out: List[Dict[str, object]] = []
+        for toks, node in entries:
+            if node.block is None:
+                kv = node.host
+            else:
+                j = pos[id(node)]
+                kv = tuple({k: layer[k][j] for k in layer}
+                           for layer in data)
+            out.append({"tokens": list(toks), "kv": kv})
+        return out
+
+    def import_tree(self, entries: Sequence[Dict[str, object]]
+                    ) -> int:
+        """Seed migrated chains into THIS engine's HOST tier
+        (ISSUE 16): pure placement into host RAM — zero device work,
+        zero compute, zero new executables; grafted blocks re-admit
+        on their first prefix hit like any spilled block. Requires
+        the spill tier (`spill=True`); incumbents win, host capacity
+        applies (LRU childless host nodes make room). Returns the
+        number of blocks grafted."""
+        if not self.spill_enabled or not entries:
+            return 0
+        ref = self.pool[0]["k"]
+        for e in entries:
+            kv = e["kv"]
+            if len(kv) != len(self.pool) \
+                    or tuple(kv[0]["k"].shape) != tuple(ref.shape[1:]) \
+                    or kv[0]["k"].dtype != ref.dtype:
+                raise ValueError(
+                    f"migrated tree entry layout {len(kv)} layers x "
+                    f"{tuple(kv[0]['k'].shape)} ({kv[0]['k'].dtype}) "
+                    f"does not match this engine's {len(self.pool)} "
+                    f"layers x {tuple(ref.shape[1:])} ({ref.dtype}) — "
+                    "migration requires a same-layout fleet")
+        grafted = 0
+        for e in sorted(entries, key=lambda e: len(e["tokens"])):
+            if self._prefix.graft_host(e["tokens"], e["kv"]):
+                grafted += 1
+        if grafted:
+            self._update_pool_gauge()
+        return grafted
+
     def import_handoff(self, pkg: HandoffPackage) -> bool:
         """Seat a prefilled package directly into a slot, skipping
         prefill: allocate exclusive blocks (LRU-evicting cached
@@ -1531,19 +1804,24 @@ class InferenceEngine:
             # retries and run()'s stuck-backlog guard names the cause
             return False
         bs = self.block_size
-        hit: List[int] = []
+        nodes: List[object] = []
         if self.prefix_cache_enabled:
             # same lookup + COW cap as _admit_into: blocks the
             # importer already caches for this prefix are REUSED, not
             # re-scattered — their content is bitwise the package's
             # content for the same tokens (warm == cold), and without
             # this the allocator would evict the cached chain to make
-            # room for its own duplicate under pool pressure
-            hit = self._prefix.lookup(prompt, (n - 1) // bs)
-        nh = len(hit)
+            # room for its own duplicate under pool pressure.
+            # Tier-aware (ISSUE 16): a spilled chain re-admits here
+            # exactly like at a direct admission
+            nodes = self._prefix.lookup_nodes(prompt, (n - 1) // bs)
+        nh = len(nodes)
         # pin the hit chain BEFORE allocating (the _admit_into rule:
-        # LRU eviction must never eat the chain this import matched)
-        self._pool_mgr.ref(hit)
+        # LRU spill/eviction must never eat the chain this import
+        # matched), re-admitting any host-tier links on the way
+        hit = self._readmit_chain(nodes)
+        if hit is None:
+            return False
         new = self._alloc_blocks(nb - nh)
         if new is None:
             self._pool_mgr.unref(hit)     # back to cached parking

@@ -38,6 +38,26 @@ is that layer for the serving plane:
   model object share jitted executables, so growing the pool compiles
   NOTHING new — the #buckets+1 contract holds fleet-wide
   (tests/test_router.py pins it).
+* **Prefix-affinity placement (ISSUE 16).** With `affinity=True`,
+  submit() probes each healthy engine's radix tree
+  (engine.prefix_match_tokens — a stamp-free peek over both KV
+  tiers) and ranks by longest match FIRST, load second, index third —
+  shared-prefix bursts and multi-turn sessions land where their
+  blocks live instead of scattering by load. Health gating overrides
+  affinity unconditionally: a degraded or draining engine is never a
+  candidate, however warm its tree. Failover resubmission uses the
+  same prompt-aware ranking, so a migrated tree (below) pulls the
+  rerouted requests to the survivor that received it.
+* **Warm-state migration (ISSUE 16).** The first time an engine is
+  seen degraded (or is drained), its parked radix tree EXPORTS in one
+  batched transfer (engine.export_tree — the handoff serialization)
+  and grafts into the least-loaded spill-enabled survivor's HOST
+  tier (engine.import_tree — pure host-RAM placement, zero device
+  work, zero new executables). Re-admission on the survivors' next
+  prefix hits turns engine death from a full re-prefill cliff into a
+  byte-preserving degradation — the fleet_affinity_failover drill
+  pins warm hit-rate > 0 on the survivors with tokens bit-identical
+  to an undisturbed run.
 
 Determinism contract: the router does no wall-clock reads (clock is
 injectable, default time.monotonic as the injection point), no device
@@ -135,7 +155,8 @@ class EngineRouter:
                  clock: Callable[[], float] = time.monotonic,
                  obs_label: Optional[str] = None,
                  prefill_engines: Sequence[InferenceEngine] = (),
-                 handoff_len: Optional[int] = None):
+                 handoff_len: Optional[int] = None,
+                 affinity: bool = False):
         if not engines:
             raise ValueError("EngineRouter needs at least one engine")
         for eng in prefill_engines:
@@ -156,6 +177,12 @@ class EngineRouter:
                                  and handoff_len is None) \
             else handoff_len
         self._handoff_backlog: List[object] = []
+        # prefix-affinity dispatch (ISSUE 16): constructor arg, never
+        # env; off by default — load-only ranking is the pre-16 pin
+        self.affinity = bool(affinity)
+        # engines whose tree already migrated (one shot per engine —
+        # id()-keyed: an engine object never re-enters a pool healthy)
+        self._migrated: set = set()
         self.engine_factory = engine_factory
         self._clock = clock
         self.completed: Dict[int, GenerationResult] = {}
@@ -173,6 +200,7 @@ class EngineRouter:
             "failover_lost": 0, "rejected": 0, "rebalanced": 0,
             "engines_added": 0, "engines_removed": 0,
             "prefill_dispatched": 0, "handoffs": 0,
+            "migrations": 0, "migrated_blocks": 0,
         }
         self._obs_name = obs_label or f"router{next(_ROUTER_IDS)}"
         reg = obs.get_registry()
@@ -196,6 +224,10 @@ class EngineRouter:
                                       "disaggregated prefill tier",
                 "handoffs": "prefilled packages seated on serving "
                             "engines",
+                "migrations": "degraded/draining engines whose radix "
+                              "tree migrated to a survivor",
+                "migrated_blocks": "KV blocks grafted into a "
+                                   "survivor's host tier",
             }.items()}
         self._m_pool = reg.gauge(
             "router_pool_size", "engines in the pool",
@@ -239,8 +271,21 @@ class EngineRouter:
                   if e.degraded is None and not e.draining]
         return [e for _, _, e in sorted(scored, key=lambda s: s[:2])]
 
-    def _ranked(self) -> List[InferenceEngine]:
-        return self._rank(self.engines)
+    def _ranked(self, prompt: Optional[Sequence[int]] = None
+                ) -> List[InferenceEngine]:
+        """Healthy serving engines in dispatch order. With affinity on
+        and a prompt in hand, longest radix match ranks FIRST (the
+        stamp-free peek spans both KV tiers), load second, index third
+        — health gating is applied before scoring, so a warm but
+        degraded/draining tree is never a candidate."""
+        if not (self.affinity and prompt is not None):
+            return self._rank(self.engines)
+        scored = [(-e.prefix_match_tokens(prompt),
+                   (e.slots_active + e.queue_depth) / max(e.slots, 1),
+                   i, e)
+                  for i, e in enumerate(self.engines)
+                  if e.degraded is None and not e.draining]
+        return [e for _, _, _, e in sorted(scored, key=lambda s: s[:3])]
 
     def _ranked_prefill(self) -> List[InferenceEngine]:
         """Healthy prefill-tier engines, least-loaded first (the same
@@ -299,7 +344,7 @@ class EngineRouter:
                         router=self._obs_name,
                         engine=eng.obs_name).inc()
                 return request.id
-        order = self._ranked()
+        order = self._ranked(request.prompt)
         if not order:
             raise NoHealthyEngine(
                 "no healthy engine in the pool (all degraded or "
@@ -364,8 +409,10 @@ class EngineRouter:
         re-decodes from its prompt there; fold_in(seed, n) sampling
         makes the regenerated tokens bit-identical to an undisturbed
         run. Deadline TTLs restart at resubmission (the original
-        submit time is kept for latency accounting only)."""
-        for eng in self._ranked():
+        submit time is kept for latency accounting only). Ranking is
+        prompt-aware under affinity, so a migrated tree pulls the
+        rerouted requests to the survivor holding their blocks."""
+        for eng in self._ranked(asg.request.prompt):
             if eng is asg.engine:
                 continue
             asg.request.hop += 1          # the reroute is a journey hop
@@ -387,6 +434,39 @@ class EngineRouter:
         self._bump("failover_lost")
         return False
 
+    # ----------------------------------------------------------- migration
+    def _migrate_tree(self, eng: InferenceEngine) -> None:
+        """Warm-state migration (ISSUE 16): the first time `eng` is
+        seen degraded (or is drained), export its parked radix tree in
+        one batched transfer and graft it into the least-loaded
+        spill-enabled survivor's HOST tier. Pure placement — zero
+        device work on the importer, zero new executables; the
+        survivor's next prefix hits re-admit the bytes. One shot per
+        engine object (id-keyed: an engine never re-enters a pool
+        healthy), and a no-op when the tree is empty, unexportable
+        (consumed device cache) or no survivor runs a spill tier."""
+        if id(eng) in self._migrated:
+            return
+        self._migrated.add(id(eng))
+        entries = eng.export_tree()
+        if not entries:
+            return
+        for target in self._ranked():
+            if target is eng or not getattr(target, "spill_enabled",
+                                            False):
+                continue
+            grafted = target.import_tree(entries)
+            if not grafted:
+                return
+            self._bump("migrations")
+            self._bump("migrated_blocks", grafted)
+            obs.emit_event(
+                "prefix_migrate", plane="serving",
+                router=self._obs_name, source=eng.obs_name,
+                target=target.obs_name, blocks=grafted,
+                chains=len(entries))
+            return
+
     def _harvest(self, eng: InferenceEngine,
                  out: Optional[List[GenerationResult]]) -> None:
         """Claim results the engine settled outside step() returns —
@@ -407,7 +487,13 @@ class EngineRouter:
         draining engines hand their line to the rest of the pool.
         Donors give up the requests they would serve LAST
         (engine.steal_queued); receivers take only what they can admit
-        on the next round, so a moved request never waits twice."""
+        on the next round, so a moved request never waits twice.
+
+        With affinity on (ISSUE 16), a donor keeps any queued request
+        its radix tree matches STRICTLY better than the receiver's —
+        load smoothing must not cold-start a prompt whose warm prefix
+        lives on the donor (the trip-time migration path covers the
+        donor actually dying)."""
         for ri, recv in sorted(
                 ((i, e) for i, e in enumerate(self.engines)
                  if e.degraded is None and not e.draining),
@@ -433,6 +519,17 @@ class EngineRouter:
                 moved = donor.steal_queued(min(room, excess_best))
                 if not moved:
                     break
+                if self.affinity:
+                    keep = []
+                    for req, t0 in moved:
+                        if (donor.prefix_match_tokens(req.prompt)
+                                > recv.prefix_match_tokens(req.prompt)):
+                            donor._requeue(req, t0)  # warm stays home
+                        else:
+                            keep.append((req, t0))
+                    if not keep:
+                        break
+                    moved = keep
                 n_ok, moved_ids = 0, []
                 for mi, (req, t0) in enumerate(moved):
                     req.hop += 1          # the move is a journey hop
@@ -492,6 +589,13 @@ class EngineRouter:
                 if self.handoff(pkg) is None]
         for eng in list(self.engines):
             results = [] if eng.degraded is not None else eng.step()
+            if eng.degraded is not None:
+                # a degradation happens INSIDE eng.step() — migrate
+                # the parked tree BEFORE settling this round's
+                # failures, so the failover resubmissions land on (and
+                # re-admit from) the survivor that received it rather
+                # than re-prefilling cold (incumbents win at graft)
+                self._migrate_tree(eng)
             # in-flight failures first (admitted earlier), then the
             # queued ones the degradation parked in eng.completed —
             # failover preserves original admission order
@@ -594,6 +698,10 @@ class EngineRouter:
         remove_engine()."""
         eng = self._resolve(engine)
         eng.drain()
+        # hand the warm tree to a survivor now — new traffic routes
+        # around this engine from this point on, so its blocks would
+        # otherwise age out unused
+        self._migrate_tree(eng)
         return eng
 
     def remove_engine(self, engine) -> InferenceEngine:

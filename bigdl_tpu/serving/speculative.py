@@ -266,6 +266,26 @@ class SpeculativeEngine:
     def take_handoffs(self):
         return self._t.take_handoffs()
 
+    # fleet-scale KV surface (ISSUE 16): the router's affinity probe
+    # and warm-state migration see the TARGET's tree — that's where
+    # the request-visible blocks live. The draft mirrors spill on
+    # their own engine's tier (construct the draft with spill=True);
+    # its tree never migrates: a survivor's draft re-prefills shadows
+    # from the prompt, and draft bits move only accept rate, never a
+    # token.
+    @property
+    def spill_enabled(self) -> bool:
+        return self._t.spill_enabled
+
+    def prefix_match_tokens(self, prompt: Sequence[int]) -> int:
+        return self._t.prefix_match_tokens(prompt)
+
+    def export_tree(self):
+        return self._t.export_tree()
+
+    def import_tree(self, entries) -> int:
+        return self._t.import_tree(entries)
+
     def cancel(self, request_id: int) -> GenerationResult:
         slot = next((i for i, r in enumerate(self._t._req)
                      if r is not None and r.id == request_id), None)

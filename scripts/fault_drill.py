@@ -1002,6 +1002,96 @@ def drill_serve_prefix(workdir):
                        "poison": log3.counts_by_kind()}}
 
 
+def drill_serve_spill(workdir):
+    """ISSUE 16: the host-RAM KV spill tier end to end, twice. A
+    spill-enabled block_size=4 engine with a deliberately tiny device
+    pool (8 usable blocks) caches a 13-token prompt's 3-block chain,
+    then a filler burst drives the pool past exhaustion — the chain
+    SPILLS to pinned host numpy (kv_spill events,
+    serving_kv_spill_blocks_total) instead of dying. Resubmitting the
+    prompt re-admits the bytes (kv_readmit, a device_put + table
+    patch, never recomputation) and decodes tokens bitwise == a
+    never-spilled warm run on a large pool == a cold run. Two
+    invocations are byte-identical in the leg digest (event counts,
+    tokens, tier occupancy)."""
+    from bigdl_tpu import obs
+    from bigdl_tpu.serving import InferenceEngine
+
+    def eng(**kw):
+        kw.setdefault("slots", 2)
+        kw.setdefault("prefill_buckets", (8, 16))
+        kw.setdefault("block_size", 4)
+        kw.setdefault("max_len", 32)
+        return InferenceEngine(_serve_lm(), **kw)
+
+    P = dict(prompt=[5, 9, 3, 7, 2, 8, 4, 6, 1, 3, 9, 2, 7],
+             max_new_tokens=5, temperature=0.8, seed=11)
+    fillers = [dict(prompt=[10 + i, 20 + i, 30 + i, 40 + i, 11 + i,
+                            21 + i, 31 + i, 41 + i, 2],
+                    max_new_tokens=3, seed=i) for i in range(4)]
+    cold = eng(prefix_cache=False).run([_req(**P)])[0]
+    # never-spilled warm oracle: a large pool rides out the fillers
+    # with P's chain resident on device the whole time
+    e_ns = eng()
+    e_ns.run([_req(**P)])
+    for f in fillers:
+        e_ns.run([_req(**f)])
+    never_spilled = e_ns.run([_req(**P)])[0]
+
+    def counter(name, metrics):
+        fam = metrics.get(name, {"series": []})
+        return sum(s["value"] for s in fam["series"])
+
+    def run():
+        with _telemetry() as log:
+            e = eng(slots=1, pool_blocks=9, spill=True, host_blocks=32)
+            e.run([_req(**P)])             # caches P's 3-block chain
+            for f in fillers:              # pool past exhaustion
+                e.run([_req(**f)])
+            rerun = e.run([_req(**P)])[0]  # repeat prompt: re-admit
+            h = e.health()["prefix"]
+            snap = obs.get_registry().snapshot()["metrics"]
+            digest = json.dumps({
+                "events": log.counts_by_kind(),
+                "tokens": rerun.tokens,
+                "tier": {k: h[k] for k in
+                         ("spilled", "readmitted", "host_evictions",
+                          "host_in_use")},
+            }, sort_keys=True)
+            evs = (log.events("kv_spill"), log.events("kv_readmit"),
+                   log.events("prefix_hit"))
+        return rerun, h, snap, digest, evs
+
+    rerun1, h1, snap1, d1, (spill_ev, readmit_ev, hit_ev) = run()
+    _, _, _, d2, _ = run()
+
+    bit_identical = (rerun1.tokens == never_spilled.tokens
+                     == cold.tokens)
+    ok = (bit_identical
+          and h1["spilled"] > 0 and h1["readmitted"] >= 3
+          and len(spill_ev) >= 1 and len(readmit_ev) >= 1
+          and sum(e["blocks"] for e in spill_ev) == h1["spilled"]
+          and sum(e["blocks"] for e in readmit_ev)
+          == h1["readmitted"]
+          # the repeat prompt HIT the spilled chain — full 3-block
+          # (12-token) match, served from bytes, not recomputation
+          and any(e["matched_tokens"] == 12 for e in hit_ev)
+          and counter("serving_kv_spill_blocks_total", snap1)
+          == h1["spilled"]
+          and counter("serving_kv_readmit_blocks_total", snap1)
+          == h1["readmitted"]
+          and d1 == d2)
+    return {"ok": bool(ok),
+            "spilled_readmitted_bit_identical":
+                rerun1.tokens == never_spilled.tokens,
+            "cold_bit_identical": rerun1.tokens == cold.tokens,
+            "spilled": h1["spilled"], "readmitted": h1["readmitted"],
+            "host_in_use": h1["host_in_use"],
+            "host_evictions": h1["host_evictions"],
+            "report_byte_identical": d1 == d2,
+            "events": json.loads(d1)["events"]}
+
+
 def drill_serve_spec(workdir):
     """ISSUE 15: speculative decoding loses its draft mid-burst,
     twice. A 6-request burst (greedy + seeded sampling) runs through a
@@ -1129,6 +1219,153 @@ def drill_fleet_failover(workdir):
             "failovers": router.stats["failover"],
             "degraded_engine": e0.degraded,
             "events": log.counts_by_kind()}
+
+
+def drill_fleet_affinity_failover(workdir):
+    """ISSUE 16: prefix-affinity routing + warm-state migration under
+    an engine loss, twice. A 2-engine spill-enabled fleet under a
+    virtual clock first settles ONE shared-prefix warmup request (it
+    lands on e0 by index tie-break), then takes a 6-request burst of
+    the same prefix with `affinity=True`: every burst request follows
+    the warm radix tree onto engine 0 — load ranking alone would have
+    split them. serve_slow trips e0's watchdog mid-burst — its parked
+    tree MIGRATES into e1's host tier (ONE prefix_migrate event,
+    router.stats migrations/migrated_blocks) BEFORE the failover
+    resubmissions settle, so the survivor serves the burst with warm
+    prefix hits sourced from the migrated bytes (e1 prefix_hits > 0
+    AND readmitted > 0 — re-admission, not re-prefill). Zero requests
+    lost, tokens bit-identical to an undisturbed run, and two
+    invocations are byte-identical in the leg digest AND in the
+    flight-recorder bundle bytes."""
+    from bigdl_tpu.obs.flightrecorder import FlightRecorder
+    from bigdl_tpu.serving import EngineRouter, InferenceEngine
+
+    shared = [7, 3, 9, 1, 4, 8, 2, 6]
+    specs = [dict(prompt=shared + [10 + i], max_new_tokens=4,
+                  temperature=(0.8 if i % 2 else 0.0), seed=30 + i)
+             for i in range(6)]
+
+    def eng(**kw):
+        kw.setdefault("slots", 2)
+        kw.setdefault("prefill_buckets", (8, 16))
+        kw.setdefault("block_size", 4)
+        kw.setdefault("max_len", 32)
+        kw.setdefault("spill", True)
+        kw.setdefault("host_blocks", 32)
+        return InferenceEngine(_serve_lm(), **kw)
+
+    ref = eng(spill=False, host_blocks=None).run(
+        [_req(**s) for s in specs])
+
+    def run(outdir):
+        clk = {"t": 0.0}
+
+        def c():
+            return clk["t"]
+
+        fm = None
+        try:
+            with _telemetry(clock=c) as log:
+                # 0.25 s budget like the journey leg: byte-identity
+                # runs must only trip on the injected 5x hang
+                e0 = eng(step_timeout_s=0.25, obs_label="a0", clock=c)
+                e1 = eng(obs_label="a1", clock=c)
+                router = EngineRouter([e0, e1], clock=c,
+                                      obs_label="ra", affinity=True)
+                rec = FlightRecorder(outdir, clock=c)
+                for name, e in (("a0", e0), ("a1", e1)):
+                    rec.register_health_source(name, e.health)
+                rec.install()
+                # warmup: settle one shared-prefix request BEFORE the
+                # burst so e0 alone is warm — affinity, not load,
+                # must then concentrate the burst there
+                got = {}
+                wid = router.submit(_req(prompt=shared + [9],
+                                         max_new_tokens=3,
+                                         temperature=0.0, seed=99))
+                rounds = 0
+                while wid not in got:
+                    rounds += 1
+                    if rounds > 100:
+                        raise RuntimeError("affinity warmup stalled")
+                    clk["t"] += 0.5
+                    for res in router.step():
+                        got[res.id] = res
+                # arm the trip two decode steps into the burst —
+                # relative to e0's counter so the warmup's (fixed,
+                # deterministic) step count never shifts it
+                fm = _plan(
+                    f"serve_slow@{e0.stats['decode_steps'] + 2}")
+                ids = [router.submit(_req(**s)) for s in specs]
+                while any(i not in got for i in ids):
+                    rounds += 1
+                    if rounds > 200:
+                        raise RuntimeError(
+                            "affinity drill stalled: "
+                            f"{sum(i in got for i in ids)}"
+                            f"/{len(ids)} settled")
+                    clk["t"] += 0.5
+                    for res in router.step():
+                        got[res.id] = res
+                rec.close()
+                h1 = e1.health()["prefix"]
+                digest = json.dumps({
+                    "events": log.counts_by_kind(),
+                    "statuses": [got[i].status for i in ids],
+                    "tokens": [got[i].tokens for i in ids],
+                    "router": router.stats,
+                    "survivor_tier": {k: h1[k] for k in
+                                      ("hits", "readmitted",
+                                       "host_in_use")},
+                }, sort_keys=True)
+                migrate_ev = log.events("prefix_migrate")
+                failed_ev = log.events("request_terminal",
+                                       status="failed")
+                done_ev = log.events("request_terminal", status="done")
+        finally:
+            if fm is not None:
+                fm.set_plan(None)
+        return (router, e0, e1, [got[i] for i in ids], digest,
+                (migrate_ev, failed_ev, done_ev),
+                _bundle_bytes(outdir))
+
+    router, e0, e1, got1, d1, (migrate_ev, failed_ev, done_ev), b1 \
+        = run(os.path.join(workdir, "run1"))
+    _, _, _, _, d2, _, b2 = run(os.path.join(workdir, "run2"))
+
+    bit_identical = [g.tokens for g in got1] == [r.tokens for r in ref]
+    h1 = e1.health()["prefix"]
+    ok = (e0.degraded is not None and "watchdog" in e0.degraded
+          and all(g.status == "done" for g in got1)
+          and bit_identical
+          # affinity held the burst on e0 until the trip: the whole
+          # session followed the warm tree, not the load ranking
+          and e0.stats["prefix_hits"] >= 1
+          and router.stats["failover"] >= 1
+          and router.stats["failover_lost"] == 0
+          and router.stats["migrations"] == 1
+          and router.stats["migrated_blocks"] >= 1
+          and len(migrate_ev) == 1
+          and migrate_ev[0]["source"] == "a0"
+          and migrate_ev[0]["target"] == "a1"
+          # warm hit-rate survived the failover: the survivor's hits
+          # re-admitted MIGRATED bytes (host tier), not re-prefill
+          and e1.stats["prefix_hits"] > 0
+          and h1["readmitted"] > 0
+          and len(done_ev) == 7          # 6-request burst + warmup
+          and d1 == d2
+          and bool(b1) and b1 == b2)
+    return {"ok": bool(ok),
+            "statuses": [g.status for g in got1],
+            "bit_identical_to_undisturbed": bit_identical,
+            "failovers": router.stats["failover"],
+            "migrations": router.stats["migrations"],
+            "migrated_blocks": router.stats["migrated_blocks"],
+            "survivor_prefix_hits": e1.stats["prefix_hits"],
+            "survivor_readmitted": h1["readmitted"],
+            "report_byte_identical": d1 == d2,
+            "bundles_byte_identical": bool(b1) and b1 == b2,
+            "events": json.loads(d1)["events"]}
 
 
 def drill_fleet_tp_failover(workdir):
@@ -1624,8 +1861,10 @@ SERVING_LEGS = {
     "serve_retry": drill_serve_retry,
     "serve_watchdog": drill_serve_watchdog,
     "serve_prefix": drill_serve_prefix,
+    "serve_spill": drill_serve_spill,
     "serve_spec": drill_serve_spec,
     "fleet_failover": drill_fleet_failover,
+    "fleet_affinity_failover": drill_fleet_affinity_failover,
     "fleet_drain": drill_fleet_drain,
     "fleet_autoscale": drill_fleet_autoscale,
     "fleet_tp_failover": drill_fleet_tp_failover,

@@ -253,12 +253,21 @@ def _report(results, makespan, router, rejected, autoscaler,
     # multi-turn runs show their reuse in the byte-identical report
     prompt_tokens = sum(len(r.prompt) for r in results.values())
     saved = blocks = hits = evictions = 0
+    spilled = readmitted = host_evict = host_in_use = 0
+    tiered = False
     for e in router.engines:
         s = e.stats
         hits += s.get("prefix_hits", 0)
         saved += s.get("prefix_tokens_saved", 0)
         blocks += s.get("prefix_blocks_reused", 0)
         evictions += s.get("pool_evictions", 0)
+        # host spill tier rollup (ISSUE 16) — host-side counters only
+        if getattr(e, "spill_enabled", False):
+            tiered = True
+            spilled += s.get("kv_spill_blocks", 0)
+            readmitted += s.get("kv_readmit_blocks", 0)
+            host_evict += s.get("kv_host_evictions", 0)
+            host_in_use += e.health()["prefix"].get("host_in_use", 0)
     report = {
         "requests": len(results) + rejected,
         "rejected": rejected,
@@ -286,6 +295,21 @@ def _report(results, makespan, router, rejected, autoscaler,
         "pool": {"engines_final": len(router.engines),
                  "router": router.stats},
     }
+    if tiered:
+        # kv-tier rollup (ISSUE 16): spill/re-admit traffic plus the
+        # fleet's migration tally — pure host-side stats, so the
+        # section rides the byte-identical acceptance; hit_rate is the
+        # request-level prefix hit rate AFTER any failover reshuffle
+        report["kv_tier"] = {
+            "spilled_blocks": spilled,
+            "readmitted_blocks": readmitted,
+            "host_evictions": host_evict,
+            "host_blocks_in_use": host_in_use,
+            "migrations": router.stats.get("migrations", 0),
+            "migrated_blocks": router.stats.get("migrated_blocks", 0),
+            "hit_rate": (round(hits / len(results), 4)
+                         if results else 0.0),
+        }
     if autoscaler is not None:
         report["autoscale"] = {
             "target_p99_s": autoscaler.target_p99_s,
@@ -304,7 +328,9 @@ def build_fleet(engines: int = 1, *, slots: int = 4,
                 autoscale: bool = False, target_p99_s: float = 8.0,
                 max_engines: int = 4, evaluate_every_s: float = 1.0,
                 tp: Optional[int] = None, tp_axis: str = "model",
-                spec_draft: bool = False, spec_k: int = 4):
+                spec_draft: bool = False, spec_k: int = 4,
+                host_blocks: Optional[int] = None,
+                affinity: bool = False):
     """Tiny-LM fleet for the CLI and the drills: a routed pool over
     ONE model object (engines share executables — #buckets+1 compiles
     total however large the pool grows), every clock the same virtual
@@ -321,7 +347,14 @@ def build_fleet(engines: int = 1, *, slots: int = 4,
     virtual clock, same pool-wide compile discipline (one draft model
     object), tokens bitwise the spec_draft=False tokens (coupled
     acceptance, serving/speculative.py); `spec_k` is the per-round
-    draft lookahead."""
+    draft lookahead.
+
+    `host_blocks` (ISSUE 16) arms every engine's host-RAM spill tier
+    (refcount-0 radix blocks park in pinned host arrays instead of
+    dying; prefix hits re-admit the bytes), and `affinity=True`
+    routes admissions to the engine whose radix tree already holds
+    the longest prompt prefix — both pure placement, so tokens and
+    the byte-identical acceptance are unchanged."""
     import jax
 
     from bigdl_tpu.models.transformer import build_lm
@@ -355,7 +388,9 @@ def build_fleet(engines: int = 1, *, slots: int = 4,
                               max_queue=max_queue,
                               overload_policy=overload_policy,
                               clock=lambda: clk["t"],
-                              tp_mesh=mesh, tp_axis=tp_axis)
+                              tp_mesh=mesh, tp_axis=tp_axis,
+                              spill=host_blocks is not None,
+                              host_blocks=host_blocks)
         if not spec_draft:
             return eng
         from bigdl_tpu.serving import SpeculativeEngine
@@ -368,7 +403,8 @@ def build_fleet(engines: int = 1, *, slots: int = 4,
 
     router = EngineRouter([factory() for _ in range(engines)],
                           engine_factory=factory,
-                          clock=lambda: clk["t"])
+                          clock=lambda: clk["t"],
+                          affinity=affinity)
     asc = Autoscaler(router, target_p99_s=target_p99_s,
                      max_engines=max_engines,
                      evaluate_every_s=evaluate_every_s) \
@@ -426,6 +462,23 @@ def main(argv=None) -> int:
                          "share); two runs stay byte-identical")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft lookahead per speculative round")
+    ap.add_argument("--host-blocks", type=int, default=None,
+                    help="arm the host-RAM KV spill tier with this "
+                         "many pinned host blocks per engine (ISSUE "
+                         "16): refcount-0 radix blocks park in host "
+                         "arrays instead of dying and prefix hits "
+                         "re-admit the bytes; the report gains a "
+                         "'kv_tier' section (spills, re-admits, "
+                         "migrations) and prefix-affinity routing "
+                         "turns on; two runs stay byte-identical")
+    ap.add_argument("--affinity", dest="affinity", default=None,
+                    action="store_true",
+                    help="route admissions to the engine whose radix "
+                         "tree holds the longest prompt prefix "
+                         "(health-gated; on by default with "
+                         "--sessions or --host-blocks)")
+    ap.add_argument("--no-affinity", dest="affinity",
+                    action="store_false")
     ap.add_argument("--autoscale", action="store_true")
     ap.add_argument("--target-p99", type=float, default=8.0)
     ap.add_argument("--max-engines", type=int, default=4)
@@ -480,6 +533,10 @@ def main(argv=None) -> int:
             buckets = buckets + (2 * max(buckets),)
         max_len = max(max_len, max(buckets) + 32)
         max_len += (-max_len) % args.block_size
+    # affinity defaults on for the workloads with reuse to protect:
+    # multi-turn sessions and spill-tier runs (ISSUE 16)
+    affinity = args.affinity if args.affinity is not None \
+        else bool(args.sessions or args.host_blocks is not None)
     router, asc, clk = build_fleet(
         args.engines, slots=args.slots, max_queue=args.max_queue,
         overload_policy=args.overload_policy,
@@ -487,7 +544,8 @@ def main(argv=None) -> int:
         block_size=args.block_size,
         autoscale=args.autoscale,
         target_p99_s=args.target_p99, max_engines=args.max_engines,
-        tp=args.tp, spec_draft=args.spec_draft, spec_k=args.spec_k)
+        tp=args.tp, spec_draft=args.spec_draft, spec_k=args.spec_k,
+        host_blocks=args.host_blocks, affinity=affinity)
     # SLO plane (ISSUE 14): a sampler ticking once per scheduling
     # round plus declarative objectives/alerts over the same virtual
     # clock — pure function of the trace, so the byte-identical

@@ -121,6 +121,9 @@ def summarize(events: List[dict]) -> Dict[str, object]:
     prefix = _prefix_section(events)
     if prefix:
         out["prefix"] = prefix
+    kv_tier = _kv_tier_section(events)
+    if kv_tier:
+        out["kv_tier"] = kv_tier
     spec = _speculation_section(events)
     if spec:
         out["speculation"] = spec
@@ -391,6 +394,53 @@ def _prefix_section(events: List[dict]) -> Optional[dict]:
     return out or None
 
 
+def _kv_tier_section(events: List[dict]) -> Optional[dict]:
+    """Host spill-tier digest (ISSUE 16): spill/re-admit block flow
+    from the kv_spill / kv_readmit events, warm-state migrations from
+    prefix_migrate (source -> target paths), per-tier occupancy from
+    the serving_kv_tier_blocks_in_use gauge of the last embedded
+    metrics snapshot, and the hit-source split — a prefix hit whose
+    chain had spilled re-admits from host (one kv_readmit event per
+    re-admitted hit), the rest serve straight from the device tree,
+    and everything else prefilled cold (miss)."""
+    spill_ev = [e for e in events if e.get("kind") == "kv_spill"]
+    readmit_ev = [e for e in events if e.get("kind") == "kv_readmit"]
+    migrate_ev = [e for e in events
+                  if e.get("kind") == "prefix_migrate"]
+    if not (spill_ev or readmit_ev or migrate_ev):
+        return None
+    out: dict = {
+        "spilled_blocks": sum(e.get("blocks", 0) for e in spill_ev),
+        "readmitted_blocks": sum(e.get("blocks", 0)
+                                 for e in readmit_ev),
+        "migrations": len(migrate_ev),
+        "migrated_blocks": sum(e.get("blocks", 0) for e in migrate_ev),
+    }
+    if migrate_ev:
+        out["migration_paths"] = [
+            {"source": e.get("source"), "target": e.get("target"),
+             "blocks": e.get("blocks"), "chains": e.get("chains")}
+            for e in migrate_ev]
+    term = [e for e in events if e.get("kind") == "request_terminal"]
+    hits = sum(1 for e in events if e.get("kind") == "prefix_hit")
+    if term:
+        out["hit_source"] = {
+            "host": len(readmit_ev),
+            "device": max(hits - len(readmit_ev), 0),
+            "miss": max(len(term) - hits, 0),
+        }
+    snaps = [e for e in events if e.get("kind") == "metrics_snapshot"]
+    if snaps:
+        occ = snaps[-1]["snapshot"].get("metrics", {}).get(
+            "serving_kv_tier_blocks_in_use")
+        if occ is not None:
+            out["tier_blocks_in_use"] = {
+                f'{s["labels"].get("engine", "?")}'
+                f'/{s["labels"].get("tier", "?")}': s["value"]
+                for s in occ["series"]}
+    return out
+
+
 def _speculation_section(events: List[dict]) -> Optional[dict]:
     """Speculative-decoding digest (ISSUE 15): per-engine accept rate
     and draft-overhead share from the `spec_verify` round events, plus
@@ -626,6 +676,26 @@ def render(events: List[dict], tail: int = 15) -> str:
         if "pool_blocks_in_use" in p:
             rows += [(f"pool in use [{eng}]", v)
                      for eng, v in p["pool_blocks_in_use"].items()]
+        lines.append(_fmt_table(rows))
+    if "kv_tier" in s:
+        kt = s["kv_tier"]
+        lines.append("\nkv tier (host spill):")
+        rows = [("spilled blocks", kt["spilled_blocks"]),
+                ("re-admitted blocks", kt["readmitted_blocks"]),
+                ("migrations", kt["migrations"]),
+                ("migrated blocks", kt["migrated_blocks"])]
+        if "hit_source" in kt:
+            hs = kt["hit_source"]
+            rows.append(("hit source",
+                         f"device {hs['device']} / host {hs['host']}"
+                         f" / miss {hs['miss']}"))
+        for path in kt.get("migration_paths", []):
+            rows.append((f"{path['source']} -> {path['target']}",
+                         f"{path['blocks']} blocks "
+                         f"({path['chains']} chains)"))
+        for key, v in sorted(kt.get("tier_blocks_in_use",
+                                    {}).items()):
+            rows.append((f"tier in use [{key}]", v))
         lines.append(_fmt_table(rows))
     if "speculation" in s:
         sp = s["speculation"]

@@ -167,6 +167,27 @@ def render_frame(events: List[dict]) -> str:
     else:
         lines.extend(_kv_rows([]))
 
+    # ---- kv tier (ISSUE 16) ----------------------------------------
+    kt = s.get("kv_tier")
+    if kt:
+        lines.append(_rule("kv tier"))
+        rows = [("spill / re-admit",
+                 f"{kt['spilled_blocks']} blocks out, "
+                 f"{kt['readmitted_blocks']} back")]
+        if "hit_source" in kt:
+            hs = kt["hit_source"]
+            rows.append(("hit source",
+                         f"device {hs['device']} / host {hs['host']}"
+                         f" / miss {hs['miss']}"))
+        for path in kt.get("migration_paths", []):
+            rows.append((f"{path['source']} -> {path['target']}",
+                         f"migrated {path['blocks']} blocks "
+                         f"({path['chains']} chains)"))
+        for key, v in sorted(kt.get("tier_blocks_in_use",
+                                    {}).items()):
+            rows.append((f"in use [{key}]", v))
+        lines.extend(_kv_rows(rows))
+
     # ---- incidents --------------------------------------------------
     lines.append(_rule("incidents"))
     inc = s.get("incidents")
@@ -210,6 +231,7 @@ def render_scrape_frame(health: dict, metrics_text: str) -> str:
     for ln in metrics_text.splitlines():
         if ln.startswith(("router_pool_size",
                           "serving_kv_pool_blocks_in_use",
+                          "serving_kv_tier_blocks_in_use",
                           "serving_tp_shards")):
             name, _, val = ln.rpartition(" ")
             rows.append((name, val))

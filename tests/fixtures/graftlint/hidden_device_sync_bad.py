@@ -74,3 +74,20 @@ def rollback_slot(table, pos_leaf):
 
 def mirror_slot(draft_pool, pkg):
     return jax.device_get(draft_pool)  # BAD
+
+
+# ISSUE 16: the host spill tier's spill/readmit/migrate paths run
+# between decode steps (eviction cascade, prefix re-admission, trip-
+# time tree migration) — only the export's ONE batched fetch may sync
+def spill_victims(pool, victims, stamps):
+    order = np.asarray(stamps)  # BAD
+    return [pool[v] for v in victims], order
+
+
+def readmit_chain(host_blocks, table, occupancy_leaf):
+    jax.device_get(occupancy_leaf)  # BAD
+    return table
+
+
+def migrate_tree(entries, survivor, depth_leaf):
+    return survivor.graft(entries, depth_leaf.item())  # BAD

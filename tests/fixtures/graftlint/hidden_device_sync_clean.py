@@ -106,3 +106,25 @@ def accept_and_rollback(host_samples, host_proposals, table_row):
 def mirror_slot(draft, slot, prompt):
     # shadow seat = host bookkeeping + the draft's own prefill path
     return draft.admit(slot, list(prompt))
+
+
+# ISSUE 16 host spill tier: the export's batched device_get IS the
+# spill (host parking needs the bytes down); everything else is host
+# bookkeeping over block ids and already-parked numpy arrays
+def spill_victims(pool, victims):
+    # THE one deliberate batched spill fetch, justified + suppressed:
+    return np.asarray(pool[victims])  # graftlint: disable=hidden-device-sync
+
+
+def readmit_chain(parked, table, slot, free_blocks):
+    # re-admission = block-table patch over host ints; the device_put
+    # side is placement, not a fetch
+    for j, blk in enumerate(free_blocks[:len(parked)]):
+        table[slot][j] = blk
+    return table
+
+
+def migrate_tree(entries, survivor):
+    # warm-state migration grafts already-parked host entries — pure
+    # tree surgery, no device round-trips
+    return sum(survivor.graft_host(e) for e in entries)

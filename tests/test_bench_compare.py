@@ -153,6 +153,34 @@ def test_lmdecode_spec_row_parses_and_gates(bc):
         == [spec_metric]
 
 
+def test_lmdecode_spill_row_parses_and_gates(bc):
+    """ISSUE 16: the sentinel picks the spill-tier row up — a bench
+    line shaped like bench_lm_decode_spill's output parses into a
+    metric row (tier provenance preserved), a within-tolerance wobble
+    passes, and a 2x goodput collapse (e.g. re-admission silently
+    falling back to re-prefill) flags exactly that row."""
+    spill_metric = ("transformer_lm_43m_decode_spill_goodput"
+                    "_tokens_per_sec[cpu]")
+    line = json.dumps({
+        "metric": spill_metric, "value": 90.0, "unit": "tokens/sec",
+        "vs_baseline": None, "cold_cache_tokens_per_sec": 55.0,
+        "speedup_vs_cold": 1.64, "spilled_blocks": 84,
+        "readmitted_blocks": 30, "host_evictions": 0,
+        "host_blocks_in_use": 61,
+        "tokens_bit_identical_to_cold": True})
+    rows = bc.rows_from_text("some warmup noise\n" + line + "\n")
+    assert spill_metric in rows
+    assert rows[spill_metric]["readmitted_blocks"] == 30
+    hist = [("r1", rows)]
+    wobble = {spill_metric: {"metric": spill_metric, "value": 75.0}}
+    assert bc.compare(hist, wobble)["ok"]      # -17% < the 25% floor
+    collapsed = {spill_metric: {"metric": spill_metric, "value": 45.0}}
+    verdict = bc.compare(hist, collapsed)
+    assert not verdict["ok"]
+    assert [r["metric"] for r in verdict["regressions"]] \
+        == [spill_metric]
+
+
 # ----------------------------------------------------------------- CLI
 
 def test_cli_fresh_latest_exits_zero(bc, capsys):

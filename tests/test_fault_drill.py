@@ -67,8 +67,9 @@ def test_elastic_drill_leg(tmp_path, leg):
 @pytest.mark.parametrize("leg", ["serve_poison", "serve_overload",
                                  "serve_deadline", "serve_retry",
                                  "serve_watchdog", "serve_prefix",
-                                 "serve_spec",
-                                 "fleet_failover", "fleet_drain",
+                                 "serve_spill", "serve_spec",
+                                 "fleet_failover",
+                                 "fleet_affinity_failover", "fleet_drain",
                                  "fleet_autoscale",
                                  "fleet_tp_failover",
                                  "fleet_journey", "slo_alert"])

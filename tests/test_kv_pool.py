@@ -444,6 +444,31 @@ class TestEnginePaged:
         with pytest.raises(ValueError, match="pool_blocks"):
             InferenceEngine(m, slots=1, block_size=16, pool_blocks=3)
 
+    def test_admit_requeue_budget_bounds_spin(self):
+        """Regression (ISSUE 16 satellite): an admission that can
+        NEVER succeed (pool pinned by an external holder, nothing in
+        flight to free blocks) must not spin the request through the
+        queue forever — after `admit_requeue_budget` requeues it
+        finishes 'pool_exhausted' (status done, zero tokens) and bumps
+        the exhaustion counter; the pool stays serviceable once blocks
+        return."""
+        m = _shared_lm()
+        eng = InferenceEngine(m, slots=1, prefill_buckets=(8,),
+                              block_size=4, max_len=16, pool_blocks=5,
+                              prefix_cache=False,
+                              admit_requeue_budget=3)
+        pinned = eng._pool_mgr.alloc(4)      # every usable block held
+        r = eng.run([Request(prompt=[1, 2, 3], max_new_tokens=2,
+                             seed=0)])[0]
+        assert r.status == "done"
+        assert r.finish_reason == "pool_exhausted"
+        assert r.tokens == []
+        assert eng.stats["admit_requeue_exhausted"] == 1
+        eng._pool_mgr.unref(pinned)
+        ok = eng.run([Request(prompt=[1, 2, 3], max_new_tokens=2,
+                              seed=0)])[0]
+        assert ok.finish_reason == "max_tokens"
+
     def test_multi_turn_resubmission_reuses_history(self):
         """The loadgen multi-turn shape: turn 2 resubmits turn 1's
         prompt + output and must hit the cached history prefix, with
@@ -465,3 +490,161 @@ class TestEnginePaged:
             [Request(prompt=follow, max_new_tokens=4, temperature=0.7,
                      seed=14)])[0]
         assert t2.tokens == cold.tokens
+
+
+class TestSpillTier:
+    """Host-RAM block spill tier (ISSUE 16): tree-level spill/park/
+    re-admit/graft units, the engine round-trip bitwise pin, and the
+    compile-count guard re-pinned with the tier armed."""
+
+    def _cached_chain(self, pool, tree, tokens):
+        blocks = pool.alloc(len(tokens) // pool.block_size)
+        for b in tree.insert(tokens, blocks):
+            pool.mark_cached(b)
+        pool.unref(blocks)
+        return blocks
+
+    def test_spill_victim_selection_lru_refd_protect(self):
+        """spill_victims returns LRU refcount-0 device nodes (stamp,
+        then insertion-order tie-break), skips ref'd blocks and the
+        protected chain — and unlike eviction has NO leaf-only rule."""
+        pool = BlockPool(32, 4)
+        tree = RadixPrefixCache(pool, host_blocks=8)
+        a = self._cached_chain(pool, tree, list(range(1, 9)))
+        b = self._cached_chain(pool, tree, [20, 21, 22, 23])
+        tree.lookup(list(range(1, 9)), 2)    # touch chain a
+        got = [n.block for n in tree.spill_victims(3)]
+        assert got == [b[0], a[0], a[1]]     # b LRU; a root-first
+        pool.ref([a[0]])                     # an active user pins it
+        assert [n.block for n in tree.spill_victims(3)] == [b[0], a[1]]
+        pool.unref([a[0]])
+        prot = frozenset(tree.lookup_nodes(list(range(1, 9)), 2))
+        assert [n.block for n in tree.spill_victims(3, prot)] == [b[0]]
+
+    def test_park_readmit_roundtrip_and_tier_surfaces(self):
+        """park moves a victim's block to the free list and its bytes
+        to the host tier; the device-block surface (lookup) stops at
+        the parked node while the tier-aware walk still matches;
+        readmit hands the bytes back and re-joins the device tier."""
+        pool = BlockPool(32, 4)
+        tree = RadixPrefixCache(pool, host_blocks=8)
+        toks = list(range(1, 9))
+        a = self._cached_chain(pool, tree, toks)
+        free0 = pool.free_count
+        node = tree.spill_victims(1)[0]      # root-most of chain a
+        assert node.block == a[0]
+        assert tree.park(node, "BYTES") == a[0]
+        assert pool.free_count == free0 + 1
+        assert (tree.num_blocks, tree.host_in_use) == (1, 1)
+        assert tree.lookup(toks, 2) == []    # chain starts on host
+        assert len(tree.lookup_nodes(toks, 2)) == 2
+        assert tree.peek_blocks(toks, 2) == 2
+        nb = pool.alloc(1)[0]
+        assert tree.readmit(node, nb) == "BYTES"
+        pool.mark_cached(nb)
+        pool.unref([nb])
+        assert tree.lookup(toks, 2) == [nb, a[1]]
+        assert tree.host_in_use == 0
+
+    def test_host_eviction_childless_only_and_graft(self):
+        """evict_host_one drops only CHILDLESS host nodes (deepest
+        first — interior nodes wait for their subtree); graft_host
+        seeds parents-first, lets incumbents win, refuses orphans,
+        makes room by host-LRU, and is disabled at host_blocks=0."""
+        pool = BlockPool(32, 4)
+        tree = RadixPrefixCache(pool, host_blocks=8)
+        toks = list(range(1, 9))
+        self._cached_chain(pool, tree, toks)
+        for node in tree.spill_victims(2):
+            tree.park(node, bytes(node.tokens))
+        assert tree.host_in_use == 2
+        assert tree.evict_host_one()         # deepest (childless)
+        assert tree.evict_host_one()         # then its parent
+        assert not tree.evict_host_one()
+        assert tree.peek_blocks(toks, 2) == 0
+
+        t2 = RadixPrefixCache(BlockPool(8, 4), host_blocks=2)
+        assert t2.graft_host(toks[:4], "D0")
+        assert t2.graft_host(toks, "D1")
+        assert t2.host_in_use == 2
+        # orphan: depth-2 entry whose parent chunk was never imported
+        assert not t2.graft_host([70, 71, 72, 73, 80, 81, 82, 83],
+                                 "ORPHAN")
+        assert not t2.graft_host(toks[:4], "X")     # incumbent wins
+        assert t2.graft_host([90, 91, 92, 93], "D2")  # evicts LRU
+        assert t2.host_in_use == 2
+        assert t2.peek_blocks(toks, 2) == 1  # D1 made way for D2
+        t3 = RadixPrefixCache(BlockPool(8, 4))      # tier disabled
+        assert not t3.graft_host(toks[:4], "D0")
+
+    def test_spill_readmit_round_trip_bit_identity(self):
+        """THE tentpole acceptance pin: a chain pushed to the host
+        tier by pool pressure and re-admitted on the next hit decodes
+        tokens BITWISE identical to the cold run AND to the original
+        warm run — spilled blocks are bytes, never recomputation."""
+        m = _shared_lm()
+        P = dict(prompt=[5, 9, 3, 7, 2, 8, 4, 6, 1, 3, 9, 2, 7],
+                 max_new_tokens=3, temperature=0.8, seed=11)
+        F = dict(prompt=[30, 31, 32, 33, 34, 35, 36, 37, 38, 39, 40,
+                         41, 42],
+                 max_new_tokens=3, temperature=0.8, seed=2)
+        cold = InferenceEngine(m, slots=1, prefill_buckets=(8, 16),
+                               block_size=4, max_len=20, pool_blocks=6,
+                               prefix_cache=False).run(
+            [Request(**P)])[0]
+        # 5 usable blocks: P's 13-token prompt holds 4, so its cached
+        # 3-block chain MUST spill to admit F — and F's must spill to
+        # re-admit P
+        eng = InferenceEngine(m, slots=1, prefill_buckets=(8, 16),
+                              block_size=4, max_len=20, pool_blocks=6,
+                              spill=True, host_blocks=8)
+        first = eng.run([Request(**P)])[0]
+        assert first.tokens == cold.tokens
+        eng.run([Request(**F)])              # pressure: P's chain spills
+        assert eng.stats["kv_spill_blocks"] >= 1
+        assert eng.health()["prefix"]["host_in_use"] >= 1
+        warm = eng.run([Request(**P)])[0]
+        assert eng.stats["kv_readmit_blocks"] >= 1
+        assert eng.stats["prefix_hits"] >= 1
+        assert warm.tokens == cold.tokens == first.tokens
+
+    def test_compile_guard_with_spill_armed(self):
+        """The #buckets+1 contract holds with the tier armed: spill
+        waves and host re-admissions compile ZERO new executables — a
+        re-admit is a device_put + block-table patch, never a prefill
+        of the parked positions."""
+        m = _tiny_lm()                       # fresh: attribute traces
+        eng = InferenceEngine(m, slots=1, prefill_buckets=(8, 16),
+                              block_size=4, max_len=20, pool_blocks=6,
+                              spill=True, host_blocks=8)
+        P = dict(prompt=[5, 9, 3, 7, 2, 8, 4, 6, 1, 3, 9, 2, 7],
+                 max_new_tokens=3, temperature=0.8, seed=11)
+        F = dict(prompt=[30, 31, 32, 33, 34, 35, 36, 37, 38, 39, 40,
+                         41, 42],
+                 max_new_tokens=3, temperature=0.8, seed=2)
+        eng.run([Request(**P)])              # bucket 16 + decode
+        eng.run([Request(**F)])              # spill wave
+        eng.run([Request(**P)])              # re-admit + bucket-8 suffix
+        assert eng.stats["prefill_traces"] == 2
+        assert eng.stats["decode_traces"] == 1
+        eng.run([Request(**F)])              # spill AND re-admit again:
+        eng.run([Request(**P)])              # every path now warm
+        assert eng.stats["kv_spill_blocks"] > 0
+        assert eng.stats["kv_readmit_blocks"] > 0
+        assert eng.stats["prefill_traces"] == 2
+        assert eng.stats["decode_traces"] == 1
+
+    def test_spill_knob_validation(self):
+        m = _shared_lm()
+        with pytest.raises(ValueError, match="prefix_cache"):
+            InferenceEngine(m, slots=1, block_size=4, max_len=16,
+                            spill=True, prefix_cache=False)
+        with pytest.raises(ValueError, match="host_blocks"):
+            InferenceEngine(m, slots=1, block_size=4, max_len=16,
+                            host_blocks=4)
+        with pytest.raises(ValueError, match="host_blocks"):
+            InferenceEngine(m, slots=1, block_size=4, max_len=16,
+                            spill=True, host_blocks=0)
+        with pytest.raises(ValueError, match="admit_requeue_budget"):
+            InferenceEngine(m, slots=1, block_size=4, max_len=16,
+                            admit_requeue_budget=0)
