@@ -22,6 +22,16 @@ functions handed to `shard_map(body, mesh=..., ...)`, which traces
 escape hatch: every parameter is a traced operand. A function passed
 as the first argument to a `shard_map(...)` call anywhere in the
 module is therefore checked with all parameters traced.
+
+ISSUE 17: Pallas KERNEL BODIES are trace roots too — a function
+handed to `pl.pallas_call` (directly, wrapped in
+`functools.partial(...)`, or via a variable holding such a partial —
+the `ops/paged_decode.py` / `ops/flash_attention.py` launch idiom) is
+traced with its Ref parameters as traced operands. The partial's
+bound arguments are the kernel's static escape hatch (grid constants
+like tile sizes and `dup_batch` are Python values by construction);
+everything unbound is a Ref, and a Python branch on a Ref would
+concretize at trace time exactly like a jit-root branch.
 """
 
 from __future__ import annotations
@@ -48,14 +58,20 @@ class RetraceHazard(Rule):
 
     def check(self, ctx):
         shard_bodies = self._shard_map_bodies(ctx.tree)
+        kernel_bodies = self._pallas_kernel_bodies(ctx.tree)
         for fn in functions(ctx.tree):
             jit = jit_decoration(fn)
             if jit is None:
-                if fn.name not in shard_bodies:
+                if fn.name in shard_bodies:
+                    # shard_map body: no static-arg escape —
+                    # everything the mesh hands in is a traced operand
+                    nums, names = set(), set()
+                elif fn.name in kernel_bodies:
+                    # pallas kernel body: partial-bound args are the
+                    # static escape; unbound params are traced Refs
+                    nums, names = kernel_bodies[fn.name]
+                else:
                     continue
-                # shard_map body: no static-arg escape — everything
-                # the mesh hands in is a traced operand
-                nums, names = set(), set()
             else:
                 nums, names = jit
             params = param_names(fn)
@@ -63,6 +79,56 @@ class RetraceHazard(Rule):
                       if i not in nums and p not in names}
             traced.discard("self")
             yield from self._check_fn(ctx, fn, traced)
+
+    @staticmethod
+    def _pallas_kernel_bodies(tree):
+        """Kernel name -> (static positional indexes, static kwarg
+        names) for functions handed to pallas_call — directly, as an
+        inline `functools.partial(kernel, ...)`, or via a variable
+        assigned such a partial (the ops/ launch idiom). The partial's
+        bound leading positionals / kwargs are static; every other
+        parameter is a traced Ref (ISSUE 17)."""
+
+        def unpartial(expr):
+            if isinstance(expr, ast.Name):
+                return expr.id, set(), set()
+            if isinstance(expr, ast.Call) \
+                    and last_segment(call_name(expr)) == "partial" \
+                    and expr.args \
+                    and isinstance(expr.args[0], ast.Name):
+                return (expr.args[0].id,
+                        set(range(1, len(expr.args))) | {0},
+                        {kw.arg for kw in expr.keywords if kw.arg})
+            return None
+
+        # variables holding a partial: name -> partial info
+        partials = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                info = unpartial(node.value)
+                if info is not None and (info[1] or info[2]):
+                    partials[node.targets[0].id] = info
+
+        out = {}
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and last_segment(call_name(node)) == "pallas_call"
+                    and node.args):
+                continue
+            first = node.args[0]
+            info = unpartial(first)
+            if isinstance(first, ast.Name) and first.id in partials:
+                info = partials[first.id]
+            if info is None:
+                continue
+            name, pos, kws = info
+            # partial(fn, a, b) binds fn's FIRST len-1 params; the
+            # recorded indexes 1..n map to param slots 0..n-1
+            nums = {i - 1 for i in pos if i} if pos else set()
+            out[name] = (nums, kws)
+        return out
 
     @staticmethod
     def _shard_map_bodies(tree):

@@ -30,6 +30,24 @@ from jax import lax
 from bigdl_tpu.nn.module import Module
 
 
+def _deq(w):
+    """Duck-typed dequantize: a serving/quant.py QuantWeight knows how
+    to `deq()` itself back to fp32; a plain array passes through. The
+    serving paths call this at every gemm-weight use so one code path
+    serves both layouts — and models/ never imports serving/."""
+    return w.deq() if hasattr(w, "deq") else w
+
+
+def _embed_rows(w, tokens):
+    """Embedding-table row lookup for either layout. The quantized
+    table is scaled PER ROW (axis=1 amax → scale (V, 1)), so a lookup
+    gathers int8 rows and their scales and multiplies — O(rows·E)
+    work, never the (V, E) fp32 dequant `_deq` would materialize."""
+    if hasattr(w, "deq"):
+        return w.q[tokens].astype(jnp.float32) * w.scale[tokens]
+    return w[tokens]
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
 def tp_identity(x, axis):
     """Megatron's conjugate "f" operator: identity forward, psum backward.
@@ -427,9 +445,11 @@ class TransformerLM(Module):
 
     def head(self, variables):
         """The (E, V) output projection (weight-tied to the embedding
-        unless cfg.tie_embeddings=False)."""
+        unless cfg.tie_embeddings=False). Dequantizes a quantized
+        embedding/head leaf (serving/quant.py) — fp32 passes through."""
         p = variables["params"]
-        return p["embed"].T if self.cfg.tie_embeddings else p["head"]
+        return _deq(p["embed"]).T if self.cfg.tie_embeddings \
+            else _deq(p["head"])
 
     def loss(self, variables, tokens, targets, training=False, rng=None,
              chunk: int = 256):
@@ -459,6 +479,14 @@ class TransformerLM(Module):
     # sequence per token — and both steps compile exactly once (fixed
     # max_len, position-indexed dynamic_update_slice writes; shared
     # primitives in bigdl_tpu/ops/kv_cache.py).
+    #
+    # Quantized serving (ISSUE 17): serving/quant.py repacks
+    # serving_params' gemm weights into int8 QuantWeight leaves. The
+    # paged trio dequantizes at use via the duck-typed helpers below —
+    # models/ never imports serving/ (layering), it just honors any
+    # leaf that knows how to `deq()` itself. fp32 leaves pass through
+    # untouched, so the fp32 layout stays the bit-identity reference;
+    # training paths (apply_hidden/loss) never see QuantWeight.
 
     def _serving_guard(self, tp_ok=False):
         """`tp_ok=True` on the PAGED trio: those paths are tp-aware
@@ -540,10 +568,10 @@ class TransformerLM(Module):
         w2 gemm keeps its FULL contraction extent over a replicated
         w2 — bitwise identical to the unsharded step (the down-proj
         flops are the price of bit-identity; see tp_shard_gather)."""
-        y = jax.nn.gelu(y @ bp["w1"] + bp["b1"])
+        y = jax.nn.gelu(y @ _deq(bp["w1"]) + bp["b1"])
         if self.tp_axis is not None:
             y = tp_shard_gather(y, self.tp_axis)
-        return y @ bp["w2"] + bp["b2"]
+        return y @ _deq(bp["w2"]) + bp["b2"]
 
     def prefill(self, variables, tokens, cache, lengths=None):
         """Fill cache positions [0, S_p) from a right-padded prompt
@@ -652,7 +680,7 @@ class TransformerLM(Module):
                              f"1), got batch {bsz}")
         d = self.head_dim
         start = jnp.asarray(start, jnp.int32)
-        x = p["embed"][tokens] \
+        x = _embed_rows(p["embed"], tokens) \
             + lax.dynamic_slice_in_dim(p["pos"], start, s, axis=0)
 
         new_pools = []
@@ -660,11 +688,11 @@ class TransformerLM(Module):
         for bp, pl in zip(self._layer_blocks(p), pools):
             h = bp["wq"].shape[-1] // d     # local heads (= H/tp)
             y = self._ln(x, bp["ln1_g"], bp["ln1_b"])
-            q = (y @ bp["wq"] + bp["bq"]).reshape(
+            q = (y @ _deq(bp["wq"]) + bp["bq"]).reshape(
                 bsz, s, h, d).transpose(0, 2, 1, 3)
-            k = (y @ bp["wk"] + bp["bk"]).reshape(
+            k = (y @ _deq(bp["wk"]) + bp["bk"]).reshape(
                 bsz, s, h, d).transpose(0, 2, 1, 3)
-            v = (y @ bp["wv"] + bp["bv"]).reshape(
+            v = (y @ _deq(bp["wv"]) + bp["bv"]).reshape(
                 bsz, s, h, d).transpose(0, 2, 1, 3)
             kp, vp = write_prompt_blocks(pl["k"], pl["v"], k, v,
                                          block_ids)
@@ -681,12 +709,13 @@ class TransformerLM(Module):
             a = a.transpose(0, 2, 1, 3).reshape(bsz, s, h * d)
             if self.tp_axis is not None:
                 a = tp_shard_gather(a, self.tp_axis)
-            x = x + a @ bp["wo"] + bp["bo"]
+            x = x + a @ _deq(bp["wo"]) + bp["bo"]
             x = x + self._dense_ffn(
                 self._ln(x, bp["ln2_g"], bp["ln2_b"]), bp)
         return tuple(new_pools)
 
-    def decode_step_paged(self, variables, tokens, pos, pools, table):
+    def decode_step_paged(self, variables, tokens, pos, pools, table,
+                          attn_impl: str = "xla"):
         """One incremental step over the paged pools: tokens/pos (B,)
         as decode_step, `table` (B, max_blocks) int32 block tables.
         Writes each row's k/v at (table[pos // bs], pos % bs) — always
@@ -721,9 +750,19 @@ class TransformerLM(Module):
         and Q>=2 gemms lower to different kernels (ops/kv_cache.py),
         so a prefill-shaped verify would score in the wrong regime
         and the spec-vs-target-only token identity would be luck, not
-        construction."""
-        from bigdl_tpu.ops.kv_cache import (paged_attention,
-                                            write_decode_blocks)
+        construction.
+
+        `attn_impl` (ISSUE 17, STATIC under jit — the engine threads
+        it as a static argnum): "xla" = the gather-then-attend oracle
+        (ops/kv_cache.paged_attention, the default and the bitwise
+        reference everywhere off-TPU); "pallas"/"interpret" = the
+        one-launch table-routed kernel (ops/paged_decode.py), fp32
+        interpret output bitwise == "xla". Because this step is also
+        the speculative verify entry, one knob covers plain decode,
+        draft decode, and the k+1-row verify with the same
+        executable-per-impl."""
+        from bigdl_tpu.ops.kv_cache import write_decode_blocks
+        from bigdl_tpu.ops.paged_decode import paged_decode_attention
 
         self._serving_guard(tp_ok=True)
         p = variables["params"] if "params" in variables else variables
@@ -733,26 +772,27 @@ class TransformerLM(Module):
         rows = jnp.arange(bsz)
         block_ids = table[rows, pos // bs]          # (B,)
         offsets = pos % bs
-        x = p["embed"][tokens] + p["pos"][pos]      # (B, E)
+        x = _embed_rows(p["embed"], tokens) + p["pos"][pos]  # (B, E)
 
         new_pools = []
         for bp, pl in zip(self._layer_blocks(p), pools):
             h = bp["wq"].shape[-1] // d     # local heads (= H/tp)
             y = self._ln(x, bp["ln1_g"], bp["ln1_b"])
-            q = (y @ bp["wq"] + bp["bq"]).reshape(
+            q = (y @ _deq(bp["wq"]) + bp["bq"]).reshape(
                 bsz, 1, h, d).transpose(0, 2, 1, 3)
-            k = (y @ bp["wk"] + bp["bk"]).reshape(
+            k = (y @ _deq(bp["wk"]) + bp["bk"]).reshape(
                 bsz, 1, h, d).transpose(0, 2, 1, 3)
-            v = (y @ bp["wv"] + bp["bv"]).reshape(
+            v = (y @ _deq(bp["wv"]) + bp["bv"]).reshape(
                 bsz, 1, h, d).transpose(0, 2, 1, 3)
             kp, vp = write_decode_blocks(pl["k"], pl["v"], k, v,
                                          block_ids, offsets)
             new_pools.append({"k": kp, "v": vp})
-            a = paged_attention(q, kp, vp, table, pos)  # (B, h, 1, D)
+            a = paged_decode_attention(q, kp, vp, table, pos,
+                                       impl=attn_impl)  # (B, h, 1, D)
             a = a.transpose(0, 2, 1, 3).reshape(bsz, h * d)
             if self.tp_axis is not None:
                 a = tp_shard_gather(a, self.tp_axis)
-            x = x + a @ bp["wo"] + bp["bo"]
+            x = x + a @ _deq(bp["wo"]) + bp["bo"]
             x = x + self._dense_ffn(
                 self._ln(x, bp["ln2_g"], bp["ln2_b"]), bp)
 

@@ -256,9 +256,9 @@ def _prefill_step(model, params, pools, tokens, start, block_ids,
                                table_row, block_ids, start)
 
 
-@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+@functools.partial(jax.jit, static_argnums=(0, 12), donate_argnums=(2,))
 def _decode_step(model, params, pools, tok, pos, seed, nout, temp,
-                 topk, topp, poison, table):
+                 topk, topp, poison, table, attn_impl="xla"):
     """One decode step over all slots + per-row sampling + per-row
     finite-logits health. Shared across engines of the same model
     (static arg) — ONE executable ever. `table` (B, max_blocks) int32
@@ -267,10 +267,14 @@ def _decode_step(model, params, pools, tok, pos, seed, nout, temp,
     a True row's logits are forced to NaN INSIDE the jitted step, so
     the drill exercises the same health reduction and eviction path a
     genuinely non-finite request would — and, being a (B,) operand,
-    arming it never retraces."""
+    arming it never retraces. `attn_impl` (ISSUE 17) is STATIC like
+    the model: engines sharing (model, attn_impl) share the one
+    executable; flipping the impl is a distinct executable by
+    construction, never a silent retrace."""
     _TRACES["decode"] += 1                # runs at trace time only
     logits, pools = model.decode_step_paged({"params": params}, tok,
-                                            pos, pools, table)
+                                            pos, pools, table,
+                                            attn_impl)
     logits = jnp.where(poison[:, None], jnp.float32(jnp.nan), logits)
     finite = rows_finite(logits)
     keys = jax.vmap(lambda s, t: jax.random.fold_in(
@@ -419,7 +423,9 @@ class InferenceEngine:
                  clock: Callable[[], float] = time.monotonic,
                  obs_label: Optional[str] = None,
                  tp_mesh=None, tp_axis: str = "model",
-                 role: str = "both"):
+                 role: str = "both",
+                 attn_impl: str = "xla",
+                 weight_dtype: str = "fp32"):
         if tp_mesh is not None:
             # memoized: engines over the same (model, mesh, axis)
             # share one wrapper and therefore every jitted executable
@@ -455,6 +461,39 @@ class InferenceEngine:
         # 'both' (handoff imports AND direct admissions); 'prefill'
         # changes step() into the export path
         self.role = role
+        # decode-attention impl (ISSUE 17; constructor arg, never
+        # env): "xla" = gather-then-attend (ops/kv_cache, the bitwise
+        # reference and the off-TPU default), "pallas" = the
+        # one-launch table-routed kernel (ops/paged_decode.py, TPU
+        # only), "interpret" = the same kernel through the Pallas
+        # interpreter (CPU parity tests). Static in _decode_step, so
+        # each impl is its own executable — never a silent retrace.
+        if attn_impl not in ("xla", "pallas", "interpret"):
+            raise ValueError(f"attn_impl {attn_impl!r}: expected "
+                             "'xla', 'pallas' or 'interpret'")
+        if attn_impl != "xla" and tp_mesh is not None:
+            raise ValueError(
+                "attn_impl='pallas' under tp_mesh is not validated "
+                "(the kernel inside shard_map is on-chip measurement "
+                "debt, ops/paged_decode.py) — serve sharded engines "
+                "with attn_impl='xla'")
+        self.attn_impl = attn_impl
+        # weight layout (ISSUE 17; constructor arg, never env):
+        # "fp32" is THE bit-identity reference layout every bitwise
+        # pin runs on; "int8" repacks the serving gemm weights via
+        # serving/quant.py under a tolerance contract
+        # (tests/test_quant_serving.py) — the router keeps failover
+        # within one layout_family for exactly that reason
+        if weight_dtype not in ("fp32", "int8"):
+            raise ValueError(f"weight_dtype {weight_dtype!r}: "
+                             "expected 'fp32' or 'int8'")
+        if weight_dtype != "fp32" and tp_mesh is not None:
+            raise ValueError(
+                "weight_dtype='int8' under tp_mesh: the sharded path "
+                "pins BITWISE tp==unsharded tokens, which a lossy "
+                "weight layout cannot honor — quantize unsharded "
+                "engines only")
+        self.weight_dtype = weight_dtype
         self.model = model
         # tp degree for telemetry/provenance (1 = unsharded); the
         # serving/tp.py wrapper carries it, plain models don't
@@ -466,6 +505,15 @@ class InferenceEngine:
         self._params = model.serving_params(self.variables) \
             if hasattr(model, "serving_params") \
             else self.variables["params"]
+        if weight_dtype == "int8":
+            from bigdl_tpu.serving.quant import quantize_serving_params
+
+            self._params = quantize_serving_params(self._params)
+        # stored weight bytes for the bench rows' bytes/token
+        # provenance (QuantWeight leaves count q AND scale)
+        self._weight_bytes = int(sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree_util.tree_leaves(self._params)))
         self.slots = slots
         self.cache_len = max_len if max_len is not None \
             else model.cfg.max_len
@@ -620,6 +668,16 @@ class InferenceEngine:
             "KV pool blocks held by live requests or cached prefixes",
             labelnames=("engine", "tp")).labels(
                 engine=self._obs_name, tp=self._obs_tp)
+        # ISSUE 17: occupancy in BYTES — in-use blocks x the pool's
+        # actual per-block footprint, so a bf16/int8 cache_dtype
+        # engine's residency reads half/quarter the fp32 engine's at
+        # equal block counts
+        self._m_pool_bytes_gauge = reg.gauge(
+            "serving_kv_pool_bytes",
+            "KV pool bytes held by live requests or cached prefixes "
+            "(block count x cache-dtype block footprint)",
+            labelnames=("engine", "tp")).labels(
+                engine=self._obs_name, tp=self._obs_tp)
         # per-tier occupancy (ISSUE 16): device = in-use pool blocks
         # (live + cached), host = parked spill-tier blocks
         self._m_tier_gauges = {
@@ -715,6 +773,15 @@ class InferenceEngine:
         """This engine's registry/event label (see `obs_label`)."""
         return self._obs_name
 
+    @property
+    def layout_family(self) -> str:
+        """'{weight_dtype}/{cache dtype}' — the numerics contract a
+        request's tokens were produced under (ISSUE 17). The router
+        reroutes only within one family: fp32 engines pin bitwise
+        token identity across failover, and a lossy layout's tokens
+        are only comparable to the same layout's."""
+        return f"{self.weight_dtype}/{np.dtype(self.cache_dtype).name}"
+
     def drain(self) -> None:
         """Enter stop-admission mode: subsequent submit() raises
         EngineDraining; already-accepted requests (queued AND
@@ -762,6 +829,11 @@ class InferenceEngine:
             "degraded_reason": self._degraded,
             "tp": self.tp,
             "role": self.role,
+            # serving-layout provenance (ISSUE 17): which attention
+            # impl decodes and which numerics family tokens carry
+            "attn_impl": self.attn_impl,
+            "weight_dtype": self.weight_dtype,
+            "cache_dtype": np.dtype(self.cache_dtype).name,
             "handoffs_out": s["handoffs_out"],
             "handoffs_in": s["handoffs_in"],
             "slots": self.slots,
@@ -1160,6 +1232,10 @@ class InferenceEngine:
         if obs.enabled():
             in_use = self._pool_mgr.capacity - self._pool_mgr.free_count
             self._m_pool_gauge.set(in_use)
+            # bytes view: per-block footprint straight off the pool
+            # leaves, so a bf16/int8 cache reads its true residency
+            self._m_pool_bytes_gauge.set(
+                in_use * self._kv_bytes_per_token * self.block_size)
             self._m_tier_gauges["device"].set(in_use)
             self._m_tier_gauges["host"].set(self._prefix.host_in_use)
             # re-asserted alongside the pool gauge (not only at
@@ -1510,7 +1586,7 @@ class InferenceEngine:
                     jnp.asarray(self._seed), jnp.asarray(self._nout),
                     jnp.asarray(self._temp), jnp.asarray(self._topk),
                     jnp.asarray(self._topp), jnp.asarray(poison),
-                    jnp.asarray(self._table))
+                    jnp.asarray(self._table), self.attn_impl)
             # THE one deliberate per-step device→host fetch: it fences
             # the decode dispatch (block_until_ready lies through the
             # tunnel) and runs inside the watchdog budget above

@@ -411,9 +411,18 @@ class EngineRouter:
         run. Deadline TTLs restart at resubmission (the original
         submit time is kept for latency accounting only). Ranking is
         prompt-aware under affinity, so a migrated tree pulls the
-        rerouted requests to the survivor holding their blocks."""
+        rerouted requests to the survivor holding their blocks.
+
+        Failover never crosses a layout family (ISSUE 17): a quantized
+        engine's tokens agree with fp32 only to a tolerance, so a
+        reroute onto a different `layout_family` would hand the client
+        tokens the original engine would never have produced — the
+        bit-identical-failover pin only holds within one family."""
+        family = getattr(asg.engine, "layout_family", None)
         for eng in self._ranked(asg.request.prompt):
             if eng is asg.engine:
+                continue
+            if getattr(eng, "layout_family", None) != family:
                 continue
             asg.request.hop += 1          # the reroute is a journey hop
             try:
@@ -454,6 +463,12 @@ class EngineRouter:
         for target in self._ranked():
             if target is eng or not getattr(target, "spill_enabled",
                                             False):
+                continue
+            # migrated KV bytes embed the donor's weight/cache layout —
+            # grafting them across a layout family would warm a prefix
+            # the importer's own prefill would never have written
+            if getattr(target, "layout_family", None) != \
+                    getattr(eng, "layout_family", None):
                 continue
             grafted = target.import_tree(entries)
             if not grafted:

@@ -238,6 +238,13 @@ class SpeculativeEngine:
         return self._t.obs_name
 
     @property
+    def layout_family(self) -> str:
+        """The TARGET's layout (ISSUE 17): coupled acceptance emits the
+        target-only stream verbatim, so the draft's layout never shows
+        in the tokens — router failover gates on the target family."""
+        return self._t.layout_family
+
+    @property
     def draft_engine(self) -> InferenceEngine:
         return self._d
 
@@ -424,7 +431,7 @@ class SpeculativeEngine:
                     jnp.asarray(d._temp), jnp.asarray(d._topk),
                     jnp.asarray(d._topp),
                     jnp.asarray(np.zeros(d.slots, bool)),
-                    jnp.asarray(table))
+                    jnp.asarray(table), d.attn_impl)
             # the draft half of the round's deliberate fetches: the
             # chain is sequential by nature (step j+1's input token IS
             # step j's sample), so one bounded host fetch per draft
@@ -457,7 +464,7 @@ class SpeculativeEngine:
                     jnp.asarray(seed), jnp.asarray(nout),
                     jnp.asarray(temp), jnp.asarray(topk),
                     jnp.asarray(topp), jnp.asarray(poison),
-                    jnp.asarray(table))
+                    jnp.asarray(table), t.attn_impl)
             # THE one deliberate per-round target fetch: it fences the
             # verify dispatch (block_until_ready lies through the
             # tunnel) and runs inside the watchdog budget above

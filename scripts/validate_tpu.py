@@ -61,6 +61,40 @@ def main():
         float(acc.sum())
         print(f"  {name}: {(time.perf_counter() - t0) / 20 * 1e3:.2f} ms")
 
+    # --- paged-decode kernel lowers on-chip and matches the oracle ---
+    # (ISSUE 17 measurement debt: CPU verified interpret-mode BITWISE
+    # parity only — Mosaic-compiled numerics and ms/token-vs-roofline
+    # are established HERE, per the PROFILE_r06 protocol, before any
+    # engine trusts attn_impl="pallas". Full tile sweep:
+    # scripts/sweep_paged_decode.py.)
+    from bigdl_tpu.ops.kv_cache import paged_attention
+    from bigdl_tpu.ops.paged_decode import paged_decode_attention
+
+    b, h, nb, bs, d = 4, 8, 16, 16, 64
+    pool_n = b * nb + 1                      # block 0 reserved scratch
+    kp = jnp.asarray(rng.randn(pool_n, h, bs, d), jnp.float32)
+    vp = jnp.asarray(rng.randn(pool_n, h, bs, d), jnp.float32)
+    tbl = jnp.asarray(rng.permutation(np.arange(1, pool_n))[:b * nb]
+                      .reshape(b, nb), jnp.int32)
+    ppos = jnp.asarray(rng.randint(bs, nb * bs, size=b), jnp.int32)
+    qd = jnp.asarray(rng.randn(b, h, 1, d), jnp.float32)
+    pd = jax.jit(lambda q: paged_decode_attention(
+        q, kp, vp, tbl, ppos, impl="pallas"))
+    od = pd(qd)
+    refd = paged_attention(qd, kp, vp, tbl, ppos)
+    err_pd = float(jnp.abs(od - refd).max())
+    bitwise_pd = bool(jnp.array_equal(od, refd))
+    print(f"paged_decode pallas err={err_pd:.4g} bitwise={bitwise_pd}")
+    assert err_pd < 1e-4, "paged-decode kernel diverges from oracle"
+    rd = jax.jit(lambda q: paged_attention(q, kp, vp, tbl, ppos))
+    float(pd(qd).sum()); float(rd(qd).sum())
+    for name, fn in (("pallas", pd), ("xla-gather", rd)):
+        t0 = time.perf_counter()
+        acc = 0.0
+        for _ in range(50):
+            acc += float(fn(qd).sum())       # fenced fetch per step
+        print(f"  {name}: {(time.perf_counter() - t0) / 50 * 1e3:.3f} ms")
+
     # --- bf16 train step is finite and fast ---
     from bigdl_tpu.models import lenet
     from bigdl_tpu.optim import SGD

@@ -91,3 +91,14 @@ def readmit_chain(host_blocks, table, occupancy_leaf):
 
 def migrate_tree(entries, survivor, depth_leaf):
     return survivor.graft(entries, depth_leaf.item())  # BAD
+
+
+# ISSUE 17: quant/repack paths — quantization runs once at engine
+# construction, but a fetch inside the repack pulls the whole fp32
+# tree through the tunnel leaf by leaf
+def quantize_serving_params(params):
+    return {k: np.asarray(v) for k, v in params.items()}  # BAD
+
+
+def repack_weight(w, scale_leaf):
+    return w, scale_leaf.item()  # BAD

@@ -128,3 +128,13 @@ def migrate_tree(entries, survivor):
     # warm-state migration grafts already-parked host entries — pure
     # tree surgery, no device round-trips
     return sum(survivor.graft_host(e) for e in entries)
+
+
+# ISSUE 17: the repack stays device-side (jnp ops in, jax arrays
+# out); bytes provenance reads STATIC leaf metadata, never values
+def quantize_serving_params(params, quantize_fn):
+    return {k: quantize_fn(v) for k, v in params.items()}
+
+
+def quant_params_bytes(leaves):
+    return sum(leaf.size * leaf.dtype.itemsize for leaf in leaves)

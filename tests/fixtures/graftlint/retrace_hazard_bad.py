@@ -38,3 +38,27 @@ def sharded_decode(params, pools, tokens, mesh, specs):
 
     return shard_map(body, mesh=mesh, in_specs=specs,
                      out_specs=specs)(params, pools, tokens)
+
+
+# ISSUE 17: pallas kernel bodies are trace roots — partial-bound args
+# are the static escape; unbound params are traced Refs (the
+# ops/paged_decode.py launch idiom)
+def paged_launch(q, table):
+    from jax.experimental import pallas as pl
+
+    def kernel(tbl_ref, q_ref, o_ref, *, block_tile):
+        if tbl_ref:  # BAD
+            o_ref[...] = q_ref[...] * block_tile
+
+    body = functools.partial(kernel, block_tile=2)
+    return pl.pallas_call(body, out_shape=None)(table, q)
+
+
+def paged_launch_inline(q, table):
+    from jax.experimental import pallas as pl
+
+    def kernel2(tbl_ref, q_ref, o_ref, *, seq):
+        o_ref[...] = q_ref[...] * float(tbl_ref)  # BAD
+
+    return pl.pallas_call(functools.partial(kernel2, seq=64),
+                          out_shape=None)(table, q)

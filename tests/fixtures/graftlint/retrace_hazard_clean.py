@@ -60,3 +60,19 @@ def sharded_decode(params, pools, tokens, mesh, specs):
 
     return shard_map(body, mesh=mesh, in_specs=specs,
                      out_specs=specs)(params, pools, tokens)
+
+
+# ISSUE 17: pallas kernel bodies may branch on their partial-BOUND
+# statics (tile sizes, dup flags) — those are Python values by
+# construction, exactly like jit static_argnames
+def paged_launch(q, table):
+    from jax.experimental import pallas as pl
+
+    def kernel(tbl_ref, q_ref, o_ref, *, block_tile, dup_batch):
+        if dup_batch:
+            o_ref[...] = q_ref[...] * 2
+        for i in range(block_tile):
+            o_ref[...] = q_ref[...] + i
+
+    body = functools.partial(kernel, block_tile=2, dup_batch=True)
+    return pl.pallas_call(body, out_shape=None)(table, q)

@@ -13,3 +13,14 @@ def kill_switch():
     if os.environ["BIGDL_FIXTURE"] == "0":  # BAD
         return "xla"
     return os.getenv("BIGDL_FIXTURE_IMPL", "pallas")  # BAD
+
+
+# ISSUE 17: the paged-decode tile knob is an IMPORT-time snapshot
+# (BIGDL_PAGED_DECODE_TILES, utils/envknobs) — resolving it at launch
+# time would freeze the first value into every compiled decode step
+def resolve_decode_tiles(num_blocks, num_heads):
+    raw = os.environ.get("BIGDL_PAGED_DECODE_TILES")  # BAD
+    if raw:
+        bt, ht = raw.split("x")
+        return int(bt), int(ht)
+    return 1, 1

@@ -12,3 +12,15 @@ def resolve_block(n):
 
 def resolve_impl():
     return _IMPL
+
+_PAGED_TILES = os.environ.get("BIGDL_PAGED_DECODE_TILES")
+
+
+# ISSUE 17: launch-time tile resolution reads the import snapshot —
+# in-process sweeps mutate env then call envknobs.refresh() with a
+# fresh jit root per config
+def resolve_decode_tiles(num_blocks, num_heads):
+    if _PAGED_TILES:
+        bt, ht = _PAGED_TILES.split("x")
+        return int(bt), int(ht)
+    return 1, 1
