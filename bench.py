@@ -1669,6 +1669,178 @@ def bench_lm_decode_spec(on_tpu, context=None, new_tokens=None,
     }), flush=True)
 
 
+def bench_lm_decode_adapt(on_tpu, context=None, new_tokens=None,
+                          slots=None, n_requests=None, k=4):
+    """Adaptive-lookahead row (ISSUE 18): the speculation flywheel's
+    NEVER-SLOWER contract, measured on a workload built to punish
+    speculation. Three engines serve the IDENTICAL shared-prefix burst
+    — adaptive speculative (`adapt_k=True`), fixed-k speculative, and
+    target-only — with tokens asserted BITWISE identical across all
+    three in-row (coupled acceptance keeps speculation
+    output-invisible at ANY accept rate).
+
+    Where lmdecode_spec PLANTS predictability (damped target) to show
+    the upside, this row plants the OPPOSITE: the target keeps its raw
+    random-init weights, so its greedy chains are the
+    chaotic-attractor noise nothing predicts, and the constructed
+    repeat-token draft's proposals are almost all rejected (accept ~0
+    — disclosed in the row). A fixed-k wrapper pays the full
+    draft+verify tax per round for ~zero accepted tokens; the adaptive
+    wrapper's windowed accept collapses within `adapt_window` rounds,
+    k_live drops to the floor and speculation SUSPENDS — later rounds
+    cruise as plain target steps (a probe every `probe_every` cruise
+    rounds keeps auditioning, so a recovered draft would resume; here
+    it never does). k_live/suspend changes are host-side operands over
+    the SAME executables: the timed wave is asserted to compile
+    nothing, for all three engines.
+
+    Acceptance: adaptive goodput >= 0.95x target-only on this hostile
+    trace (the speculation tax adapts away), tokens bit-identical
+    across all three engines, zero timed-wave compiles."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models.transformer import TransformerConfig, TransformerLM
+    from bigdl_tpu.serving import (InferenceEngine, Request,
+                                   SpeculativeEngine)
+
+    lg = _load_loadgen()
+
+    context = context or (512 if on_tpu else 256)
+    slots = slots or (8 if on_tpu else 4)
+    new_tokens = new_tokens or (32 if on_tpu else 16)
+    n_requests = n_requests or (32 if on_tpu else 16)
+    block_size = 16
+    tail = 26 if context >= 256 else max(context // 10, 4)
+    shared_len = context - tail
+    vocab = 32000
+    if on_tpu:
+        dim, layers, heads = 1024, 12, 16
+        d_dim, d_layers, d_heads = 512, 8, 8
+    else:
+        dim, layers, heads = 512, 8, 8               # 43M target
+        d_dim, d_layers, d_heads = 64, 2, 2          # tiny draft
+    max_len = context + new_tokens + 8
+    max_len += (-max_len) % block_size
+    buckets = (2 * block_size, context)
+    # RAW random target — no damping: the low-predictability plant
+    tgt_model = TransformerLM(TransformerConfig(
+        vocab_size=vocab, max_len=max_len, dim=dim, num_heads=heads,
+        num_layers=layers))
+    tgt_vars = tgt_model.init(jax.random.PRNGKey(0))
+    # the repeat-token draft (see bench_lm_decode_spec): predicts
+    # next==current, which the raw target's chaotic chains rarely obey
+    drf_model = TransformerLM(TransformerConfig(
+        vocab_size=vocab, max_len=max_len, dim=d_dim,
+        num_heads=d_heads, num_layers=d_layers))
+    drf_vars = drf_model.init(jax.random.PRNGKey(1))
+    dp = dict(drf_vars["params"])
+    dp["blocks"] = jax.tree_util.tree_map(jnp.zeros_like, dp["blocks"])
+    dp["pos"] = jnp.zeros_like(dp["pos"])
+    drf_vars = {"params": dp, "state": drf_vars.get("state", {})}
+
+    # bench knobs: a 1-round window collapses after the FIRST all-
+    # rejected evaluation (the tax floor this row measures), and the
+    # probe cadence sits past this short run's ~64 cruise rounds —
+    # probes re-mirror every draft slot (a prefill each), so at this
+    # scale one probe alone costs ~5% of the run; the spec_adapt drill
+    # is where probe/resume behavior is exercised and pinned
+    adapt_knobs = dict(adapt_k=True, k_min=1, adapt_window=1,
+                       raise_at=0.6, lower_at=0.3, collapse_at=0.25,
+                       probe_every=192)
+
+    def spec_engine(**kw):
+        return SpeculativeEngine(
+            InferenceEngine(drf_model, drf_vars, slots=slots,
+                            max_len=max_len, prefill_buckets=buckets,
+                            block_size=block_size),
+            InferenceEngine(tgt_model, tgt_vars, slots=slots,
+                            max_len=max_len, prefill_buckets=buckets,
+                            block_size=block_size),
+            k=k, **kw)
+
+    def tgt_engine():
+        return InferenceEngine(tgt_model, tgt_vars, slots=slots,
+                               max_len=max_len, prefill_buckets=buckets,
+                               block_size=block_size)
+
+    def burst(seed):
+        trace = lg.make_trace(
+            n_requests, seed=seed, arrival="bursty",
+            burst_size=n_requests, shared_prefix_len=shared_len,
+            shared_frac=1.0, prompt_len_choices=(tail,),
+            max_new_choices=(new_tokens,), temperature=0.0,
+            priorities=(0,), vocab=vocab)
+        return [Request(**a.spec) for a in trace["arrivals"]]
+
+    from bigdl_tpu.serving.engine import _TRACES
+
+    # warmup on a DIFFERENT trace seed compiles every executable all
+    # three timed engines share (both models' prefill buckets, both
+    # decodes, the ONE verify)
+    spec_engine().run(burst(99)[:slots + 1])
+    tgt_engine().run(burst(99)[:2])
+
+    def timed(eng, seed):
+        reqs = burst(seed)
+        t0 = time.perf_counter()
+        res = eng.run(reqs)
+        dt = time.perf_counter() - t0
+        done = [r for r in res if r.status == "done"]
+        return sum(len(r.tokens) for r in done) / dt, res
+
+    traces0 = dict(_TRACES)
+    adapt_eng = spec_engine(**adapt_knobs)
+    adapt_gps, adapt_res = timed(adapt_eng, 1)
+    fixed_eng = spec_engine()
+    fixed_gps, fixed_res = timed(fixed_eng, 1)
+    tgt_gps, tgt_res = timed(tgt_engine(), 1)
+    # identical trace; speculation is output-invisible at ANY accept
+    # rate, adaptive or not
+    assert [r.tokens for r in adapt_res] == [r.tokens for r in tgt_res]
+    assert [r.tokens for r in fixed_res] == [r.tokens for r in tgt_res]
+    assert dict(_TRACES) == traces0, "timed engines must not compile"
+    # THE contract this row exists for: a hostile workload pays ~zero
+    # speculation tax once adaptation suspends
+    assert adapt_gps >= 0.95 * tgt_gps, \
+        f"adaptive {adapt_gps:.2f} < 0.95x target-only {tgt_gps:.2f}"
+    ha = adapt_eng.health()["speculative"]
+    hf = fixed_eng.health()["speculative"]
+    platform = "tpu" if on_tpu else "cpu"
+    print(json.dumps({
+        "metric": f"transformer_lm_{'186m' if on_tpu else '43m'}"
+                  f"_decode_adapt_goodput_tokens_per_sec[{platform}]",
+        "value": round(adapt_gps, 2), "unit": "tokens/sec",
+        "vs_baseline": None,
+        "target_only_tokens_per_sec": round(tgt_gps, 2),
+        "fixed_k_tokens_per_sec": round(fixed_gps, 2),
+        "adaptive_vs_target_only": round(adapt_gps / tgt_gps, 3),
+        "fixed_k_vs_target_only": round(fixed_gps / tgt_gps, 3),
+        "never_slower_floor": 0.95,
+        "tokens_bit_identical_across_all_three": True,
+        "k_ceiling": k, **{f"adapt_{n}": v for n, v in
+                           adapt_knobs.items() if n != "adapt_k"},
+        "adaptive": {"accept_rate": ha["accept_rate"],
+                     "k_live_final": ha["k_live"],
+                     "suspended_final": ha["suspended"],
+                     "k_adjusts": ha["k_adjusts"],
+                     "speculating_rounds": ha["rounds"],
+                     "draft_steps": ha["draft_steps"]},
+        "fixed": {"accept_rate": hf["accept_rate"],
+                  "speculating_rounds": hf["rounds"],
+                  "draft_steps": hf["draft_steps"]},
+        "workload": "hostile by construction: raw random-init target "
+                    "(chaotic greedy chains) vs repeat-token draft — "
+                    "accept ~0, the anti-lmdecode_spec",
+        "requests": n_requests, "context": context,
+        "new_tokens": new_tokens,
+        "shared_prompt_frac": round(shared_len / context, 3),
+        "cache_slots": slots, "block_size": block_size,
+        "timed_wave_new_compiles": 0,
+        "telemetry": _obs_provenance("serving_"),
+    }), flush=True)
+
+
 def bench_lm_decode_quant(on_tpu, context=None, new_tokens=None,
                           slots=None, n_requests=None):
     """Quantized-serving row (ISSUE 17): the 43M decode served twice
@@ -1840,7 +2012,8 @@ def main(argv=None) -> None:
                          "lm43m,lm186m,lmtiny (cpu),lmdecode,"
                          "lmdecode_batched,lmdecode_prefix,"
                          "lmdecode_spill,lmdecode_fleet,lmdecode_tp,"
-                         "lmdecode_spec,lmdecode_quant")
+                         "lmdecode_spec,lmdecode_adapt,"
+                         "lmdecode_quant")
     args = ap.parse_args(argv)
 
     # bounded backend probe: the axon tunnel's init can block forever
@@ -1927,6 +2100,8 @@ def main(argv=None) -> None:
             bench_lm_decode_tp(on_tpu)
         if sel("lmdecode_spec"):
             bench_lm_decode_spec(on_tpu)
+        if sel("lmdecode_adapt"):
+            bench_lm_decode_adapt(on_tpu)
         if sel("lmdecode_quant"):
             bench_lm_decode_quant(on_tpu)
     else:
@@ -1963,6 +2138,11 @@ def main(argv=None) -> None:
         # waves on one core), default on TPU
         if "lmdecode_spec" in (want or ()):
             bench_lm_decode_spec(on_tpu)
+        # adaptive-lookahead row: explicit-only on CPU (THREE 43M
+        # waves — adaptive, fixed-k, target-only — on one core),
+        # default on TPU
+        if "lmdecode_adapt" in (want or ()):
+            bench_lm_decode_adapt(on_tpu)
         # quantized-serving row: explicit-only on CPU (two full-context
         # 43M prefill waves on one core; the dequant multiply makes
         # quant ms/token a CPU artifact anyway), default on TPU

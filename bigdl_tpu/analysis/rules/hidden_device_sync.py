@@ -66,6 +66,18 @@ are trace roots — a fetch there would sync once per decode step), and
 must stay device-side jnp ops: quantization happens once at engine
 construction, but a fetch hiding in `quantize_serving_params` would
 pull the whole fp32 tree through the tunnel.
+
+ISSUE 18 adds `parallel/param_layout.py` to the scope and
+swap/distill/adapt to the hot-name set (the speculation flywheel).
+The param-layout spine's shard/unstack/spec helpers run inside
+jitted step traces (zero2 slices) and on the engine-construction /
+hot-swap path; `swap_params`/`swap_draft` execute BETWEEN decode
+rounds on a LIVE engine — a fetch there stalls serving once per
+swap, and the swap is pure re-placement (structure/shape checks on
+tree metadata, never values). The adaptive-k ladder (`_evaluate_k`)
+and the distiller's corpus walk are host arithmetic over already-
+fetched ints; `gather_tree`'s np.asarray is the deliberate,
+documented exception (explicit gather API, not a step path).
 """
 
 from __future__ import annotations
@@ -86,7 +98,8 @@ _HOT_FN = re.compile(
     r"|journey|record|dump|bundle|flight"
     r"|verify|rollback|mirror|spec"
     r"|spill|readmit|migrate"
-    r"|quant|repack)")
+    r"|quant|repack"
+    r"|swap|distill|adapt)")
 
 
 @register
@@ -100,7 +113,8 @@ class HiddenDeviceSync(Rule):
              "bigdl_tpu/serving/",
              "bigdl_tpu/ops/kv_cache.py",
              "bigdl_tpu/ops/paged_decode.py",
-             "bigdl_tpu/models/transformer.py")
+             "bigdl_tpu/models/transformer.py",
+             "bigdl_tpu/parallel/param_layout.py")
 
     def _in_scope(self, ctx, node) -> bool:
         fns = ctx.enclosing_functions(node)

@@ -21,6 +21,15 @@ jitted decode step, the canonical place this bug class bites) and
 `serving/quant.py` (layout choices are CONSTRUCTOR args on the
 engine, never env — a quantization knob read here would freeze the
 first engine's layout into every later one).
+
+ISSUE 18 likewise: the speculation flywheel's knobs — adaptive
+lookahead (`adapt_k`, `k_min`, `adapt_window`, `raise_at`,
+`lower_at`, `collapse_at`, `probe_every` on `SpeculativeEngine`) and
+distillation (`seq_len`, `batch_size`, `learningrate`, `epochs`,
+`zero`, `mesh` on `DraftDistiller`) — are CONSTRUCTOR args, never
+env, and `parallel/param_layout.py` rides the `parallel/` prefix:
+the spine's shard helpers run inside the zero2 step trace, exactly
+where an env read would freeze into the first executable.
 """
 
 from __future__ import annotations

@@ -541,24 +541,23 @@ class TransformerLM(Module):
         (43M CPU: 148 ms/token stacked vs 46 unstacked). One-time
         O(params) repack; pass the result anywhere `variables` goes:
         `model.prefill({"params": sp}, ...)`."""
+        from bigdl_tpu.parallel.param_layout import unstack_blocks
+
         p = variables["params"] if "params" in variables else variables
         if isinstance(p["blocks"], (tuple, list)):
             return p
         out = dict(p)
-        out["blocks"] = tuple(
-            jax.tree_util.tree_map(lambda a: a[l], p["blocks"])
-            for l in range(self.cfg.num_layers))
+        out["blocks"] = unstack_blocks(p, self.cfg.num_layers)
         return out
 
     def _layer_blocks(self, p):
         """Per-layer block params from either layout (tuple passthrough;
         stacked → traced per-layer slices, correct but slow — use
-        serving_params for the hot path)."""
-        blocks = p["blocks"]
-        if isinstance(blocks, (tuple, list)):
-            return blocks
-        return tuple(jax.tree_util.tree_map(lambda a: a[l], blocks)
-                     for l in range(self.cfg.num_layers))
+        serving_params for the hot path). Routes through the
+        param-layout spine's unstack walk (ISSUE 18)."""
+        from bigdl_tpu.parallel.param_layout import unstack_blocks
+
+        return unstack_blocks(p, self.cfg.num_layers)
 
     def _dense_ffn(self, y, bp):
         """Serving FFN. Under `tp_axis` (paged trio inside shard_map)

@@ -179,6 +179,31 @@ EVENT_KINDS: Dict[str, dict] = {
                "target-only decode — tokens bit-identical by "
                "construction (ISSUE 15; the draft's own "
                "engine_degraded event rides alongside)"},
+    "spec_k_adjust": {
+        "required": ("plane", "engine", "draft_engine", "round",
+                     "k_from", "k_to", "accept"),
+        "optional": ("suspended", "window"),
+        "doc": "one adaptive-lookahead evaluation (ISSUE 18): every "
+               "`adapt_window` speculative rounds the windowed accept "
+               "rate (obs/timeseries.HistogramWindow over the per-"
+               "round accept-fraction histogram) moves k_live "
+               "k_from→k_to (equal = held); `suspended` marks the "
+               "~0-tax collapse mode where rounds run target-only "
+               "between probe rounds — emitted every evaluation, so "
+               "the sequence IS obs_report's k-timeline"},
+    "draft_swap": {
+        "required": ("plane", "engine", "draft_engine", "swap",
+                     "accept_before"),
+        "optional": ("accept_after", "round", "source"),
+        "doc": "improved draft weights hot-swapped into the live "
+               "engine (ISSUE 18): pure re-placement through the "
+               "param_layout spine — zero new executables, no "
+               "quiesce, tokens stay the target's bitwise. "
+               "accept_before = windowed accept at swap time; "
+               "accept_after lands in health()['speculative'] at the "
+               "first post-swap evaluation (events are immutable — "
+               "obs_report pairs the swap with the NEXT spec_k_adjust "
+               "instead)"},
     # ---- serving plane: fleet ------------------------------------------
     "engine_degraded": {
         "required": ("plane", "engine", "reason"),

@@ -56,40 +56,10 @@ from bigdl_tpu.nn.module import Criterion, Module
 from bigdl_tpu.utils.anomaly import health_ok, select_update as _select_update
 
 from bigdl_tpu.parallel.shard_map_compat import shard_map
-
-
-class FlatParamSpec:
-    """Flatten/unflatten a params pytree to one padded flat vector.
-
-    Reference parity: Module.getParameters() — the reference compacts all
-    weights into a single contiguous Tensor so AllReduceParameter can
-    slice it evenly; we pad to a multiple of the mesh axis size so every
-    device owns an equal slice (the reference does the same ceil-division
-    in AllReduceParameter.init).
-    """
-
-    def __init__(self, params: Any, num_shards: int):
-        leaves, self.treedef = jax.tree_util.tree_flatten(params)
-        self.shapes = [l.shape for l in leaves]
-        self.dtypes = [l.dtype for l in leaves]
-        self.sizes = [int(np.prod(s)) if s else 1 for s in self.shapes]
-        self.total = sum(self.sizes)
-        self.num_shards = num_shards
-        self.padded = ((self.total + num_shards - 1) // num_shards) * num_shards
-        self.shard_size = self.padded // num_shards
-
-    def flatten(self, params) -> jax.Array:
-        leaves = jax.tree_util.tree_leaves(params)
-        flat = jnp.concatenate([jnp.ravel(l).astype(jnp.float32) for l in leaves])
-        return jnp.pad(flat, (0, self.padded - self.total))
-
-    def unflatten(self, flat: jax.Array):
-        out, off = [], 0
-        for shape, dtype, size in zip(self.shapes, self.dtypes, self.sizes):
-            out.append(lax.dynamic_slice(flat, (off,), (size,))
-                       .reshape(shape).astype(dtype))
-            off += size
-        return jax.tree_util.tree_unflatten(self.treedef, out)
+# the flatten/pad/slice algebra lives in the param-layout spine
+# (ISSUE 18) — re-exported here because this module IS its historical
+# home and every training consumer imports it from parallel/
+from bigdl_tpu.parallel.param_layout import FlatParamSpec  # noqa: F401
 
 
 def _make_scattered_grads(model, criterion, spec, axis, grad_dtype,
@@ -249,9 +219,7 @@ def make_dp_train_step(
         g_my = _clip_shard(g_my, clip_const, clip_norm, axis)
 
         if zero == 1:
-            my_index = lax.axis_index(axis)
-            w_my = lax.dynamic_slice(flat_w, (my_index * spec.shard_size,),
-                                     (spec.shard_size,))
+            w_my = spec.shard_slice(flat_w, lax.axis_index(axis))
         new_w_my, new_slots = method.update(g_my, w_my, slots, lr, stepno)
         if zero == 2:
             new_flat_w, prev_w = new_w_my, w_my  # stays sharded
@@ -354,9 +322,7 @@ def make_dp_accum_steps(
         if zero == 2:
             w_my = flat_w
         else:
-            my_index = lax.axis_index(axis)
-            w_my = lax.dynamic_slice(flat_w, (my_index * spec.shard_size,),
-                                     (spec.shard_size,))
+            w_my = spec.shard_slice(flat_w, lax.axis_index(axis))
         new_w_my, new_slots = method.update(g_my, w_my, slots, lr, stepno)
         if zero == 2:
             new_flat_w = new_w_my

@@ -145,19 +145,14 @@ class DistriOptimizer(LocalOptimizer):
           re-pad (the elastic-resume reshard)
         - pytree slots from a LocalOptimizer checkpoint → flatten each
           top-level slot branch with this spec
+
+        The algebra lives in the param-layout spine (ISSUE 18) — this
+        wrapper keeps the historical call site (scripts and the
+        recover/resume paths reference it by name).
         """
-        layout = (optim_meta or {}).get("layout")
-        if layout in ("zero1_flat", "zero2_flat"):
-            if optim_meta["padded"] == spec.padded:
-                return saved_slots
-            total = optim_meta["total"]
-            return jax.tree_util.tree_map(
-                lambda v: jnp.pad(jnp.asarray(v)[:total],
-                                  (0, spec.padded - total)),
-                saved_slots)
-        # local (pytree-per-slot) checkpoint: each top-level entry mirrors
-        # the params tree — flatten it into this run's flat vector layout
-        return {k: spec.flatten(v) for k, v in saved_slots.items()}
+        from bigdl_tpu.parallel.param_layout import adapt_flat_tree
+
+        return adapt_flat_tree(saved_slots, optim_meta, spec)
 
     # ------------------------------------------------------------------ run
     def run(self):
@@ -276,6 +271,8 @@ class DistriOptimizer(LocalOptimizer):
             if isinstance(acc, dict):
                 flat = spec.flatten(acc)
             else:
+                from bigdl_tpu.parallel.param_layout import repad_flat
+
                 flat = jnp.asarray(acc)
                 old_total = (optim_meta or {}).get("total")
                 if flat.shape[0] != spec.padded:
@@ -283,8 +280,7 @@ class DistriOptimizer(LocalOptimizer):
                         raise ValueError(
                             f"cannot adapt accumulator of length "
                             f"{flat.shape[0]} to padded {spec.padded}")
-                    flat = jnp.pad(flat[:old_total],
-                                   (0, spec.padded - old_total))
+                    flat = repad_flat(flat, old_total, spec.padded)
             g_acc = place_global(self.mesh, P(self.axis), flat)
             micro_n = int(saved["micro_n"])
 

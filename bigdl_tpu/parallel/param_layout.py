@@ -1,0 +1,214 @@
+"""One param-layout spine — the flatten/pad/shard/unstack algebra every
+layout consumer shares (ISSUE 18 tentpole (c)).
+
+Before this module the same layout algebra lived in four hand-rolled
+copies, each re-deriving the others' invariants:
+
+* ZeRO shard slices — `parallel/data_parallel.py` flattened the params
+  pytree, padded to a multiple of the axis size and sliced per device;
+* checkpoint reshard — `parallel/distri_optimizer.py::_adapt_slots`
+  stripped a saved layout's padding and re-padded into this run's, and
+  `serialization/checkpoint.py::_load_sharded_dir` concatenated the
+  per-shard slices back into the full vectors;
+* serving repack — `models/transformer.py::serving_params` unstacked
+  the (L, ...) training stack into per-layer tuples and
+  `serving/quant.py` walked those per-layer blocks to quantize;
+* tp gather/shard — `serving/tp.py` kept its own table of which
+  serving-layout leaves are column-sharded and rebuilt the spec pytree.
+
+Draft hot-swap (tentpole (b)) would have been a fifth copy. Now the
+algebra lives HERE once: `FlatParamSpec` (flatten/unflatten/pad +
+`shard_slice`, the ZeRO slice rule), `adapt_flat_tree`/`repad_flat`
+(the elastic-resume reshard), `concat_shard_trees` (the load-side
+inverse), `unstack_blocks`/`map_block_leaves` (the serving repack
+walks) and `tp_serving_block_specs`/`tp_serving_specs`/`gather_tree`
+(the tp placement schedule). The original call sites delegate — every
+pre-existing bitwise pin (zero2==zero1, reshard roundtrip across world
+sizes, tp==unsharded, warm==cold) re-ran green over the reroute, and
+`tests/test_param_layout.py` pins each path against its pre-refactor
+form. The flat side is deliberately ZeRO-3-ready (arXiv 2004.13336):
+a future param-sharded forward needs exactly `shard_slice` +
+`unflatten` composed per layer, nothing new.
+
+This module depends only on jax/numpy — serving/, models/ and
+serialization/ all import it without cycles. Placement itself
+(`shard_params` over a mesh) stays with its callers: the spine owns
+WHAT the layout is, not where it lives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["FlatParamSpec", "repad_flat", "adapt_flat_tree",
+           "concat_shard_trees", "unstack_blocks", "map_block_leaves",
+           "TP_COL", "TP_COL_BIAS", "tp_serving_block_specs",
+           "tp_serving_specs", "gather_tree"]
+
+
+class FlatParamSpec:
+    """Flatten/unflatten a params pytree to one padded flat vector.
+
+    Reference parity: Module.getParameters() — the reference compacts all
+    weights into a single contiguous Tensor so AllReduceParameter can
+    slice it evenly; we pad to a multiple of the mesh axis size so every
+    device owns an equal slice (the reference does the same ceil-division
+    in AllReduceParameter.init).
+    """
+
+    def __init__(self, params: Any, num_shards: int):
+        leaves, self.treedef = jax.tree_util.tree_flatten(params)
+        self.shapes = [l.shape for l in leaves]
+        self.dtypes = [l.dtype for l in leaves]
+        self.sizes = [int(np.prod(s)) if s else 1 for s in self.shapes]
+        self.total = sum(self.sizes)
+        self.num_shards = num_shards
+        self.padded = ((self.total + num_shards - 1) // num_shards) * num_shards
+        self.shard_size = self.padded // num_shards
+
+    def flatten(self, params) -> jax.Array:
+        leaves = jax.tree_util.tree_leaves(params)
+        flat = jnp.concatenate([jnp.ravel(l).astype(jnp.float32) for l in leaves])
+        return jnp.pad(flat, (0, self.padded - self.total))
+
+    def unflatten(self, flat: jax.Array):
+        out, off = [], 0
+        for shape, dtype, size in zip(self.shapes, self.dtypes, self.sizes):
+            out.append(lax.dynamic_slice(flat, (off,), (size,))
+                       .reshape(shape).astype(dtype))
+            off += size
+        return jax.tree_util.tree_unflatten(self.treedef, out)
+
+    def shard_slice(self, flat: jax.Array, index) -> jax.Array:
+        """Shard `index`'s (shard_size,) slice of a (padded,) flat
+        vector — THE ZeRO slice rule. Traceable (`index` may be
+        `lax.axis_index`); the slices of indices 0..num_shards-1 are
+        disjoint and cover the padded vector exactly, which is what
+        makes all_gather-of-slices bitwise == the replicated vector
+        (the zero2==zero1 pin)."""
+        return lax.dynamic_slice(flat, (index * self.shard_size,),
+                                 (self.shard_size,))
+
+
+def repad_flat(flat: jax.Array, old_total: int,
+               padded: int) -> jax.Array:
+    """Re-pad one flat vector from a different world size's layout:
+    strip the OLD padding down to the real `old_total` parameters,
+    then zero-pad to this layout's `padded` length. The elastic-resume
+    primitive `adapt_flat_tree` and `restore_accum` both reduce to."""
+    flat = jnp.asarray(flat)
+    return jnp.pad(flat[:old_total], (0, padded - old_total))
+
+
+def adapt_flat_tree(saved_slots, optim_meta, spec: FlatParamSpec):
+    """Convert checkpointed slots to this run's ZeRO flat layout.
+
+    Three cases (see the `optim_meta` written at save time):
+    - same `padded` → use directly
+    - zero{1,2}_flat from a different mesh size → strip padding,
+      re-pad (the elastic-resume reshard)
+    - pytree slots from a LocalOptimizer checkpoint → flatten each
+      top-level slot branch with this spec
+    """
+    layout = (optim_meta or {}).get("layout")
+    if layout in ("zero1_flat", "zero2_flat"):
+        if optim_meta["padded"] == spec.padded:
+            return saved_slots
+        total = optim_meta["total"]
+        return jax.tree_util.tree_map(
+            lambda v: repad_flat(v, total, spec.padded), saved_slots)
+    # local (pytree-per-slot) checkpoint: each top-level entry mirrors
+    # the params tree — flatten it into this run's flat vector layout
+    return {k: spec.flatten(v) for k, v in saved_slots.items()}
+
+
+def concat_shard_trees(parts):
+    """Concatenate per-shard slot trees (shard order) back into the
+    full (padded,) vectors — the load-side inverse of `shard_slice`.
+    Host-side on purpose: the shards were loaded as numpy, and callers
+    re-place/re-shard onto the current mesh, so a jnp.concatenate here
+    would bounce the full optimizer state through the default device
+    for nothing."""
+    return jax.tree_util.tree_map(
+        lambda *xs: np.concatenate([np.asarray(x) for x in xs]), *parts)
+
+
+# ---------------------------------------------------------------- serving
+def unstack_blocks(p: Dict[str, Any], num_layers: int) -> tuple:
+    """Per-layer block tuples from the stacked (L, ...) training
+    layout (tuple/list passthrough) — the serving-repack walk
+    `TransformerLM.serving_params` / `_layer_blocks` and the draft
+    hot-swap all route through. Device-side tree_map slices: the
+    repack is one O(params) gather, never a host fetch."""
+    blocks = p["blocks"]
+    if isinstance(blocks, (tuple, list)):
+        return tuple(blocks)
+    return tuple(jax.tree_util.tree_map(lambda a: a[l], blocks)
+                 for l in range(num_layers))
+
+
+def map_block_leaves(params: Dict[str, Any], fn) -> Dict[str, Any]:
+    """Rebuild a serving-layout dict with `fn(key, leaf)` applied to
+    every per-layer block leaf (top-level entries pass through
+    untouched — callers transform those explicitly). Requires the
+    per-layer tuple layout: the walk is the quantized-repack /
+    hot-swap spine and must never silently retrace a stacked tree."""
+    if not isinstance(params["blocks"], (tuple, list)):
+        raise ValueError(
+            "map_block_leaves expects the per-layer serving layout — "
+            "call model.serving_params(variables) first")
+    out = dict(params)
+    out["blocks"] = tuple(
+        {k: fn(k, v) for k, v in bp.items()}
+        for bp in params["blocks"])
+    return out
+
+
+# ---------------------------------------------------------------- tp spec
+# per-layer serving-layout leaves: which are column-sharded (last dim)
+TP_COL = frozenset({"wq", "wk", "wv", "w1"})
+TP_COL_BIAS = frozenset({"bq", "bk", "bv", "b1"})
+
+
+def tp_serving_block_specs(axis: str = "model") -> Dict[str, Any]:
+    """PartitionSpecs for ONE per-layer serving block (the unstacked
+    dict `serving_params` produces). wq/wk/wv split by head column,
+    w1 by ffn hidden; wo/w2/ln/biases-of-row-gemms replicated (the
+    bit-identity construction — serving/tp.py module docstring)."""
+    spec: Dict[str, Any] = {}
+    for k in ("ln1_g", "ln1_b", "ln2_g", "ln2_b", "wo", "bo", "w2",
+              "b2"):
+        spec[k] = P()
+    for k in TP_COL:
+        spec[k] = P(None, axis)
+    for k in TP_COL_BIAS:
+        spec[k] = P(axis)
+    return spec
+
+
+def tp_serving_specs(params, axis: str = "model") -> Dict[str, Any]:
+    """Spec pytree matching a serving-layout param tree (per-layer
+    tuple of blocks, as `TransformerLM.serving_params` returns).
+    Derived from the tree's own structure so checkpoint-loaded trees
+    reshard without the model object."""
+    block = tp_serving_block_specs(axis)
+    specs: Dict[str, Any] = {
+        k: P() for k in params if k != "blocks"}
+    specs["blocks"] = tuple(block for _ in params["blocks"])
+    return specs
+
+
+def gather_tree(params):
+    """Host (checkpoint) form of a possibly-sharded param tree: every
+    leaf fetched as a GLOBAL numpy array — the gather half of the
+    re-placement round-trip (`serving/tp.py::shard_serving_params` is
+    the inverse; placement round-trips bitwise because the mesh only
+    places values, never changes them). A deliberate whole-tree fetch:
+    host-side setup/checkpoint form by name, never a hot path."""
+    return jax.tree_util.tree_map(np.asarray, params)

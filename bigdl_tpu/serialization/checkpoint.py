@@ -689,13 +689,12 @@ class Checkpoint:
                                   as_jax=False)
             parts.append(tree)
         if parts and jax.tree_util.tree_leaves(parts[0]):
-            # host-side concatenate: the shards were loaded as numpy on
-            # purpose — callers re-place/re-shard onto the current mesh,
-            # so a jnp.concatenate here would bounce the full optimizer
-            # state through the default device for nothing
-            optim_state = jax.tree_util.tree_map(
-                lambda *xs: np.concatenate([np.asarray(x) for x in xs]),
-                *parts)
+            # host-side concatenate via the param-layout spine (the
+            # load-side inverse of the ZeRO shard_slice; ISSUE 18) —
+            # callers re-place/re-shard onto the current mesh
+            from bigdl_tpu.parallel.param_layout import concat_shard_trees
+
+            optim_state = concat_shard_trees(parts)
         else:  # slot-less method (plain SGD): every shard tree is empty
             optim_state = parts[0] if parts else {}
         self._last_loaded = d

@@ -8,6 +8,7 @@ from bigdl_tpu.serving.autoscaler import Autoscaler
 from bigdl_tpu.serving.bucketing import (bucket_for, bucket_histogram,
                                          default_buckets, pad_rows,
                                          pad_tokens)
+from bigdl_tpu.serving.distill import DraftDistiller
 from bigdl_tpu.serving.engine import (STATUSES, EngineDegraded,
                                       EngineDraining, GenerationResult,
                                       HandoffPackage, InferenceEngine,
@@ -28,7 +29,7 @@ __all__ = [
     "OverloadError", "StepTimeout", "EngineDegraded", "EngineDraining",
     "HandoffPackage", "EngineRouter", "NoHealthyEngine",
     "ROUTER_LATENCY_BUCKETS",
-    "SpeculativeEngine",
+    "SpeculativeEngine", "DraftDistiller",
     "TPServingLM", "tp_serving_model", "tp_serving_specs",
     "gather_serving_params", "shard_serving_params",
     "Autoscaler", "BlockPool", "RadixPrefixCache",

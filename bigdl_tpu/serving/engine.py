@@ -501,14 +501,9 @@ class InferenceEngine:
         self.variables = variables if variables is not None \
             else model.variables
         # one-time repack into the per-layer serving layout (stacked
-        # weights pay a full-stack slice copy per decoded token)
-        self._params = model.serving_params(self.variables) \
-            if hasattr(model, "serving_params") \
-            else self.variables["params"]
-        if weight_dtype == "int8":
-            from bigdl_tpu.serving.quant import quantize_serving_params
-
-            self._params = quantize_serving_params(self._params)
+        # weights pay a full-stack slice copy per decoded token);
+        # swap_params re-runs the identical build for weight hot-swap
+        self._params = self._build_params(self.variables)
         # stored weight bytes for the bench rows' bytes/token
         # provenance (QuantWeight leaves count q AND scale)
         self._weight_bytes = int(sum(
@@ -599,6 +594,7 @@ class InferenceEngine:
             "kv_spill_blocks": 0, "kv_readmit_blocks": 0,
             "kv_host_evictions": 0, "admit_requeue_exhausted": 0,
             "handoffs_out": 0, "handoffs_in": 0,
+            "weight_swaps": 0,
         }
         # ---- telemetry plane (ISSUE 5): every _stats increment also
         # mirrors into the process-wide registry under this engine's
@@ -651,6 +647,8 @@ class InferenceEngine:
                             "disaggregated decode",
             "handoffs_in": "prefilled requests imported from a "
                            "prefill tier",
+            "weight_swaps": "weight hot-swaps re-placed into the live "
+                            "serving layout (ISSUE 18)",
         }
         self._m_ops = {
             key: reg.counter(f"serving_{key}_total", help_,
@@ -733,6 +731,54 @@ class InferenceEngine:
             # is prefilled (position 0 rewritten) before it decodes.
             self._dispatch_and_fetch(np.zeros(slots, bool), 0.0,
                                      watchdog=False)
+
+    def _build_params(self, variables):
+        """The serving weight layout for `variables`, through the
+        param-layout spine (ISSUE 18): per-layer unstack
+        (`model.serving_params` → parallel/param_layout.unstack_blocks;
+        the tp wrapper's variant additionally mesh-places via
+        shard_serving_params), then the int8 block-leaf repack when
+        quantized (serving/quant.py). The constructor and
+        `swap_params` run the IDENTICAL build — one spine, no drift."""
+        params = self.model.serving_params(variables) \
+            if hasattr(self.model, "serving_params") \
+            else variables["params"]
+        if self.weight_dtype == "int8":
+            from bigdl_tpu.serving.quant import quantize_serving_params
+
+            params = quantize_serving_params(params)
+        return params
+
+    def swap_params(self, variables) -> None:
+        """Hot-swap model weights (ISSUE 18): rebuild the serving
+        layout from `variables` and re-point the jitted steps' params
+        OPERAND. The model (+ attn_impl) is the static jit argument
+        and the new tree arrives with identical structure/shapes/
+        dtypes, so the swap is pure re-placement — zero new
+        executables (the `_TRACES` census pins it) and no quiesce:
+        in-flight slots keep their KV bytes and decode their next
+        token under the new weights. Swapping a speculative DRAFT is
+        invisible in the token stream by construction (acceptance
+        exactness is draft-independent, ISSUE 15); swapping a TARGET
+        changes its tokens — that gate is the caller's contract."""
+        params = self._build_params(variables)
+        if jax.tree_util.tree_structure(params) \
+                != jax.tree_util.tree_structure(self._params):
+            raise ValueError(
+                "swap_params: new variables produce a different "
+                "serving-layout structure — hot-swap is re-placement "
+                "over the SAME layout, never a re-architecture")
+        old_shapes = [l.shape for l in
+                      jax.tree_util.tree_leaves(self._params)]
+        new_shapes = [l.shape for l in
+                      jax.tree_util.tree_leaves(params)]
+        if old_shapes != new_shapes:
+            raise ValueError(
+                "swap_params: leaf shapes changed — a different model "
+                "config cannot hot-swap into a live engine")
+        self.variables = variables
+        self._params = params
+        self._bump("weight_swaps")
 
     @property
     def stats(self) -> Dict[str, int]:

@@ -88,19 +88,18 @@ def quantize_serving_params(params):
     untouched. The embedding is scaled PER ROW (axis=1) so token
     lookups gather int8 rows + their scales instead of dequantizing
     the whole (V, E) table (models/transformer._embed_rows)."""
+    from bigdl_tpu.parallel.param_layout import map_block_leaves
+
     p = params["params"] if "params" in params else params
-    out = dict(p)
+    # the per-layer walk is the param-layout spine's block-leaf map
+    # (ISSUE 18) — it raises on a stacked tree, keeping the "call
+    # serving_params first" contract
+    out = map_block_leaves(
+        p, lambda k, v: (quantize_weight(v, axis=0)
+                         if k in _BLOCK_GEMMS else v))
     out["embed"] = quantize_weight(p["embed"], axis=1)
     if "head" in p:
         out["head"] = quantize_weight(p["head"], axis=0)
-    if not isinstance(p["blocks"], (tuple, list)):
-        raise ValueError(
-            "quantize_serving_params expects the per-layer serving "
-            "layout — call model.serving_params(variables) first")
-    out["blocks"] = tuple(
-        {k: (quantize_weight(v, axis=0) if k in _BLOCK_GEMMS else v)
-         for k, v in bp.items()}
-        for bp in p["blocks"])
     return out
 
 

@@ -69,6 +69,32 @@ target's own step() with tokens bit-identical to an undisturbed
 target-only run (the serve_spec drill). Verify dispatch failures use
 the target's own watchdog/retry/degrade machinery, faults and all.
 
+Speculation flywheel (ISSUE 18)
+-------------------------------
+`adapt_k=True` drives the lookahead from the MEASURED accept rate:
+per-round accepted/proposed fractions feed a registry histogram whose
+`obs/timeseries.HistogramWindow` median is evaluated every
+`adapt_window` proposing rounds — accept >= `raise_at` steps `k_live`
+up (ceiling `k`), accept < `lower_at` steps it down (floor `k_min`),
+and a collapse below `collapse_at` SUSPENDS speculation entirely: the
+wrapper cruises on the target's own step() (true target-only cost —
+a hostile workload pays ~0 speculation tax) and re-probes with one
+k_min-lookahead round every `probe_every` rounds, resuming once a
+probe window clears `raise_at`. `k_live` caps per-round horizons — a
+host-side operand; the verify executable keeps its B*(k+1) shape, so
+adaptation compiles NOTHING. Catch-up generalizes to any lag (cruise
+rounds leave the draft shadow behind; the probe replays the accepted
+sequence from the target's prompt+gen — for the classic lag-1 case
+the replay input is bitwise the old single-step catch-up's
+t._tok/t._pos). `swap_draft(variables)` hot-swaps distilled draft
+weights through the engine's param-layout re-placement
+(`InferenceEngine.swap_params` — zero new executables, no quiesce)
+and stamps accept-before/after provenance (`draft_swap` event;
+"after" is measured over the next `adapt_window` proposing rounds).
+Both levers move ONLY throughput: acceptance exactness is draft-
+independent (coupled sampling above), so tokens stay the target-only
+stream verbatim through any k trajectory or mid-run swap.
+
 All knobs are CONSTRUCTOR args, never env (graftlint trace-env-read).
 Fleet story: draft and target may be different tp layouts — both
 engines' steps are layout-blind behind their models, handoff imports
@@ -86,6 +112,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu import obs
+from bigdl_tpu.obs.timeseries import HistogramWindow
 from bigdl_tpu.serving.engine import (GenerationResult, InferenceEngine,
                                       Request, StepTimeout, _decode_step,
                                       _watchdog_call)
@@ -102,7 +129,10 @@ class SpeculativeEngine:
     Requests live in the TARGET engine (queue, slots, deadlines,
     overload, lifecycle events all under the target's label); the
     draft holds per-slot shadow mirrors of the same sequences. `k` is
-    the draft lookahead per round (constructor arg, never env). The
+    the draft lookahead CEILING per round (constructor arg, never
+    env); `adapt_k=True` lets the measured accept rate move the live
+    lookahead between `k_min` and `k` — and suspend speculation
+    outright on a collapse (module docstring, ISSUE 18). The
     wrapper exposes the full router-driven engine surface; `health()`
     adds a "speculative" section (accept rate, draft overhead,
     fallback state) and the draft engine's health rides under
@@ -110,10 +140,29 @@ class SpeculativeEngine:
     """
 
     def __init__(self, draft: InferenceEngine, target: InferenceEngine,
-                 k: int = 4):
+                 k: int = 4, *, adapt_k: bool = False, k_min: int = 1,
+                 adapt_window: int = 8, raise_at: float = 0.6,
+                 lower_at: float = 0.3, collapse_at: float = 0.1,
+                 probe_every: int = 64):
         if k < 1:
             raise ValueError("k must be >= 1 (the draft proposes at "
                              "least one token per round)")
+        if not 1 <= k_min <= k:
+            raise ValueError(f"k_min must satisfy 1 <= k_min <= k "
+                             f"(got k_min={k_min}, k={k})")
+        if adapt_window < 1:
+            raise ValueError("adapt_window must be >= 1 proposing "
+                             "rounds per evaluation")
+        if probe_every < 1:
+            raise ValueError("probe_every must be >= 1 (how many "
+                             "suspended rounds buy one probe)")
+        if not 0.0 <= collapse_at <= lower_at < raise_at <= 1.0:
+            raise ValueError(
+                "adaptive thresholds must satisfy 0 <= collapse_at <= "
+                f"lower_at < raise_at <= 1 (got collapse_at="
+                f"{collapse_at}, lower_at={lower_at}, "
+                f"raise_at={raise_at}); the lower_at < raise_at gap is "
+                "the hysteresis band that keeps k from oscillating")
         for name, eng in (("draft", draft), ("target", target)):
             if eng.role == "prefill":
                 raise ValueError(f"{name} engine has role='prefill': "
@@ -147,6 +196,33 @@ class SpeculativeEngine:
         self._d = draft
         self._t = target
         self.k = k
+        # --- adaptive lookahead (ISSUE 18) -------------------------
+        # `k` stays the CEILING that fixes the verify executable's
+        # B*(k+1) row shape; `k_live` is the per-round horizon cap —
+        # purely host-side, so moving it compiles nothing.
+        self._adapt = bool(adapt_k)
+        self.k_min = int(k_min)
+        self.k_live = int(k)
+        self.adapt_window = int(adapt_window)
+        self.raise_at = float(raise_at)
+        self.lower_at = float(lower_at)
+        self.collapse_at = float(collapse_at)
+        self.probe_every = int(probe_every)
+        self._suspended = False          # cruising on target.step()
+        self._suspended_rounds = 0       # cruise rounds since suspend
+        self._probe_next = False         # force a probe next round
+        self._rounds_windowed = 0        # proposing rounds since eval
+        self._adjusts = 0                # spec_k_adjust evaluations
+        self._last_window_accept: Optional[float] = None
+        # draft hot-swap provenance (tentpole b): records pair
+        # accept-before with an accept-after measured over the next
+        # `adapt_window` proposing rounds (cumulative counters, so it
+        # works with adaptation off too)
+        self._swaps = 0
+        self._swap_records: List[Dict[str, object]] = []
+        self._pending_swap: Optional[Dict[str, object]] = None
+        self._swap_base = (0, 0)         # (accepted, proposed) at swap
+        self._swap_rounds = 0            # proposing rounds since swap
         # draft fallback reason (None while speculating); a degraded
         # draft turns every subsequent step() into target.step() —
         # tokens stay bit-identical because the target's row state is
@@ -172,6 +248,19 @@ class SpeculativeEngine:
             "draft proposals rejected at verify (draft compute spent, "
             "no token emitted from it)",
             labelnames=("engine", "draft")).labels(**labels)
+        # adaptation input: one observation per proposing round, the
+        # round's accepted/proposed fraction. Observed UNGATED like the
+        # target's _m_lat (core bookkeeping — the k ladder must keep
+        # working under BIGDL_OBS=off); consumes host ints only, zero
+        # device syncs. 0.05-wide buckets bound the windowed-median
+        # estimate the thresholds compare against.
+        self._m_accept_frac = reg.histogram(
+            "serving_spec_accept_fraction",
+            "per-round accepted/proposed fraction (adaptive-lookahead "
+            "window input, ISSUE 18)",
+            labelnames=("engine", "draft"),
+            buckets=tuple(i / 20 for i in range(21))).labels(**labels)
+        self._accept_window = HistogramWindow(self._m_accept_frac)
 
     # ------------------------------------------------- delegated surface
     @property
@@ -253,6 +342,12 @@ class SpeculativeEngine:
         return self._t
 
     @property
+    def swap_records(self) -> List[Dict[str, object]]:
+        """Hot-swap provenance (ISSUE 18): one record per swap_draft
+        with accept_before/accept_after — copies, in swap order."""
+        return [dict(r) for r in self._swap_records]
+
+    @property
     def fallback(self) -> Optional[str]:
         """None while speculating; else why the wrapper now drives
         the target's own single-token step."""
@@ -321,6 +416,15 @@ class SpeculativeEngine:
         denom = s["proposed"]
         h["speculative"] = {
             "k": self.k,
+            "k_live": self.k_live,
+            "k_min": self.k_min,
+            "adaptive": self._adapt,
+            "suspended": self._suspended,
+            "k_adjusts": self._adjusts,
+            "window_accept": self._last_window_accept,
+            "swaps": self._swaps,
+            "last_swap": (dict(self._swap_records[-1])
+                          if self._swap_records else None),
             "fallback": self._fallback,
             "rounds": s["spec_rounds"],
             "draft_steps": s["draft_steps"],
@@ -379,6 +483,17 @@ class SpeculativeEngine:
         self._lag[slot] = 0
         return True
 
+    def _seq_token(self, slot: int, pos: int) -> int:
+        """Token at absolute position `pos` of the target's ACCEPTED
+        sequence (prompt, then emitted tokens) — the catch-up replay
+        input. Every accepted token is the target's own sample, so
+        this is exactly what a target-only run holds at `pos`."""
+        req = self._t._req[slot]
+        lp = len(req.prompt)
+        if pos < lp:
+            return int(req.prompt[pos])
+        return int(self._t._gen[slot][pos - lp])
+
     def _release_mirror(self, slot: int, poisoned: bool = False) -> None:
         if self._d._req[slot] is not None:
             # the quiet engine-side release: no terminal, no counter
@@ -404,6 +519,101 @@ class SpeculativeEngine:
         obs.emit_event("spec_fallback", plane="serving",
                        engine=self._t.obs_name,
                        draft_engine=self._d.obs_name, reason=reason)
+
+    # ------------------------------------ adaptive lookahead (ISSUE 18)
+    def _evaluate_k(self) -> None:
+        """One ladder evaluation: compare the HistogramWindow median of
+        per-round accept fractions against the thresholds, move k_live
+        one rung (hysteresis: the lower_at..raise_at band holds), or
+        suspend/resume. Emits `spec_k_adjust` per evaluation — the
+        event sequence IS obs_report's k-timeline. Pure host-side: no
+        device work, no new executables."""
+        accept = self._accept_window.quantile(0.5)
+        self._rounds_windowed = 0
+        if accept is None:
+            return                      # window saw no proposals: hold
+        k_from = self.k_live
+        if self._suspended:
+            if accept >= self.raise_at:
+                # probe cleared the resume bar: speculate again from
+                # the floor; later evaluations climb the ladder
+                self._suspended = False
+                self._suspended_rounds = 0
+        elif accept < self.collapse_at:
+            # straight drop: a collapsed draft makes every verify row
+            # past j=0 waste — stop paying for the verify pass at all
+            self.k_live = self.k_min
+            self._suspended = True
+            self._suspended_rounds = 0
+        elif accept < self.lower_at:
+            self.k_live = max(self.k_min, self.k_live - 1)
+        elif accept >= self.raise_at:
+            self.k_live = min(self.k, self.k_live + 1)
+        self._adjusts += 1
+        self._last_window_accept = round(float(accept), 4)
+        obs.emit_event("spec_k_adjust", plane="serving",
+                       engine=self._t.obs_name,
+                       draft_engine=self._d.obs_name,
+                       round=self._stats["spec_rounds"],
+                       k_from=k_from, k_to=self.k_live,
+                       accept=self._last_window_accept,
+                       suspended=self._suspended,
+                       window=self.adapt_window)
+
+    def _settle_swap(self) -> None:
+        """Fill the open swap record's accept_after from the proposing
+        rounds since the swap (cumulative counters, so this works with
+        adaptation off too) and close it."""
+        rec = self._pending_swap
+        acc0, prop0 = self._swap_base
+        dprop = self._stats["proposed"] - prop0
+        if dprop:
+            rec["accept_after"] = round(
+                (self._stats["accepted"] - acc0) / dprop, 4)
+        self._pending_swap = None
+
+    def swap_draft(self, variables, source: str = "distill") -> None:
+        """Hot-swap improved draft weights into the live draft engine
+        (tentpole b): `InferenceEngine.swap_params` re-places the new
+        variables over the SAME serving layout (param-layout spine) —
+        zero new executables, no quiesce, requests in flight keep
+        decoding. Tokens cannot move: acceptance is coupled sampling,
+        so draft bits change ONLY the accept rate. Emits `draft_swap`
+        with accept_before; accept_after lands on the swap record (and
+        health()["speculative"]["last_swap"]) after the next
+        `adapt_window` proposing rounds. A fresh accept window opens so
+        pre-swap observations never dilute the post-swap ladder."""
+        if self._fallback is not None:
+            raise RuntimeError(
+                f"swap_draft after fallback ({self._fallback}): the "
+                "draft is quiesced — build a fresh wrapper instead")
+        s = self._stats
+        before = self._last_window_accept
+        if before is None and s["proposed"]:
+            before = round(s["accepted"] / s["proposed"], 4)
+        if self._pending_swap is not None:
+            self._settle_swap()         # back-to-back swaps: close out
+        self._d.swap_params(variables)
+        self._swaps += 1
+        rec: Dict[str, object] = {
+            "swap": self._swaps, "round": s["spec_rounds"],
+            "accept_before": before, "accept_after": None,
+            "source": source}
+        self._swap_records.append(rec)
+        self._pending_swap = rec
+        self._swap_base = (s["accepted"], s["proposed"])
+        self._swap_rounds = 0
+        # drain the delta window: post-swap evaluations measure the
+        # NEW draft only
+        self._accept_window.quantile(0.5)
+        self._rounds_windowed = 0
+        if self._adapt and self._suspended:
+            self._probe_next = True     # audition the new draft now
+        obs.emit_event("draft_swap", plane="serving",
+                       engine=self._t.obs_name,
+                       draft_engine=self._d.obs_name,
+                       swap=self._swaps, accept_before=before,
+                       round=s["spec_rounds"], source=source)
 
     # -------------------------------------------------------- dispatches
     def _draft_dispatch(self, tok, pos, nout, table, slow_s: float):
@@ -491,14 +701,37 @@ class SpeculativeEngine:
             self._enter_fallback(f"draft degraded ({d.degraded})",
                                  watchdog=False)
             return t.step()
+        if self._adapt and self._suspended:
+            # acceptance collapsed: cruise on the target's own step()
+            # (true target-only cost — the verify pass, not k_live,
+            # is the speculation tax, and only skipping it zeroes the
+            # bill). One probe round per `probe_every` re-measures.
+            self._suspended_rounds += 1
+            if not (self._probe_next
+                    or self._suspended_rounds % self.probe_every == 0):
+                return t.step()
+            self._probe_next = False
         t._admit()
         for i, req in enumerate(t._req):
             if req is not None and self._mirror_ids[i] != req.id:
+                if self._mirror_ids[i] is not None:
+                    # stale shadow: the slot turned over during
+                    # suspended cruise rounds (terminals there happen
+                    # inside t.step(), which never touches mirrors)
+                    self._release_mirror(i)
                 if not self._mirror_slot(i):
                     self._enter_fallback(
                         "draft pool exhausted mirroring admission",
                         watchdog=False)
                     return t.step()
+        if self._adapt:
+            # cruise rounds advance the target while the draft shadow
+            # idles — recompute the lag from positions (the invariant
+            # the incremental bookkeeping maintains in steady state;
+            # identical for the lag<=1 cases, general after a cruise)
+            for i, req in enumerate(t._req):
+                if req is not None and self._mirror_ids[i] == req.id:
+                    self._lag[i] = int(t._pos[i]) - int(d._pos[i])
         B = t.slots
         # per-slot horizons: how many proposals this round may verify.
         # A lagging slot's catch-up step does NOT shrink its horizon:
@@ -513,7 +746,9 @@ class SpeculativeEngine:
                 continue
             head = t.cache_len - 1 - int(t._pos[i])
             remaining = req.max_new_tokens - len(t._gen[i])
-            horizons[i] = max(0, min(k, head, remaining))
+            # k_live (== k unless adapt_k moved it) caps the horizon —
+            # host-side only; verify rows stay B*(k+1)
+            horizons[i] = max(0, min(self.k_live, head, remaining))
         done = t._ensure_blocks(horizons)
         for i in range(B):
             if t._req[i] is None and self._mirror_ids[i] is not None:
@@ -577,10 +812,17 @@ class SpeculativeEngine:
             self._stats["draft_steps"] += 1
             for i in live:
                 if s < int(self._lag[i]):
-                    # catch-up wrote the already-known token; the
-                    # chain resumes from the target's current
-                    ctok[i] = int(t._tok[i])
-                    cpos[i] = int(t._pos[i])
+                    # catch-up wrote the already-known token at cpos;
+                    # the chain advances along the ACCEPTED sequence
+                    # (prompt + target gen) — for the classic lag-1
+                    # case the next input IS the target's current
+                    # (t._tok, t._pos), bitwise the old single-step
+                    # catch-up; larger lags (post-cruise probes,
+                    # ISSUE 18) replay the intermediate tokens the
+                    # target emitted while the shadow idled
+                    p1 = int(cpos[i]) + 1
+                    ctok[i] = self._seq_token(i, p1)
+                    cpos[i] = p1
                 else:
                     j = s - int(self._lag[i])
                     proposals[i, j] = int(nxt[i])
@@ -725,4 +967,19 @@ class SpeculativeEngine:
                        step=stepno, active=len(active),
                        proposed=round_prop, accepted=round_acc,
                        emitted=round_emit)
+        if round_prop:
+            # one window observation per PROPOSING round (host ints
+            # only; ungated — see the histogram's ctor comment)
+            self._m_accept_frac.observe(round_acc / round_prop)
+            self._rounds_windowed += 1
+            self._swap_rounds += 1
+        if self._pending_swap is not None \
+                and self._swap_rounds >= self.adapt_window:
+            self._settle_swap()
+        if self._adapt and (self._suspended
+                            or self._rounds_windowed >= self.adapt_window):
+            # suspended probes evaluate immediately (the window holds
+            # exactly the probe round); live speculation evaluates
+            # every adapt_window proposing rounds
+            self._evaluate_k()
         return done

@@ -76,54 +76,28 @@ from __future__ import annotations
 import weakref
 from typing import Any, Dict, Optional, Tuple
 
-import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from bigdl_tpu.models.transformer import TransformerLM
+# the column-shard table, spec derivation and gather form live in the
+# param-layout spine (ISSUE 18); this module keeps the serving-plane
+# names and adds the mesh PLACEMENT the spine stays agnostic of
+from bigdl_tpu.parallel.param_layout import (gather_tree,
+                                             tp_serving_block_specs,
+                                             tp_serving_specs)
 from bigdl_tpu.parallel.shard_map_compat import shard_map
 from bigdl_tpu.parallel.tensor_parallel import shard_params
-
-# per-layer serving-layout leaves: which are column-sharded (last dim)
-_COL = frozenset({"wq", "wk", "wv", "w1"})
-_COL_BIAS = frozenset({"bq", "bk", "bv", "b1"})
-
-
-def tp_serving_block_specs(axis: str = "model") -> Dict[str, Any]:
-    """PartitionSpecs for ONE per-layer serving block (the unstacked
-    dict `serving_params` produces). wq/wk/wv split by head column,
-    w1 by ffn hidden; wo/w2/ln/biases-of-row-gemms replicated (the
-    bit-identity construction, module docstring)."""
-    spec: Dict[str, Any] = {}
-    for k in ("ln1_g", "ln1_b", "ln2_g", "ln2_b", "wo", "bo", "w2",
-              "b2"):
-        spec[k] = P()
-    for k in _COL:
-        spec[k] = P(None, axis)
-    for k in _COL_BIAS:
-        spec[k] = P(axis)
-    return spec
-
-
-def tp_serving_specs(params, axis: str = "model") -> Dict[str, Any]:
-    """Spec pytree matching a serving-layout param tree (per-layer
-    tuple of blocks, as `TransformerLM.serving_params` returns).
-    Derived from the tree's own structure so checkpoint-loaded trees
-    reshard without the model object."""
-    block = tp_serving_block_specs(axis)
-    specs: Dict[str, Any] = {
-        k: P() for k in params if k != "blocks"}
-    specs["blocks"] = tuple(block for _ in params["blocks"])
-    return specs
 
 
 def gather_serving_params(params):
     """Host (checkpoint) form of a possibly-sharded serving-layout
     tree: every leaf fetched as a GLOBAL numpy array. The inverse of
     `shard_serving_params` — placement round-trips bitwise across tp
-    degrees because the mesh only places values, never changes them."""
-    return jax.tree_util.tree_map(np.asarray, params)
+    degrees because the mesh only places values, never changes them.
+    (= the spine's `gather_tree`; this name is the serving-plane
+    surface the hot-swap/resharding docs point at.)"""
+    return gather_tree(params)
 
 
 def shard_serving_params(mesh: Mesh, params, axis: str = "model"):

@@ -1174,6 +1174,141 @@ def drill_serve_spec(workdir):
             "events": json.loads(d1)["events"]}
 
 
+def drill_spec_adapt(workdir):
+    """ISSUE 18: the speculation flywheel closes its loop, twice. A
+    6-request burst (greedy + seeded sampling) runs through an
+    ADAPTIVE SpeculativeEngine whose draft is the stock random-init
+    tiny LM — a planted accept collapse (~0.22 cumulative, far below
+    collapse_at=0.35): a window evaluation drops k_live to k_min=1 and
+    SUSPENDS speculation, and every later round cruises target-only
+    (probe_every is set past the burst), so the hostile workload pays
+    ~0 speculation tax while tokens stay BIT-IDENTICAL to an
+    undisturbed target-only run. Between bursts a DraftDistiller-
+    trained draft — distilled ONCE outside the drilled runs, from the
+    target-only reference streams (the fleet's own emitted tokens),
+    warm-started from the serving draft's exact init — is hot-swapped
+    in: pure re-placement, zero new executables, no quiesce. The swap
+    arms a probe; burst 2's first round auditions the new draft, the
+    windowed accept clears raise_at=0.6 (distilled ~0.97 on the
+    probe), speculation RESUMES and the ladder climbs off the floor
+    back to the k=3 ceiling. The burst's TAIL may re-collapse (the
+    last windows see near-empty co-batches of the hardest sampled
+    requests — adaptation reacting exactly as designed), so the
+    assertions read the k-timeline, not the final snapshot; the
+    digest pins the whole trajectory byte-identically either way. The
+    swap record's accept_after must beat accept_before; burst-2
+    tokens are still bitwise the target's (coupled sampling — draft
+    bits move ONLY the accept rate). Zero requests lost; two runs
+    byte-identical in the leg digest (event counts, statuses, tokens,
+    k-timeline, swap records, speculation tallies)."""
+    import jax
+
+    from bigdl_tpu.models.transformer import build_lm
+    from bigdl_tpu.serving import (DraftDistiller, InferenceEngine,
+                                   SpeculativeEngine)
+
+    specs1 = [dict(prompt=[i + 1, i + 2, i + 3], max_new_tokens=8,
+                   temperature=(0.8 if i % 2 else 0.0), seed=70 + i)
+              for i in range(6)]
+    specs2 = [dict(prompt=[i + 2, i + 4, i + 6], max_new_tokens=8,
+                   temperature=(0.8 if i % 2 else 0.0), seed=90 + i)
+              for i in range(6)]
+    ref_eng = _engine(slots=2)
+    ref1 = ref_eng.run([_req(**s) for s in specs1])
+    ref2 = ref_eng.run([_req(**s) for s in specs2])
+
+    # distill the better draft ONCE, outside the drilled runs: a
+    # PRIVATE model (same arch + init key as _SERVE_DRAFT_LM, so the
+    # shared serving draft's variables are never touched) warm-starts
+    # the flywheel from the serving draft's exact weights, trained on
+    # the target-only reference streams
+    dmodel = build_lm(vocab_size=50, dim=16, num_heads=2,
+                      num_layers=1, max_len=64)
+    dmodel.build(jax.random.PRNGKey(7))
+    distiller = DraftDistiller(dmodel, seq_len=8, epochs=6, seed=0)
+    for r in ref1:
+        distiller.ingest(r)
+    new_vars = distiller.distill()
+
+    def run():
+        with _telemetry() as log:
+            draft = InferenceEngine(_serve_draft_lm(), slots=2,
+                                    prefill_buckets=(8,),
+                                    obs_label="adapt_d")
+            target = _engine(obs_label="adapt_t")
+            eng = SpeculativeEngine(draft, target, k=3, adapt_k=True,
+                                    adapt_window=2, raise_at=0.6,
+                                    lower_at=0.45, collapse_at=0.35,
+                                    probe_every=10_000)
+            got1 = eng.run([_req(**s) for s in specs1])
+            mid = dict(eng.health()["speculative"])
+            eng.swap_draft(new_vars, source="distill")
+            got2 = eng.run([_req(**s) for s in specs2])
+            h = eng.health()["speculative"]
+            adjusts = log.events("spec_k_adjust")
+            swap_ev = log.events("draft_swap")
+            failed_ev = log.events("request_terminal", status="failed")
+            done_ev = log.events("request_terminal", status="done")
+            digest = json.dumps({
+                "events": log.counts_by_kind(),
+                "statuses": [r.status for r in got1 + got2],
+                "tokens": [r.tokens for r in got1 + got2],
+                "k_timeline": [{k: e[k] for k in
+                                ("k_from", "k_to", "accept",
+                                 "suspended")} for e in adjusts],
+                "swaps": eng.swap_records,
+                "spec": {k: h[k] for k in
+                         ("rounds", "proposed", "accepted", "emitted",
+                          "k_live", "suspended", "k_adjusts", "swaps",
+                          "window_accept")},
+            }, sort_keys=True)
+        return eng, got1, got2, mid, h, digest, (adjusts, swap_ev,
+                                                 failed_ev, done_ev)
+
+    eng1, got1, got2, mid, h1, d1, (adjusts, swap_ev, failed_ev,
+                                    done_ev) = run()
+    _, _, _, _, _, d2, _ = run()
+
+    bit1 = [g.tokens for g in got1] == [r.tokens for r in ref1]
+    bit2 = [g.tokens for g in got2] == [r.tokens for r in ref2]
+    rec = eng1.swap_records[0] if eng1.swap_records else {}
+    swap_round = swap_ev[0]["round"] if swap_ev else -1
+    pre = [e for e in adjusts if e["round"] <= swap_round]
+    post = [e for e in adjusts if e["round"] > swap_round]
+    ok = (all(g.status == "done" for g in got1 + got2)
+          and len(failed_ev) == 0               # zero requests lost
+          and len(done_ev) == 12
+          and bit1 and bit2
+          # burst 1 collapsed: floor + suspended, and the k-timeline
+          # records the drop
+          and mid["suspended"] and mid["k_live"] == 1
+          and any(e["k_to"] == 1 and e["suspended"] for e in pre)
+          # the swapped-in draft's probe clears the resume bar and the
+          # ladder climbs off the floor
+          and len(swap_ev) == 1
+          and any(not e["suspended"] and e["accept"] >= 0.6
+                  for e in post)
+          and any(e["k_to"] > 1 for e in post)
+          and rec.get("accept_after") is not None
+          and rec.get("accept_before") is not None
+          and rec["accept_after"] > rec["accept_before"]
+          and eng1.fallback is None             # never a draft outage
+          and d1 == d2)
+    return {"ok": bool(ok),
+            "statuses": [g.status for g in got1 + got2],
+            "bit_identical_to_target_only": bit1 and bit2,
+            "collapsed_mid_run": {"k_live": mid["k_live"],
+                                  "suspended": mid["suspended"]},
+            "final": {"k_live": h1["k_live"],
+                      "suspended": h1["suspended"],
+                      "window_accept": h1["window_accept"]},
+            "swap": rec,
+            "k_adjusts": len(adjusts),
+            "requests_lost": len(failed_ev),
+            "report_byte_identical": d1 == d2,
+            "events": json.loads(d1)["events"]}
+
+
 # ------------------------------------------------------------ fleet legs
 
 def drill_fleet_failover(workdir):
@@ -1863,6 +1998,7 @@ SERVING_LEGS = {
     "serve_prefix": drill_serve_prefix,
     "serve_spill": drill_serve_spill,
     "serve_spec": drill_serve_spec,
+    "spec_adapt": drill_spec_adapt,
     "fleet_failover": drill_fleet_failover,
     "fleet_affinity_failover": drill_fleet_affinity_failover,
     "fleet_drain": drill_fleet_drain,

@@ -160,7 +160,7 @@ def _pctl(xs: List[float], q: float) -> Optional[float]:
 
 def replay(router, trace: dict, *, clock: Dict[str, float],
            step_dt: float = 0.25, autoscaler=None, observer=None,
-           max_rounds: int = 200_000) -> dict:
+           on_result=None, max_rounds: int = 200_000) -> dict:
     """Replay `trace` against `router` on the virtual clock.
 
     `clock` is the {"t": float} cell the router AND every engine (and
@@ -170,8 +170,12 @@ def replay(router, trace: dict, *, clock: Dict[str, float],
     called once per scheduling round after the step and the autoscale
     evaluation — the SLO plane's tick point (sampler.tick() +
     alert_engine.evaluate()), on the same virtual clock so two runs
-    stay byte-identical. Returns the load report (see _report);
-    deterministic for a fixed (router config, trace, step_dt)."""
+    stay byte-identical. `on_result` (ISSUE 18) is called once per
+    settled result in completion order — the speculation flywheel's
+    ingestion point (distiller corpus + swap cadence), between router
+    steps so a hot-swap lands while the engines are quiescent.
+    Returns the load report (see _report); deterministic for a fixed
+    (router config, trace, step_dt)."""
     from bigdl_tpu.serving import NoHealthyEngine, OverloadError
 
     from bigdl_tpu.serving import Request
@@ -220,6 +224,8 @@ def replay(router, trace: dict, *, clock: Dict[str, float],
             observer()
         for res in out:
             results[res.id] = res
+            if on_result is not None:
+                on_result(res)
             a = owner.get(res.id)
             if a is not None and a.session is not None \
                     and a.turn < sess["turns"] - 1:
@@ -329,6 +335,9 @@ def build_fleet(engines: int = 1, *, slots: int = 4,
                 max_engines: int = 4, evaluate_every_s: float = 1.0,
                 tp: Optional[int] = None, tp_axis: str = "model",
                 spec_draft: bool = False, spec_k: int = 4,
+                spec_adaptive: bool = False,
+                spec_adapt_window: int = 4,
+                spec_probe_every: int = 16,
                 host_blocks: Optional[int] = None,
                 affinity: bool = False):
     """Tiny-LM fleet for the CLI and the drills: a routed pool over
@@ -347,7 +356,11 @@ def build_fleet(engines: int = 1, *, slots: int = 4,
     virtual clock, same pool-wide compile discipline (one draft model
     object), tokens bitwise the spec_draft=False tokens (coupled
     acceptance, serving/speculative.py); `spec_k` is the per-round
-    draft lookahead.
+    draft lookahead. `spec_adaptive` (ISSUE 18) arms the adaptive-
+    lookahead ladder on every wrapper (`adapt_k=True` with the given
+    window/probe cadence): k_live follows the measured accept rate and
+    collapses to target-only cruise on hostile traffic — host-side
+    only, tokens and the compile contract unchanged.
 
     `host_blocks` (ISSUE 16) arms every engine's host-RAM spill tier
     (refcount-0 radix blocks park in pinned host arrays instead of
@@ -399,7 +412,10 @@ def build_fleet(engines: int = 1, *, slots: int = 4,
                                 prefill_buckets=prefill_buckets,
                                 block_size=block_size,
                                 clock=lambda: clk["t"])
-        return SpeculativeEngine(draft, eng, k=spec_k)
+        return SpeculativeEngine(draft, eng, k=spec_k,
+                                 adapt_k=spec_adaptive,
+                                 adapt_window=spec_adapt_window,
+                                 probe_every=spec_probe_every)
 
     router = EngineRouter([factory() for _ in range(engines)],
                           engine_factory=factory,
@@ -462,6 +478,30 @@ def main(argv=None) -> int:
                          "share); two runs stay byte-identical")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft lookahead per speculative round")
+    ap.add_argument("--spec-adaptive", action="store_true",
+                    help="adaptive lookahead (ISSUE 18; implies "
+                         "--spec-draft): each wrapper's k_live follows "
+                         "its windowed accept rate between 1 and "
+                         "--spec-k, collapsing to target-only cruise "
+                         "on hostile traffic; the 'spec' section gains "
+                         "the k trajectory; two runs stay "
+                         "byte-identical")
+    ap.add_argument("--spec-adapt-window", type=int, default=4,
+                    help="proposing rounds per ladder evaluation")
+    ap.add_argument("--spec-probe-every", type=int, default=16,
+                    help="suspended rounds between speculation probes")
+    ap.add_argument("--spec-distill", action="store_true",
+                    help="online draft distillation (ISSUE 18; implies "
+                         "--spec-draft): a background ZeRO-2 loop "
+                         "trains the draft on the run's own completed "
+                         "token streams and hot-swaps the improved "
+                         "weights into every wrapper (zero new "
+                         "executables); the 'spec' section gains the "
+                         "swap events (accept before/after); two runs "
+                         "stay byte-identical")
+    ap.add_argument("--spec-swap-every", type=int, default=16,
+                    help="completed results between distill+swap "
+                         "cycles")
     ap.add_argument("--host-blocks", type=int, default=None,
                     help="arm the host-RAM KV spill tier with this "
                          "many pinned host blocks per engine (ISSUE "
@@ -497,6 +537,8 @@ def main(argv=None) -> int:
     ap.add_argument("--json", default=None,
                     help="also write the report to this path")
     args = ap.parse_args(argv)
+    if args.spec_adaptive or args.spec_distill:
+        args.spec_draft = True           # flywheel knobs ride the pool
 
     # size the in-memory event ring to the trace BEFORE any engine
     # emits (ISSUE 11): the journeys rollup below reads the ring, and
@@ -545,7 +587,38 @@ def main(argv=None) -> int:
         autoscale=args.autoscale,
         target_p99_s=args.target_p99, max_engines=args.max_engines,
         tp=args.tp, spec_draft=args.spec_draft, spec_k=args.spec_k,
+        spec_adaptive=args.spec_adaptive,
+        spec_adapt_window=args.spec_adapt_window,
+        spec_probe_every=args.spec_probe_every,
         host_blocks=args.host_blocks, affinity=affinity)
+    # speculation flywheel (ISSUE 18): the distiller ingests every
+    # completed stream in completion order (deterministic under the
+    # virtual clock) and every --spec-swap-every results trains +
+    # hot-swaps the shared draft into each wrapper — pure
+    # re-placement, so the byte-identical acceptance holds
+    on_result = None
+    if args.spec_distill:
+        from bigdl_tpu.serving import DraftDistiller, SpeculativeEngine
+
+        spec_pool = [e for e in router.engines
+                     if isinstance(e, SpeculativeEngine)]
+        distiller = DraftDistiller(spec_pool[0].draft_engine.model,
+                                   seq_len=8, epochs=2, seed=args.seed)
+        fresh = [0]
+
+        def on_result(res):
+            if res.status != "done":
+                return
+            distiller.ingest(res)
+            fresh[0] += 1
+            if fresh[0] < args.spec_swap_every:
+                return
+            fresh[0] = 0
+            new_vars = distiller.distill()
+            for e in router.engines:
+                if isinstance(e, SpeculativeEngine) \
+                        and e.fallback is None:
+                    e.swap_draft(new_vars, source="loadgen-distill")
     # SLO plane (ISSUE 14): a sampler ticking once per scheduling
     # round plus declarative objectives/alerts over the same virtual
     # clock — pure function of the trace, so the byte-identical
@@ -590,7 +663,8 @@ def main(argv=None) -> int:
 
     report = replay(router, trace, clock=clk, step_dt=args.step_dt,
                     autoscaler=asc,
-                    observer=slo_observer if slo else None)
+                    observer=slo_observer if slo else None,
+                    on_result=on_result)
     if slo:
         sampler, aeng = slo
         sampler.sample()              # close the run-wide window
@@ -626,6 +700,40 @@ def main(argv=None) -> int:
         agg["draft_overhead_share"] = (
             round(agg["wasted"] / agg["proposed"], 4)
             if agg["proposed"] else None)
+        if args.spec_adaptive:
+            # k trajectory (ISSUE 18): the spec_k_adjust event stream
+            # in ring order — one entry per ladder evaluation; plus
+            # the final per-wrapper state. Host-side + rounded, so the
+            # section rides the byte-identical acceptance
+            agg["adaptive"] = {
+                "window": args.spec_adapt_window,
+                "probe_every": args.spec_probe_every,
+                "k_final": [e.k_live for e in router.engines
+                            if isinstance(e, SpeculativeEngine)],
+                "suspended_final": [
+                    e.health()["speculative"]["suspended"]
+                    for e in router.engines
+                    if isinstance(e, SpeculativeEngine)],
+                "k_trajectory": [
+                    {"engine": ev.get("engine"),
+                     "round": ev.get("round"),
+                     "k_from": ev.get("k_from"),
+                     "k_to": ev.get("k_to"),
+                     "accept": ev.get("accept"),
+                     "suspended": ev.get("suspended")}
+                    for ev in obs.get_event_log().events()
+                    if ev.get("kind") == "spec_k_adjust"],
+            }
+        if args.spec_distill:
+            # swap events (ISSUE 18): per-wrapper hot-swap records
+            # with the accept rate before/after each swap
+            swaps = []
+            for e in router.engines:
+                if isinstance(e, SpeculativeEngine):
+                    swaps.extend(dict(r, engine=e.obs_name)
+                                 for r in e.swap_records)
+            agg["swaps"] = sorted(
+                swaps, key=lambda r: (r["engine"], r["swap"]))
         report["spec"] = agg
     # journey rollup (ISSUE 11): the CLI runs with the default event
     # log armed, so the trace/hop stamps are already there — report

@@ -451,7 +451,9 @@ def _speculation_section(events: List[dict]) -> Optional[dict]:
     target-only decode)."""
     rounds = [e for e in events if e.get("kind") == "spec_verify"]
     fallbacks = [e for e in events if e.get("kind") == "spec_fallback"]
-    if not (rounds or fallbacks):
+    adjusts = [e for e in events if e.get("kind") == "spec_k_adjust"]
+    swaps = [e for e in events if e.get("kind") == "draft_swap"]
+    if not (rounds or fallbacks or adjusts or swaps):
         return None
     per_engine: Dict[str, dict] = {}
     for e in rounds:
@@ -476,6 +478,33 @@ def _speculation_section(events: List[dict]) -> Optional[dict]:
                              "draft": e.get("draft_engine"),
                              "reason": e.get("reason")}
                             for e in fallbacks]
+    if adjusts:
+        # the adaptive-lookahead k-timeline (ISSUE 18): one entry per
+        # ladder evaluation, in event order — obs_report's view of the
+        # flywheel's k trajectory
+        out["k_timeline"] = [
+            {"engine": e.get("engine"), "round": e.get("round"),
+             "k_from": e.get("k_from"), "k_to": e.get("k_to"),
+             "accept": e.get("accept"),
+             "suspended": e.get("suspended")}
+            for e in adjusts]
+    if swaps:
+        # swap markers: accept_after is measured AFTER the event is
+        # emitted, so pair each swap with its engine's NEXT ladder
+        # evaluation (events are immutable)
+        out["swaps"] = []
+        for e in swaps:
+            after = next(
+                (a.get("accept") for a in adjusts
+                 if a.get("engine") == e.get("engine")
+                 and a.get("seq", 0) > e.get("seq", 0)), None)
+            out["swaps"].append(
+                {"engine": e.get("engine"),
+                 "draft": e.get("draft_engine"),
+                 "swap": e.get("swap"), "round": e.get("round"),
+                 "source": e.get("source"),
+                 "accept_before": e.get("accept_before"),
+                 "accept_after": after})
     return out
 
 
@@ -713,7 +742,25 @@ def render(events: List[dict], tail: int = 15) -> str:
         for f in sp.get("fallbacks", []):
             rows.append((f"{f['engine']} FALLBACK",
                          f"draft {f['draft']} lost: {f['reason']}"))
+        for w in sp.get("swaps", []):
+            aft = "-" if w["accept_after"] is None \
+                else f"{w['accept_after']:.2%}"
+            bef = "-" if w["accept_before"] is None \
+                else f"{w['accept_before']:.2%}"
+            rows.append((f"{w['engine']} SWAP #{w['swap']}",
+                         f"round {w['round']} ({w['source']}): "
+                         f"accept {bef} -> {aft}"))
         lines.append(_fmt_table(rows))
+        if sp.get("k_timeline"):
+            kt = sp["k_timeline"]
+            traj = " ".join(
+                f"{e['k_from']}->{e['k_to']}"
+                + ("S" if e.get("suspended") else "")
+                for e in kt[:24])
+            if len(kt) > 24:
+                traj += f" … (+{len(kt) - 24} more)"
+            lines.append(f"  k-timeline ({len(kt)} evaluations): "
+                         f"{traj}")
     if "faults" in s:
         lines.append("\ninjected faults: " + ", ".join(s["faults"]))
     if "checkpoints" in s:

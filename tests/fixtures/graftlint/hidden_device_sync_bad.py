@@ -102,3 +102,23 @@ def quantize_serving_params(params):
 
 def repack_weight(w, scale_leaf):
     return w, scale_leaf.item()  # BAD
+
+
+# ISSUE 18 speculation flywheel: swap/distill/adapt paths run BETWEEN
+# decode rounds on a LIVE engine — the hot-swap is re-placement over
+# tree metadata and the k ladder is host arithmetic; any fetch here
+# stalls serving once per swap or per evaluation
+def swap_params(engine, variables):
+    return np.asarray(variables["params"]["embed"])  # BAD
+
+
+def swap_draft(spec, leaves):
+    return [leaf.item() for leaf in leaves]  # BAD
+
+
+def distill_round(corpus, params_leaf):
+    return corpus, jax.device_get(params_leaf)  # BAD
+
+
+def adapt_lookahead(window_leaf, k_live):
+    return min(k_live, int(window_leaf.item()))  # BAD

@@ -138,3 +138,31 @@ def quantize_serving_params(params, quantize_fn):
 
 def quant_params_bytes(leaves):
     return sum(leaf.size * leaf.dtype.itemsize for leaf in leaves)
+
+
+# ISSUE 18 speculation flywheel: the swap checks STRUCTURE and leaf
+# metadata (shapes), never values; the adaptive ladder consumes
+# already-fetched host ints from the accept histogram
+def swap_params(engine, old_params, new_params, tree_structure):
+    if tree_structure(new_params) != tree_structure(old_params):
+        raise ValueError("layout changed")
+    return new_params
+
+
+def swap_draft(spec, new_vars, accept_before):
+    spec.draft.swap(new_vars)
+    return {"accept_before": accept_before, "accept_after": None}
+
+
+def distill_corpus(streams, seq_len):
+    return [s[i:i + seq_len + 1] for s in streams
+            for i in range(0, max(1, len(s) - seq_len), seq_len)]
+
+
+def adapt_lookahead(window_accept, k_live, k_min, k_max, raise_at,
+                    lower_at):
+    if window_accept >= raise_at:
+        return min(k_max, k_live + 1)
+    if window_accept < lower_at:
+        return max(k_min, k_live - 1)
+    return k_live

@@ -68,6 +68,7 @@ def test_elastic_drill_leg(tmp_path, leg):
                                  "serve_deadline", "serve_retry",
                                  "serve_watchdog", "serve_prefix",
                                  "serve_spill", "serve_spec",
+                                 "spec_adapt",
                                  "fleet_failover",
                                  "fleet_affinity_failover", "fleet_drain",
                                  "fleet_autoscale",
@@ -80,9 +81,12 @@ def test_serving_drill_leg(tmp_path, leg):
     fleet drills (failover bit-identity — including across sharding
     layouts, drain, SLO autoscaling), the observability drill (request
     journeys across handoff/failover with byte-identical
-    flight-recorder bundles) and the live-SLO drill (burn-rate alert
+    flight-recorder bundles), the live-SLO drill (burn-rate alert
     fires and resolves deterministically with a byte-identical
-    slo_burn bundle) run bit-deterministically on every tier-1 pass.
+    slo_burn bundle) and the ISSUE 18 speculation-flywheel drill
+    (planted accept collapse suspends speculation with tokens bitwise
+    target-only; a distilled hot-swapped draft resumes it) run
+    bit-deterministically on every tier-1 pass.
     Legs must actually DRILL here: the CPU-mesh conftest gives them 8
     devices, so the device-count skip escape is asserted shut."""
     fd = _load_drill()

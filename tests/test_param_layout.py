@@ -1,0 +1,405 @@
+"""The param-layout spine (ISSUE 18 tentpole (c)) + the flywheel's
+compile/adaptation contracts.
+
+Four layout consumers used to hand-roll the same flatten/pad/shard/
+unstack algebra; `parallel/param_layout.py` now owns it once, and the
+original call sites delegate. Each rerouted path is pinned here
+against its pre-refactor form, hand-rolled in numpy:
+
+* ZeRO slices — `FlatParamSpec` flatten/unflatten round-trips bitwise
+  and `shard_slice` produces disjoint slices that cover the padded
+  vector exactly (the construction behind the zero2==zero1 pin);
+* checkpoint reshard — `repad_flat`/`adapt_flat_tree` convert a saved
+  world size's layout into this run's, and `concat_shard_trees` is the
+  bitwise load-side inverse of slicing;
+* serving repack — `unstack_blocks`/`map_block_leaves` reproduce the
+  stacked-(L, ...)-to-per-layer walk `TransformerLM.serving_params`
+  runs, leaf-for-leaf bitwise;
+* tp gather/shard — `tp_serving_block_specs`/`tp_serving_specs` emit
+  the exact column/replicated placement table `serving/tp.py` serves
+  under, and `gather_tree` round-trips to host bitwise.
+
+Also pinned: draft hot-swap is COMPILE-FREE (the engine `_TRACES`
+census stays flat across `swap_params`, a same-weights swap is
+token-invisible, and layout/shape mismatches are refused — never
+silently retraced), and the adaptive-k ladder's hysteresis (raise /
+hold / lower / collapse-to-suspend / probe-resume transitions,
+threshold validation, swap-record accept_before/after settling)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.models.transformer import build_lm
+from bigdl_tpu.parallel.param_layout import (
+    TP_COL, TP_COL_BIAS, FlatParamSpec, adapt_flat_tree,
+    concat_shard_trees, gather_tree, map_block_leaves, repad_flat,
+    tp_serving_block_specs, tp_serving_specs, unstack_blocks)
+from bigdl_tpu.serving import InferenceEngine, Request, SpeculativeEngine
+
+
+def _tree(seed=0):
+    """A small mixed-shape params pytree (total size NOT a multiple of
+    the shard counts below, so padding is actually exercised)."""
+    rng = np.random.RandomState(seed)
+    return {"w": jnp.asarray(rng.randn(3, 5), jnp.float32),
+            "b": jnp.asarray(rng.randn(7), jnp.float32),
+            "nested": {"g": jnp.asarray(rng.randn(2, 2, 2),
+                                        jnp.float32)}}
+
+
+# ------------------------------------------------------------ zero slices
+
+class TestFlatSpec:
+    def test_flatten_matches_handrolled(self):
+        tree = _tree()
+        spec = FlatParamSpec(tree, num_shards=4)
+        flat = np.asarray(spec.flatten(tree))
+        # pre-refactor form: ravel leaves in tree order, concat, pad
+        leaves = [np.asarray(l).ravel()
+                  for l in jax.tree_util.tree_leaves(tree)]
+        ref = np.concatenate(leaves)
+        assert spec.total == ref.size
+        assert spec.padded == ((ref.size + 3) // 4) * 4
+        assert spec.padded % 4 == 0 and spec.padded >= ref.size
+        np.testing.assert_array_equal(flat[:spec.total], ref)
+        np.testing.assert_array_equal(flat[spec.total:], 0.0)
+
+    def test_unflatten_roundtrip_bitwise(self):
+        tree = _tree()
+        spec = FlatParamSpec(tree, num_shards=3)
+        out = spec.unflatten(spec.flatten(tree))
+        assert jax.tree_util.tree_structure(out) \
+            == jax.tree_util.tree_structure(tree)
+        for a, b in zip(jax.tree_util.tree_leaves(out),
+                        jax.tree_util.tree_leaves(tree)):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_shard_slices_disjoint_cover(self):
+        tree = _tree()
+        spec = FlatParamSpec(tree, num_shards=4)
+        flat = spec.flatten(tree)
+        slices = [np.asarray(spec.shard_slice(flat, i))
+                  for i in range(4)]
+        assert all(s.size == spec.shard_size for s in slices)
+        # disjoint cover: concatenating the shards IS the flat vector
+        # — the all_gather-of-slices == replicated-vector construction
+        np.testing.assert_array_equal(np.concatenate(slices),
+                                      np.asarray(flat))
+
+
+# ------------------------------------------------------- ckpt reshard
+
+class TestReshard:
+    def test_repad_across_world_sizes(self):
+        tree = _tree()
+        old = FlatParamSpec(tree, num_shards=8)
+        new = FlatParamSpec(tree, num_shards=3)
+        flat8 = old.flatten(tree)
+        flat3 = repad_flat(flat8, old.total, new.padded)
+        assert flat3.shape == (new.padded,)
+        # real parameters survive bitwise; only padding moved
+        np.testing.assert_array_equal(np.asarray(flat3),
+                                      np.asarray(new.flatten(tree)))
+
+    def test_adapt_flat_tree_same_layout_passthrough(self):
+        tree = _tree()
+        spec = FlatParamSpec(tree, num_shards=4)
+        slots = {"m": spec.flatten(tree)}
+        meta = {"layout": "zero2_flat", "padded": spec.padded,
+                "total": spec.total}
+        assert adapt_flat_tree(slots, meta, spec) is slots
+
+    def test_adapt_flat_tree_resharded(self):
+        tree = _tree()
+        old = FlatParamSpec(tree, num_shards=8)
+        new = FlatParamSpec(tree, num_shards=3)
+        slots = {"m": old.flatten(tree), "v": old.flatten(tree)}
+        meta = {"layout": "zero1_flat", "padded": old.padded,
+                "total": old.total}
+        out = adapt_flat_tree(slots, meta, new)
+        for k in slots:
+            np.testing.assert_array_equal(
+                np.asarray(out[k]), np.asarray(new.flatten(tree)))
+
+    def test_adapt_flat_tree_local_pytree(self):
+        tree = _tree()
+        spec = FlatParamSpec(tree, num_shards=2)
+        slots = {"m": tree}           # LocalOptimizer pytree-per-slot
+        out = adapt_flat_tree(slots, {}, spec)
+        np.testing.assert_array_equal(np.asarray(out["m"]),
+                                      np.asarray(spec.flatten(tree)))
+
+    def test_concat_shards_inverts_slicing(self):
+        tree = _tree()
+        spec = FlatParamSpec(tree, num_shards=4)
+        flat = spec.flatten(tree)
+        parts = [{"m": np.asarray(spec.shard_slice(flat, i))}
+                 for i in range(4)]
+        out = concat_shard_trees(parts)
+        np.testing.assert_array_equal(out["m"], np.asarray(flat))
+
+
+# ------------------------------------------------------ serving repack
+
+class TestServingRepack:
+    def test_unstack_matches_handrolled(self):
+        rng = np.random.RandomState(1)
+        stacked = {"embed": jnp.asarray(rng.randn(5, 4), jnp.float32),
+                   "blocks": {"wq": jnp.asarray(rng.randn(3, 4, 4),
+                                                jnp.float32),
+                              "bq": jnp.asarray(rng.randn(3, 4),
+                                                jnp.float32)}}
+        blocks = unstack_blocks(stacked, num_layers=3)
+        assert isinstance(blocks, tuple) and len(blocks) == 3
+        for l in range(3):
+            # pre-refactor form: index the stack's leading dim
+            np.testing.assert_array_equal(
+                np.asarray(blocks[l]["wq"]),
+                np.asarray(stacked["blocks"]["wq"])[l])
+            np.testing.assert_array_equal(
+                np.asarray(blocks[l]["bq"]),
+                np.asarray(stacked["blocks"]["bq"])[l])
+        # per-layer layouts pass through untouched
+        assert unstack_blocks({"blocks": blocks}, 3) == blocks
+
+    def test_model_serving_params_routes_through_spine(self):
+        model = build_lm(vocab_size=20, dim=8, num_heads=2,
+                         num_layers=2, max_len=16)
+        model.build(jax.random.PRNGKey(3))
+        p = model.variables["params"]
+        sp = model.serving_params(model.variables)
+        assert isinstance(sp["blocks"], tuple) \
+            and len(sp["blocks"]) == 2
+        manual = unstack_blocks(p, 2)
+        for got, ref in zip(sp["blocks"], manual):
+            assert set(got) == set(ref)
+            for k in got:
+                np.testing.assert_array_equal(np.asarray(got[k]),
+                                              np.asarray(ref[k]))
+
+    def test_map_block_leaves(self):
+        model = build_lm(vocab_size=20, dim=8, num_heads=2,
+                         num_layers=2, max_len=16)
+        model.build(jax.random.PRNGKey(3))
+        sp = model.serving_params(model.variables)
+        seen = []
+        out = map_block_leaves(sp, lambda k, v: (seen.append(k), v)[1])
+        # identity walk rebuilds the tree bitwise; top-level entries
+        # pass through as the same objects
+        for k in sp:
+            if k != "blocks":
+                assert out[k] is sp[k]
+        for got, ref in zip(out["blocks"], sp["blocks"]):
+            for k in got:
+                np.testing.assert_array_equal(np.asarray(got[k]),
+                                              np.asarray(ref[k]))
+        assert len(seen) == sum(len(b) for b in sp["blocks"])
+
+    def test_map_block_leaves_refuses_stacked(self):
+        with pytest.raises(ValueError, match="per-layer serving"):
+            map_block_leaves({"blocks": {"wq": jnp.zeros((2, 3))}},
+                             lambda k, v: v)
+
+
+# ------------------------------------------------------------- tp spec
+
+class TestTpSpecs:
+    def test_block_spec_table(self):
+        from jax.sharding import PartitionSpec as P
+
+        spec = tp_serving_block_specs("model")
+        for k in TP_COL:
+            assert spec[k] == P(None, "model"), k
+        for k in TP_COL_BIAS:
+            assert spec[k] == P("model"), k
+        for k in ("wo", "bo", "w2", "b2", "ln1_g", "ln1_b", "ln2_g",
+                  "ln2_b"):
+            assert spec[k] == P(), k
+
+    def test_tree_specs_match_serving_layout(self):
+        from jax.sharding import PartitionSpec as P
+
+        model = build_lm(vocab_size=20, dim=8, num_heads=2,
+                         num_layers=2, max_len=16)
+        model.build(jax.random.PRNGKey(3))
+        sp = model.serving_params(model.variables)
+        specs = tp_serving_specs(sp, "model")
+        assert len(specs["blocks"]) == len(sp["blocks"])
+        for k in sp:
+            if k != "blocks":
+                assert specs[k] == P()
+        # the spec pytree must cover the param pytree leaf-for-leaf
+        for bp, bs in zip(sp["blocks"], specs["blocks"]):
+            assert set(bp) <= set(bs)
+
+    def test_gather_tree_roundtrip_bitwise(self):
+        tree = _tree(seed=2)
+        host = gather_tree(tree)
+        for a, b in zip(jax.tree_util.tree_leaves(host),
+                        jax.tree_util.tree_leaves(tree)):
+            assert isinstance(a, np.ndarray)
+            np.testing.assert_array_equal(a, np.asarray(b))
+
+
+# --------------------------------------------------- hot-swap contract
+
+_LM = None
+
+
+def _lm():
+    global _LM
+    if _LM is None:
+        _LM = build_lm(vocab_size=50, dim=16, num_heads=2,
+                       num_layers=1, max_len=64)
+        _LM.build(jax.random.PRNGKey(1))
+    return _LM
+
+
+class TestHotSwap:
+    def test_swap_is_compile_free_and_token_invisible(self):
+        from bigdl_tpu.serving.engine import _TRACES
+
+        eng = InferenceEngine(_lm(), slots=2, prefill_buckets=(8,))
+        reqs = lambda: [Request(prompt=[1, 2, 3], max_new_tokens=4),
+                        Request(prompt=[4, 5], max_new_tokens=4)]
+        ref = eng.run(reqs())
+        t0 = dict(_TRACES)
+        # same weights, fresh buffers: the swap must be invisible
+        copy = jax.tree_util.tree_map(jnp.array, _lm().variables)
+        eng.swap_params(copy)
+        assert eng.stats["weight_swaps"] == 1
+        got = eng.run(reqs())
+        assert [g.tokens for g in got] == [r.tokens for r in ref]
+        assert dict(_TRACES) == t0, "hot-swap must compile nothing"
+
+    def test_swap_new_weights_changes_tokens_not_executables(self):
+        from bigdl_tpu.serving.engine import _TRACES
+
+        eng = InferenceEngine(_lm(), slots=2, prefill_buckets=(8,))
+        reqs = lambda: [Request(prompt=[7, 8, 9], max_new_tokens=6)]
+        ref = eng.run(reqs())
+        other = build_lm(vocab_size=50, dim=16, num_heads=2,
+                         num_layers=1, max_len=64)
+        other.build(jax.random.PRNGKey(9))
+        t0 = dict(_TRACES)
+        eng.swap_params(other.variables)
+        got = eng.run(reqs())
+        assert [g.tokens for g in got] != [r.tokens for r in ref], \
+            "different weights must actually serve"
+        assert dict(_TRACES) == t0, "hot-swap must compile nothing"
+
+    def test_swap_refuses_different_config(self):
+        eng = InferenceEngine(_lm(), slots=2, prefill_buckets=(8,))
+        wide = build_lm(vocab_size=50, dim=32, num_heads=2,
+                        num_layers=1, max_len=64)
+        wide.build(jax.random.PRNGKey(2))
+        with pytest.raises(ValueError, match="hot-swap|shapes"):
+            eng.swap_params(wide.variables)
+
+
+# ------------------------------------------------------ adaptive ladder
+
+_TGT = None
+
+
+def _tgt_lm():
+    global _TGT
+    if _TGT is None:
+        _TGT = build_lm(vocab_size=50, dim=16, num_heads=2,
+                        num_layers=1, max_len=64)
+        _TGT.build(jax.random.PRNGKey(0))
+    return _TGT
+
+
+def _spec(**kw):
+    d = InferenceEngine(_lm(), slots=2, prefill_buckets=(8,))
+    t = InferenceEngine(_tgt_lm(), slots=2, prefill_buckets=(8,))
+    kw.setdefault("k", 4)
+    return SpeculativeEngine(d, t, **kw)
+
+
+class TestAdaptiveLadder:
+    """The hysteresis ladder is host arithmetic over the accept
+    window; drive `_evaluate_k` directly with planted window
+    observations — no decode required."""
+
+    @staticmethod
+    def _ev(eng, *vals):
+        for v in vals:
+            eng._m_accept_frac.observe(v)
+        eng._evaluate_k()
+
+    def test_ladder_transitions(self):
+        eng = _spec(adapt_k=True, adapt_window=2, raise_at=0.6,
+                    lower_at=0.3, collapse_at=0.1)
+        assert eng.k_live == 4                  # starts at the ceiling
+        self._ev(eng, 0.2, 0.2)                 # below lower_at: -1
+        assert eng.k_live == 3 and not eng._suspended
+        self._ev(eng, 0.4, 0.5)                 # hysteresis band: hold
+        assert eng.k_live == 3
+        self._ev(eng, 0.9, 0.8)                 # >= raise_at: +1
+        assert eng.k_live == 4
+        self._ev(eng, 0.9, 0.9)                 # ceiling caps at k
+        assert eng.k_live == 4
+        self._ev(eng, 0.05, 0.0)                # collapse: floor+suspend
+        assert eng.k_live == 1 and eng._suspended
+        self._ev(eng, 0.3)                      # probe below the bar
+        assert eng._suspended
+        self._ev(eng, 0.8)                      # probe clears: resume
+        assert not eng._suspended and eng.k_live == 1
+        self._ev(eng, 0.9, 0.9)                 # climbs off the floor
+        assert eng.k_live == 2
+        assert eng.health()["speculative"]["k_adjusts"] == 8
+
+    def test_empty_window_holds(self):
+        eng = _spec(adapt_k=True, adapt_window=2)
+        eng._evaluate_k()                       # no observations
+        assert eng.k_live == 4
+        assert eng.health()["speculative"]["k_adjusts"] == 0
+
+    def test_floor_respects_k_min(self):
+        eng = _spec(adapt_k=True, adapt_window=1, k_min=2,
+                    raise_at=0.6, lower_at=0.3, collapse_at=0.1)
+        for _ in range(5):
+            self._ev(eng, 0.2)                  # lower repeatedly
+        assert eng.k_live == 2                  # never below k_min
+        self._ev(eng, 0.0)                      # collapse → k_min
+        assert eng.k_live == 2 and eng._suspended
+
+    def test_threshold_validation(self):
+        with pytest.raises(ValueError, match="k_min"):
+            _spec(k=3, k_min=4)
+        with pytest.raises(ValueError, match="lower_at < raise_at"):
+            _spec(adapt_k=True, raise_at=0.5, lower_at=0.5)
+        with pytest.raises(ValueError, match="collapse_at"):
+            _spec(adapt_k=True, collapse_at=0.4, lower_at=0.3)
+        with pytest.raises(ValueError, match="adapt_window"):
+            _spec(adapt_k=True, adapt_window=0)
+        with pytest.raises(ValueError, match="probe_every"):
+            _spec(adapt_k=True, probe_every=0)
+
+    def test_swap_record_settles(self):
+        eng = _spec(adapt_k=False, adapt_window=2)
+        s = eng._stats
+        s["proposed"] += 10
+        s["accepted"] += 2                      # cumulative 0.2
+        eng.swap_draft(_lm().variables, source="unit")
+        rec = eng.swap_records[0]
+        assert rec["accept_before"] == 0.2
+        assert rec["accept_after"] is None      # not settled yet
+        s["proposed"] += 4
+        s["accepted"] += 3                      # post-swap 0.75
+        eng._settle_swap()
+        assert eng.swap_records[0]["accept_after"] == 0.75
+        h = eng.health()["speculative"]
+        assert h["swaps"] == 1
+        assert h["last_swap"]["accept_after"] == 0.75
+
+    def test_swap_refused_after_fallback(self):
+        eng = _spec()
+        eng._fallback = "draft watchdog"
+        with pytest.raises(RuntimeError, match="fallback"):
+            eng.swap_draft(_lm().variables)
