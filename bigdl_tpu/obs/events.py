@@ -109,29 +109,39 @@ EVENT_KINDS: Dict[str, dict] = {
     "request_submit": {
         "required": ("plane", "engine", "request", "prompt_len",
                      "priority", "tp", "role"),
-        "optional": ("trace", "hop"),
+        "optional": ("trace", "hop", "tenant"),
         "journey": True, "seat": True,
         "doc": "request admitted to an engine queue (initial dispatch, "
                "failover resubmission, rebalance move)"},
     "request_rejected": {
         "required": ("plane", "engine", "request", "queue_depth"),
-        "optional": ("trace", "hop"),
+        "optional": ("trace", "hop", "tenant"),
         "journey": True,
         "doc": "submission bounced off a full queue "
                "(overload_policy='reject')"},
     "request_terminal": {
         "required": ("plane", "engine", "request", "status", "reason",
                      "tokens", "ttft_s", "latency_s", "tp", "role"),
-        "optional": ("trace", "hop"),
+        "optional": ("trace", "hop", "tenant"),
         "journey": True,
         "doc": "request reached a terminal status "
                "(done/shed/expired/poisoned/failed)"},
     "prefix_hit": {
         "required": ("plane", "engine", "request", "matched_tokens",
                      "blocks", "prompt_len"),
-        "optional": ("trace", "hop"),
+        "optional": ("trace", "hop", "tenant"),
         "journey": True,
         "doc": "paged-KV prefix reuse at admission (ISSUE 8)"},
+    "tenant_throttled": {
+        "required": ("plane", "tenant", "action"),
+        "optional": ("router", "engine", "request", "queued"),
+        "doc": "a tenant's request was held back by ITS OWN isolation "
+               "contract (ISSUE 19): action 'defer' (token bucket "
+               "empty — waits for refill), 'shed' (deferred queue at "
+               "max_pending — terminal status 'shed'), or 'kv_quota' "
+               "(engine admission skipped it, exclusive KV blocks at "
+               "quota). Other tenants' traffic is untouched by "
+               "construction — the tenant_noisy drill pins it"},
     "prefix_evict": {
         "required": ("plane", "engine", "blocks"),
         "optional": (),
@@ -152,14 +162,14 @@ EVENT_KINDS: Dict[str, dict] = {
     "handoff_export": {
         "required": ("plane", "engine", "request", "prompt_len",
                      "blocks"),
-        "optional": ("trace", "hop"),
+        "optional": ("trace", "hop", "tenant"),
         "journey": True,
         "doc": "prefill-role engine detached a prefilled request "
                "(ISSUE 10)"},
     "handoff_import": {
         "required": ("plane", "engine", "request", "prompt_len",
                      "blocks", "source", "tp", "role"),
-        "optional": ("trace", "hop"),
+        "optional": ("trace", "hop", "tenant"),
         "journey": True, "seat": True,
         "doc": "serving engine seated a disaggregated-prefill package"},
     "spec_verify": {
@@ -249,9 +259,19 @@ EVENT_KINDS: Dict[str, dict] = {
     "autoscale_decision": {
         "required": ("plane", "router", "action"),
         "optional": ("t", "p99_s", "engines", "target_p99_s",
-                     "backlog", "occupancy", "objective", "q"),
-        "doc": "autoscaler acted on the SLO loop "
-               "(scale_up/scale_down/drain/shed_mode/restore_policy)"},
+                     "backlog", "occupancy", "objective", "q",
+                     "group"),
+        "doc": "autoscaler acted on the SLO loop (scale_up/scale_down/"
+               "drain/shed_mode/restore_policy/rebalance_groups)"},
+    "group_rebalance": {
+        "required": ("plane", "router", "from_group", "to_group",
+                     "action"),
+        "optional": ("engine",),
+        "doc": "capacity moved BETWEEN engine groups (ISSUE 19): "
+               "action 'move' = EngineRouter.move_engine retagged a "
+               "same-model engine compile-free; 'rebalance' = the "
+               "Autoscaler drained an idle group's engine and grew "
+               "the breaching group via its factory"},
     # ---- observability plane -------------------------------------------
     "metrics_snapshot": {
         "required": ("snapshot",),

@@ -20,9 +20,12 @@ from bigdl_tpu.serving.router import (EngineRouter, NoHealthyEngine,
                                       ROUTER_LATENCY_BUCKETS)
 from bigdl_tpu.serving.sampler import filter_logits, sample_logits
 from bigdl_tpu.serving.speculative import SpeculativeEngine
+from bigdl_tpu.serving.tenancy import (TenancyController, TenantSpec,
+                                       TokenBucket)
 from bigdl_tpu.serving.tp import (TPServingLM, gather_serving_params,
                                   shard_serving_params,
                                   tp_serving_model, tp_serving_specs)
+from bigdl_tpu.serving.vision import VisionEngine
 
 __all__ = [
     "InferenceEngine", "Request", "GenerationResult", "STATUSES",
@@ -30,6 +33,7 @@ __all__ = [
     "HandoffPackage", "EngineRouter", "NoHealthyEngine",
     "ROUTER_LATENCY_BUCKETS",
     "SpeculativeEngine", "DraftDistiller",
+    "TenancyController", "TenantSpec", "TokenBucket", "VisionEngine",
     "TPServingLM", "tp_serving_model", "tp_serving_specs",
     "gather_serving_params", "shard_serving_params",
     "Autoscaler", "BlockPool", "RadixPrefixCache",

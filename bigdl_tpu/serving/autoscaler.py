@@ -38,6 +38,18 @@ estimator ⇒ decisions bit-identical, pinned by fleet_autoscale), and
 the alert engine watches: at max_engines the shed-mode decision asks
 the objective, not local threshold math — one definition of "missing
 the SLO" across scaling and alerting.
+
+ISSUE 19: `group=` scopes every signal and lever to ONE model group
+of a heterogeneous fleet (membership, backlog, occupancy, the engine
+counts, drain target, policy flips), and adds a between-group lever:
+when the watched group is at `max_engines` and still missing its SLO,
+the scaler looks for an IDLE donor group (>= 2 healthy engines, zero
+backlog, occupancy under `occupancy_low`), drains the donor's newest
+engine and grows the breaching group through its factory — capacity
+moves to where the SLO burns instead of shedding first. Scale-to-zero
+for an idle group is deliberately not taken (a group always keeps one
+engine — deferred stretch). One Autoscaler watches one group; run one
+per group for full-fleet coverage.
 """
 
 from __future__ import annotations
@@ -72,7 +84,8 @@ class Autoscaler:
                  evaluate_every_s: float = 1.0, min_engines: int = 1,
                  max_engines: int = 4, backlog_high: float = 4.0,
                  occupancy_low: float = 0.25,
-                 flip_overload_policy: bool = True, objective=None):
+                 flip_overload_policy: bool = True, objective=None,
+                 group: str = "default"):
         if objective is not None:
             # ISSUE 14: one SLO definition for scaling AND alerting —
             # the scaler takes its target AND quantile from the shared
@@ -102,6 +115,7 @@ class Autoscaler:
         if not 1 <= min_engines <= max_engines:
             raise ValueError("need 1 <= min_engines <= max_engines")
         self.router = router
+        self.group = group
         self.target_p99_s = target_p99_s
         self.objective = objective
         self.evaluate_every_s = evaluate_every_s
@@ -120,6 +134,15 @@ class Autoscaler:
         self.decisions: List[dict] = []
 
     # ------------------------------------------------------------ signals
+    def _members(self) -> List:
+        """The watched group's serving engines, pool order."""
+        return [e for e in self.router.engines
+                if EngineRouter._group_of(e) == self.group]
+
+    def _healthy(self) -> List:
+        return [e for e in self._members()
+                if e.degraded is None and not e.draining]
+
     def _misses_target(self, p99: Optional[float]) -> bool:
         """Whether a measured windowed p99 misses the SLO (None — no
         completions — never misses): the shared objective when one is
@@ -130,15 +153,51 @@ class Autoscaler:
 
     # ------------------------------------------------------------ actions
     def _scale_up(self) -> str:
-        self.router.add_engine()
+        self.router.add_engine(group=self.group)
         return "scale_up"
 
+    def _rebalance_groups(self) -> Optional[str]:
+        """Between-group capacity movement (ISSUE 19): the watched
+        group is at max_engines and still burning — drain an IDLE
+        donor group's newest engine (the existing drain machinery
+        finishes it) and grow this group through its factory. None
+        when no group qualifies as a donor (then shed-mode is the
+        remaining lever)."""
+        factory = getattr(self.router, "engine_factory", None)
+        if not isinstance(factory, dict) or self.group not in factory:
+            return None           # cannot grow this group's model
+        for gname, members in sorted(self.router.groups.items()):
+            if gname == self.group:
+                continue
+            healthy = [e for e in members
+                       if e.degraded is None and not e.draining]
+            if len(healthy) < 2:
+                continue          # scale-to-zero is the deferred stretch
+            if any(e.queue_depth > 0 for e in healthy):
+                continue
+            slots = sum(e.slots for e in healthy)
+            occ = sum(e.slots_active for e in healthy) / max(slots, 1)
+            if occ >= self.occupancy_low:
+                continue
+            self._draining = healthy[-1]
+            self.router.drain(self._draining)
+            self.router.add_engine(group=self.group)
+            obs.emit_event("group_rebalance", plane="serving",
+                           router=self.router._obs_name,
+                           from_group=gname, to_group=self.group,
+                           action="rebalance",
+                           engine=self._draining.obs_name)
+            return "rebalance_groups"
+        return None
+
     def _shed_mode(self) -> str:
+        members = [e for e in self._members()
+                   if hasattr(e, "overload_policy")]
         self._saved_policies = {
-            id(e): e.overload_policy for e in self.router.engines}
-        for e in self.router.engines:
+            id(e): e.overload_policy for e in members}
+        for e in members:
             e.overload_policy = "shed-lowest-priority"
-        if all(e.max_queue is None for e in self.router.engines):
+        if all(e.max_queue is None for e in members):
             # overload_policy is only consulted when a BOUNDED queue
             # fills — flipping it on unbounded engines changes
             # nothing. Say so instead of pretending to protect p99.
@@ -151,17 +210,18 @@ class Autoscaler:
         return "shed_mode"
 
     def _restore_policies(self) -> str:
-        for e in self.router.engines:
-            e.overload_policy = (self._saved_policies or {}).get(
-                id(e), e.overload_policy)
+        for e in self._members():
+            if hasattr(e, "overload_policy"):
+                e.overload_policy = (self._saved_policies or {}).get(
+                    id(e), e.overload_policy)
         self._saved_policies = None
         return "restore_policy"
 
     def _start_drain(self) -> str:
         # drain the most-loaded-index-last healthy engine: the LAST
-        # healthy engine in pool order (newest first out — the one the
-        # autoscaler most recently added), deterministic
-        self._draining = self.router.healthy_engines()[-1]
+        # healthy GROUP engine in pool order (newest first out — the
+        # one the autoscaler most recently added), deterministic
+        self._draining = self._healthy()[-1]
         self.router.drain(self._draining)
         return "drain"
 
@@ -175,11 +235,11 @@ class Autoscaler:
         # reap corpses first: an engine someone else drained, or one
         # that degraded (its work already failed over), serves nothing
         # — remove it regardless of load, min_engines permitting
-        for e in list(self.router.engines):
+        for e in self._members():
             if e is self._draining:
                 continue
             if e.health()["state"] in ("drained", "degraded") \
-                    and len(self.router.engines) > self.min_engines:
+                    and len(self._members()) > self.min_engines:
                 try:
                     self.router.remove_engine(e)
                 except ValueError:      # still holds routed work
@@ -194,7 +254,7 @@ class Autoscaler:
             return self._record(now, "draining", None)
         p99 = self._window.quantile(
             self.objective.q if self.objective is not None else 0.99)
-        healthy = self.router.healthy_engines()
+        healthy = self._healthy()
         n = len(healthy)
         slots = sum(e.slots for e in healthy)
         backlog = sum(e.queue_depth for e in healthy)
@@ -206,13 +266,18 @@ class Autoscaler:
                  and backlog == 0
                  and occupancy < self.occupancy_low)
         if over:
-            if len(self.router.engines) < self.max_engines:
+            if len(self._members()) < self.max_engines:
                 action = self._scale_up()
-            elif self.flip_overload_policy \
-                    and self._saved_policies is None:
-                action = self._shed_mode()
             else:
-                action = "hold"
+                # at capacity: move an idle group's engine here
+                # (ISSUE 19) before resorting to shedding
+                action = self._rebalance_groups()
+                if action is None:
+                    if self.flip_overload_policy \
+                            and self._saved_policies is None:
+                        action = self._shed_mode()
+                    else:
+                        action = "hold"
         elif self._saved_policies is not None \
                 and p99 is not None and not self._misses_target(p99):
             action = self._restore_policies()
@@ -237,9 +302,13 @@ class Autoscaler:
             # fleet_autoscale drill)
             d["objective"] = self.objective.name
             d["q"] = self.objective.q
+        if self.group != "default":
+            # homogeneous fleets keep the pre-ISSUE-19 record shape
+            # (the fleet_autoscale drill pins it bit-for-bit)
+            d["group"] = self.group
         self.decisions.append(d)
         if action in ("scale_up", "scale_down", "drain", "shed_mode",
-                      "restore_policy"):
+                      "restore_policy", "rebalance_groups"):
             obs.emit_event("autoscale_decision", plane="serving",
                            router=self.router._obs_name, **d)
         return d

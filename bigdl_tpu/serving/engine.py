@@ -302,7 +302,15 @@ class Request:
     clock or RNG) and `hop` counts engine-to-engine moves (failover
     resubmission, rebalance, disaggregated-prefill import). Every
     lifecycle event carries both, and obs/journey.py reconstructs the
-    cross-engine timeline from them."""
+    cross-engine timeline from them.
+
+    Multi-tenancy (ISSUE 19, host-side only): `tenant` names the
+    consumer the request bills against — the router's
+    TenancyController gates admission by its token bucket and WFQ
+    weight, the engine's `tenant_kv_quotas` bounds its exclusive KV
+    blocks, and every lifecycle event carries the name. `model_tag`
+    selects the engine GROUP that may serve the request (None →
+    'default'); dispatch, failover and rebalance never cross groups."""
     prompt: Sequence[int]
     max_new_tokens: int = 32
     temperature: float = 0.0
@@ -316,6 +324,8 @@ class Request:
     max_queue_wait_s: Optional[float] = None
     trace_id: Optional[str] = None
     hop: int = 0
+    tenant: Optional[str] = None
+    model_tag: Optional[str] = None
 
 
 @dataclass
@@ -425,7 +435,9 @@ class InferenceEngine:
                  tp_mesh=None, tp_axis: str = "model",
                  role: str = "both",
                  attn_impl: str = "xla",
-                 weight_dtype: str = "fp32"):
+                 weight_dtype: str = "fp32",
+                 model_tag: Optional[str] = None,
+                 tenant_kv_quotas: Optional[Dict[str, int]] = None):
         if tp_mesh is not None:
             # memoized: engines over the same (model, mesh, axis)
             # share one wrapper and therefore every jitted executable
@@ -494,6 +506,24 @@ class InferenceEngine:
                 "weight layout cannot honor — quantize unsharded "
                 "engines only")
         self.weight_dtype = weight_dtype
+        # engine-group membership (ISSUE 19; constructor arg, never
+        # env): the router scopes dispatch/failover/rebalance/affinity
+        # to engines sharing one tag (None → the 'default' group).
+        # Mutable on purpose — EngineRouter.move_engine regroups a
+        # same-model engine compile-free by rewriting it.
+        self.model_tag = model_tag
+        # per-tenant KV quotas (ISSUE 19; constructor arg, never env):
+        # tenant name → max EXCLUSIVELY-owned pool blocks summed over
+        # this engine's active slots. Admission SKIPS (never blocks
+        # behind) a quota-exceeded request — it stays queued and other
+        # tenants keep admitting past it.
+        if tenant_kv_quotas:
+            for t, qn in tenant_kv_quotas.items():
+                if qn < 1:
+                    raise ValueError(
+                        f"tenant_kv_quotas[{t!r}] must be >= 1")
+        self.tenant_kv_quotas = dict(tenant_kv_quotas or {})
+        self._quota_noted: set = set()
         self.model = model
         # tp degree for telemetry/provenance (1 = unsharded); the
         # serving/tp.py wrapper carries it, plain models don't
@@ -880,6 +910,7 @@ class InferenceEngine:
             "attn_impl": self.attn_impl,
             "weight_dtype": self.weight_dtype,
             "cache_dtype": np.dtype(self.cache_dtype).name,
+            "model_tag": self.model_tag,
             "handoffs_out": s["handoffs_out"],
             "handoffs_in": s["handoffs_in"],
             "slots": self.slots,
@@ -1071,11 +1102,18 @@ class InferenceEngine:
     @staticmethod
     def _trace_fields(req: Request) -> Dict[str, object]:
         """Journey-context fields for a request-lifecycle event
-        (ISSUE 11): empty when the request predates tracing."""
+        (ISSUE 11): empty when the request predates tracing. The
+        tenant stamp rides along (ISSUE 19) so every lifecycle event
+        of tenant-tagged traffic names its consumer."""
+        out: Dict[str, object] = {}
         t = getattr(req, "trace_id", None)
-        if t is None:
-            return {}
-        return {"trace": t, "hop": int(getattr(req, "hop", 0))}
+        if t is not None:
+            out["trace"] = t
+            out["hop"] = int(getattr(req, "hop", 0))
+        tenant = getattr(req, "tenant", None)
+        if tenant is not None:
+            out["tenant"] = tenant
+        return out
 
     def _bump(self, key: str, n: int = 1) -> None:
         """One increment path: the engine-local stats dict (always,
@@ -1134,6 +1172,7 @@ class InferenceEngine:
         self._observe_terminal(req, reason, status, 0, ttft, latency)
         self._meta.pop(req.id, None)
         self._admit_fails.pop(req.id, None)
+        self._quota_noted.discard(req.id)
         self._bump(_STATUS_COUNTER[status])
         res = GenerationResult(req.id, list(req.prompt), [], reason,
                                status, ttft_s=ttft, latency_s=latency)
@@ -1290,32 +1329,71 @@ class InferenceEngine:
             # every counter series does
             self._m_tp_gauge.set(self.tp)
 
+    def _tenant_kv_blocks(self, tenant: str) -> int:
+        """Exclusively-owned pool blocks held by `tenant` across the
+        active slots (shared prefix-hit blocks are NOT billed — they
+        exist once however many tenants reference them)."""
+        return sum(len(self._slot_blocks[i][1])
+                   for i, r in enumerate(self._req)
+                   if r is not None
+                   and getattr(r, "tenant", None) == tenant)
+
+    def _quota_blocked(self, req: Request) -> bool:
+        """Whether admitting `req` now would exceed its tenant's KV
+        quota (ISSUE 19). Emits one tenant_throttled(action=
+        'kv_quota') per request id (not per retry round)."""
+        tenant = getattr(req, "tenant", None)
+        quota = self.tenant_kv_quotas.get(tenant) \
+            if tenant is not None else None
+        if quota is None:
+            return False
+        if self._tenant_kv_blocks(tenant) < quota:
+            return False
+        if req.id not in self._quota_noted:
+            self._quota_noted.add(req.id)
+            obs.emit_event("tenant_throttled", plane="serving",
+                           tenant=tenant, action="kv_quota",
+                           engine=self._obs_name, request=req.id)
+        return True
+
     def _admit(self):
         self._expire_queued(self._clock())
-        for slot in self._free_slots():
-            while self._queue:
-                req = self._pop_next()
-                if self._admit_into(slot, req):
-                    self._admit_fails.pop(req.id, None)
-                    break
-                # pool pressure: every evictable/spillable prefix
-                # block is gone and the free list still cannot cover
-                # the suffix. Requeue at the FRONT of the line (its
-                # precedence is preserved) — BOUNDED (ISSUE 16
-                # bugfix): a pool that never frees (nothing in
-                # flight to release blocks) would otherwise spin the
-                # request through the queue forever with no terminal
-                # and no counter
-                fails = self._admit_fails.pop(req.id, 0) + 1
-                if fails > self.admit_requeue_budget:
-                    self._bump("admit_requeue_exhausted")
-                    self._terminal(req, "pool_exhausted", "done")
-                    continue              # try the next queued request
-                self._admit_fails[req.id] = fails
-                self._queue.appendleft(req)
-                return
-            if not self._queue:
-                return
+        # quota-exceeded requests are set ASIDE and restored to the
+        # queue front afterwards (order preserved) — a blocked tenant
+        # must never head-of-line-block the other tenants' admissions
+        quota_skipped: List[Request] = []
+        try:
+            for slot in self._free_slots():
+                while self._queue:
+                    req = self._pop_next()
+                    if self._quota_blocked(req):
+                        quota_skipped.append(req)
+                        continue
+                    if self._admit_into(slot, req):
+                        self._admit_fails.pop(req.id, None)
+                        self._quota_noted.discard(req.id)
+                        break
+                    # pool pressure: every evictable/spillable prefix
+                    # block is gone and the free list still cannot
+                    # cover the suffix. Requeue at the FRONT of the
+                    # line (its precedence is preserved) — BOUNDED
+                    # (ISSUE 16 bugfix): a pool that never frees
+                    # (nothing in flight to release blocks) would
+                    # otherwise spin the request through the queue
+                    # forever with no terminal and no counter
+                    fails = self._admit_fails.pop(req.id, 0) + 1
+                    if fails > self.admit_requeue_budget:
+                        self._bump("admit_requeue_exhausted")
+                        self._terminal(req, "pool_exhausted", "done")
+                        continue          # try the next queued request
+                    self._admit_fails[req.id] = fails
+                    self._queue.appendleft(req)
+                    return
+                if not self._queue:
+                    return
+        finally:
+            for r in reversed(quota_skipped):
+                self._queue.appendleft(r)
 
     def _point_table_row(self, slot: int, hit: List[int],
                          new: List[int]) -> np.ndarray:

@@ -91,6 +91,27 @@ engine's decode histogram.
   guard only the decode dispatch, and the engine refuses those knobs
   on role='prefill').
 
+* **Model-tagged engine groups (ISSUE 19).** Engines carry a
+  `model_tag` (None → the 'default' group) and requests select their
+  group via `Request(model_tag=)` — the 43M LM decode pool can serve
+  next to a bucketed vision group under ONE router. Dispatch,
+  spillover, failover, rebalance, affinity and warm-state migration
+  are all scoped WITHIN a group: a cross-group reroute is refused
+  exactly like a cross-`layout_family` one (a vision engine cannot
+  decode an LM prompt any more than an int8 engine can continue an
+  fp32 stream). `add_engine(group=)` grows one group (dict-valued
+  `engine_factory` keys factories by group), and `move_engine`
+  retags an idle same-model engine compile-free — executables are
+  keyed on the model object, so regrouping is pure bookkeeping.
+* **Per-tenant admission + fairness (ISSUE 19).** With
+  `tenancy=TenancyController(...)` armed, EVERY submission parks in
+  the controller's per-tenant WFQ queue; step() releases in weighted-
+  fair order, gated per request by the tenant's token bucket and the
+  target group's free capacity — an over-budget tenant defers or
+  sheds by ITS OWN budget while other tenants' queues, KV blocks and
+  SLOs are untouched. The controller shares the router's injected
+  clock (enforced), so tenancy-armed replays stay byte-identical.
+
 Engines fronted by a router are driven ONLY through it (the router
 harvests `engine.completed`; a concurrent engine.run() would race the
 harvest).
@@ -145,20 +166,31 @@ class EngineRouter:
 
     Knobs: `engine_factory` (zero-arg callable building a
     pool-compatible engine — same model object, same clock; required
-    for add_engine()/autoscaling), `clock` (monotonic-seconds source
-    shared with the request-latency bookkeeping), `obs_label`
-    (registry label; lets a rebuilt router continue its series)."""
+    for add_engine()/autoscaling; a DICT keys factories by engine
+    group for heterogeneous fleets), `clock` (monotonic-seconds
+    source shared with the request-latency bookkeeping), `obs_label`
+    (registry label; lets a rebuilt router continue its series),
+    `tenancy` (a serving/tenancy.TenancyController on the SAME clock
+    — arms per-tenant token-bucket admission + WFQ release)."""
 
     def __init__(self, engines: Sequence[InferenceEngine],
-                 engine_factory: Optional[
-                     Callable[[], InferenceEngine]] = None,
+                 engine_factory=None,
                  clock: Callable[[], float] = time.monotonic,
                  obs_label: Optional[str] = None,
                  prefill_engines: Sequence[InferenceEngine] = (),
                  handoff_len: Optional[int] = None,
-                 affinity: bool = False):
+                 affinity: bool = False,
+                 tenancy=None):
         if not engines:
             raise ValueError("EngineRouter needs at least one engine")
+        if tenancy is not None and tenancy.clock is not clock:
+            # bucket refill and WFQ expiry MUST tick on the router's
+            # clock, or a virtual-clock replay stops being a pure
+            # function of the submit/step sequence
+            raise ValueError("tenancy controller must share the "
+                             "router's clock (pass the same callable "
+                             "to both)")
+        self.tenancy = tenancy
         for eng in prefill_engines:
             if eng.role != "prefill":
                 raise ValueError(
@@ -201,6 +233,8 @@ class EngineRouter:
             "engines_added": 0, "engines_removed": 0,
             "prefill_dispatched": 0, "handoffs": 0,
             "migrations": 0, "migrated_blocks": 0,
+            "tenant_deferred": 0, "tenant_shed": 0,
+            "tenant_expired": 0, "group_moves": 0,
         }
         self._obs_name = obs_label or f"router{next(_ROUTER_IDS)}"
         reg = obs.get_registry()
@@ -245,6 +279,26 @@ class EngineRouter:
             labelnames=("router",),
             buckets=ROUTER_LATENCY_BUCKETS).labels(
                 router=self._obs_name)
+        # per-tenant telemetry (ISSUE 19) — each family registered at
+        # exactly THIS site (metric-family-contract); children resolve
+        # lazily per tenant label as traffic names them. The latency
+        # histogram is fed unconditionally like _m_latency: it is the
+        # per-tenant SLOObjective's input, core bookkeeping
+        self._m_tenant_throttled = reg.counter(
+            "serving_tenant_throttled_total",
+            "requests deferred/shed by a tenant's own admission "
+            "budget (token bucket / max_pending)",
+            labelnames=("router", "tenant", "action"))
+        self._m_tenant_requests = reg.counter(
+            "serving_tenant_requests_total",
+            "fleet-level terminal statuses per tenant",
+            labelnames=("router", "tenant", "status"))
+        self._m_tenant_latency = reg.histogram(
+            "router_tenant_request_latency_seconds",
+            "per-tenant request submit→done wall seconds (router "
+            "clock, failover included)",
+            labelnames=("router", "tenant"),
+            buckets=ROUTER_LATENCY_BUCKETS)
 
     # ------------------------------------------------------------- helpers
     def _bump(self, key: str, n: int = 1) -> None:
@@ -261,6 +315,26 @@ class EngineRouter:
         return [e for e in self.engines
                 if e.degraded is None and not e.draining]
 
+    # -------------------------------------------------------------- groups
+    @staticmethod
+    def _group_of(eng) -> str:
+        """An engine's group key (ISSUE 19): its model_tag, with None
+        mapping to 'default' — the homogeneous-fleet back-compat."""
+        return getattr(eng, "model_tag", None) or "default"
+
+    @staticmethod
+    def _req_group(request) -> str:
+        """The group a request may be served by (its model_tag)."""
+        return getattr(request, "model_tag", None) or "default"
+
+    @property
+    def groups(self) -> Dict[str, List[InferenceEngine]]:
+        """group key → member serving engines, in pool order."""
+        out: Dict[str, List[InferenceEngine]] = {}
+        for e in self.engines:
+            out.setdefault(self._group_of(e), []).append(e)
+        return out
+
     @staticmethod
     def _rank(engines) -> List[InferenceEngine]:
         """Healthy engines by load, least-loaded first; ties break on
@@ -271,26 +345,37 @@ class EngineRouter:
                   if e.degraded is None and not e.draining]
         return [e for _, _, e in sorted(scored, key=lambda s: s[:2])]
 
-    def _ranked(self, prompt: Optional[Sequence[int]] = None
+    def _ranked(self, prompt: Optional[Sequence[int]] = None,
+                group: Optional[str] = None
                 ) -> List[InferenceEngine]:
-        """Healthy serving engines in dispatch order. With affinity on
-        and a prompt in hand, longest radix match ranks FIRST (the
-        stamp-free peek spans both KV tiers), load second, index third
-        — health gating is applied before scoring, so a warm but
-        degraded/draining tree is never a candidate."""
+        """Healthy serving engines in dispatch order, scoped to one
+        engine `group` when given (ISSUE 19 — every request-driven
+        caller passes its request's group, making cross-group routing
+        structurally impossible). With affinity on and a prompt in
+        hand, longest radix match ranks FIRST (the stamp-free peek
+        spans both KV tiers), load second, index third — health gating
+        is applied before scoring, so a warm but degraded/draining
+        tree is never a candidate."""
+        pool = self.engines if group is None else [
+            e for e in self.engines if self._group_of(e) == group]
         if not (self.affinity and prompt is not None):
-            return self._rank(self.engines)
+            return self._rank(pool)
         scored = [(-e.prefix_match_tokens(prompt),
                    (e.slots_active + e.queue_depth) / max(e.slots, 1),
                    i, e)
-                  for i, e in enumerate(self.engines)
+                  for i, e in enumerate(pool)
                   if e.degraded is None and not e.draining]
         return [e for _, _, _, e in sorted(scored, key=lambda s: s[:3])]
 
-    def _ranked_prefill(self) -> List[InferenceEngine]:
+    def _ranked_prefill(self, group: Optional[str] = None
+                        ) -> List[InferenceEngine]:
         """Healthy prefill-tier engines, least-loaded first (the same
-        ranking as the serving pool — one formula, two pools)."""
-        return self._rank(self.prefill_engines)
+        ranking as the serving pool — one formula, two pools), group-
+        scoped like the serving ranking."""
+        pool = self.prefill_engines if group is None else [
+            e for e in self.prefill_engines
+            if self._group_of(e) == group]
+        return self._rank(pool)
 
     def _resolve(self, engine) -> InferenceEngine:
         if isinstance(engine, InferenceEngine):
@@ -315,7 +400,9 @@ class EngineRouter:
                 rid = next(self._ids)
             request.id = rid
         elif request.id in self._pending \
-                or request.id in self.completed:
+                or request.id in self.completed \
+                or (self.tenancy is not None
+                    and self.tenancy.has(request.id)):
             raise ValueError(f"request id {request.id} already in "
                              "flight or completed-unclaimed")
         if getattr(request, "trace_id", None) is None:
@@ -325,18 +412,88 @@ class EngineRouter:
             # rebalance, handoff import) increments the hop counter
             request.trace_id = f"{self._obs_name}/{request.id}"
             request.hop = 0
+        if self.tenancy is not None:
+            return self._submit_tenancy(request)
+        return self._dispatch(request)
+
+    def _submit_tenancy(self, request: Request) -> int:
+        """Tenancy-armed admission (ISSUE 19): the request parks in
+        its tenant's WFQ queue; step() releases in weighted-fair
+        order, gated by the token bucket and group capacity. A
+        max_pending overflow sheds HERE (status 'shed', reason
+        'throttled') and the result rides the next step() return like
+        any engine-side shed — a driver loop still sees every request
+        exactly once."""
+        verdict = self.tenancy.offer(request)
+        if verdict == "shed":
+            self._tenant_throttle(request.tenant, "shed", request)
+            self._synthesize_terminal(request, "throttled", "shed",
+                                      latency=0.0)
+            return request.id
+        if verdict == "deferred":
+            self._tenant_throttle(request.tenant, "defer", request)
+        return request.id
+
+    def _tenant_throttle(self, tenant: str, action: str,
+                         request: Optional[Request] = None) -> None:
+        self._stats["tenant_deferred" if action == "defer"
+                    else "tenant_shed"] += 1
+        if obs.enabled():
+            self._m_tenant_throttled.labels(
+                router=self._obs_name, tenant=tenant,
+                action=action).inc()
+        obs.emit_event("tenant_throttled", plane="serving",
+                       tenant=tenant, action=action,
+                       router=self._obs_name,
+                       request=None if request is None else request.id,
+                       queued=self.tenancy.queued(tenant))
+
+    def _synthesize_terminal(self, request: Request, reason: str,
+                             status: str,
+                             latency: Optional[float]) -> None:
+        """Terminal for a request that never reached an engine (shed
+        or expired at the tenancy gate): the router is the engine of
+        record on the event (tp 0, role 'router'), and the result
+        rides the settled backlog so step()/run() surface it exactly
+        once, like any engine-settled terminal."""
+        res = GenerationResult(request.id, list(request.prompt), [],
+                               reason, status, ttft_s=None,
+                               latency_s=latency)
+        self.completed[request.id] = res
+        self._settled_backlog.append(res)
+        tenant = getattr(request, "tenant", None)
+        if tenant is not None and obs.enabled():
+            self._m_tenant_requests.labels(
+                router=self._obs_name, tenant=tenant,
+                status=status).inc()
+        obs.emit_event("request_terminal", plane="serving",
+                       engine=self._obs_name, request=request.id,
+                       status=status, reason=reason, tokens=0,
+                       ttft_s=None, latency_s=latency, tp=0,
+                       role="router",
+                       **InferenceEngine._trace_fields(request))
+
+    def _dispatch(self, request: Request,
+                  t0: Optional[float] = None) -> int:
+        """Group-scoped dispatch: the prefill tier first for long
+        prompts, then the ranked serving order, spilling past bounded
+        queues that reject. `t0` back-dates the assignment's latency
+        stamp to the tenancy offer time — time spent behind the tenant
+        gate is part of the request's lifecycle, not free."""
+        t = self._clock() if t0 is None else t0
+        group = self._req_group(request)
         # disaggregated prefill: long prompts go to the prefill tier
         # (falling back to in-place prefill on the serving pool when
         # every prefill engine is unhealthy or rejects)
         if self.handoff_len is not None \
                 and len(request.prompt) >= self.handoff_len:
-            for eng in self._ranked_prefill():
+            for eng in self._ranked_prefill(group):
                 try:
                     eng.submit(request)
                 except OverloadError:
                     continue
                 self._pending[request.id] = _Assignment(
-                    request, eng, next(self._seq), self._clock())
+                    request, eng, next(self._seq), t)
                 self._bump("dispatched")
                 self._bump("prefill_dispatched")
                 if obs.enabled():
@@ -344,11 +501,11 @@ class EngineRouter:
                         router=self._obs_name,
                         engine=eng.obs_name).inc()
                 return request.id
-        order = self._ranked(request.prompt)
+        order = self._ranked(request.prompt, group)
         if not order:
             raise NoHealthyEngine(
-                "no healthy engine in the pool (all degraded or "
-                "draining)")
+                f"no healthy engine in group {group!r} (all degraded "
+                "or draining, or the group has no engines)")
         last_err: Optional[OverloadError] = None
         for nth, eng in enumerate(order):
             try:
@@ -357,7 +514,7 @@ class EngineRouter:
                 last_err = e
                 continue
             self._pending[request.id] = _Assignment(
-                request, eng, next(self._seq), self._clock())
+                request, eng, next(self._seq), t)
             self._bump("dispatched")
             if obs.enabled():
                 self._m_dispatch.labels(
@@ -396,8 +553,19 @@ class EngineRouter:
             if res.ttft_s is not None:
                 res.ttft_s += bump
         self.completed[res.id] = res
+        tenant = getattr(asg.request, "tenant", None)
         if res.status == "done":
             self._m_latency.observe(total)
+            if tenant is not None:
+                # unconditional like _m_latency — the per-tenant
+                # SLOObjective's input is core bookkeeping
+                self._m_tenant_latency.labels(
+                    router=self._obs_name,
+                    tenant=tenant).observe(total)
+        if tenant is not None and obs.enabled():
+            self._m_tenant_requests.labels(
+                router=self._obs_name, tenant=tenant,
+                status=res.status).inc()
         if out is not None:
             out.append(res)
         else:
@@ -417,9 +585,14 @@ class EngineRouter:
         engine's tokens agree with fp32 only to a tolerance, so a
         reroute onto a different `layout_family` would hand the client
         tokens the original engine would never have produced — the
-        bit-identical-failover pin only holds within one family."""
+        bit-identical-failover pin only holds within one family.
+
+        Nor a GROUP (ISSUE 19): the ranked candidate list is scoped to
+        the request's model group, so a vision engine is structurally
+        never a failover target for an LM stream (and vice versa)."""
         family = getattr(asg.engine, "layout_family", None)
-        for eng in self._ranked(asg.request.prompt):
+        for eng in self._ranked(asg.request.prompt,
+                                self._req_group(asg.request)):
             if eng is asg.engine:
                 continue
             if getattr(eng, "layout_family", None) != family:
@@ -460,7 +633,10 @@ class EngineRouter:
         entries = eng.export_tree()
         if not entries:
             return
-        for target in self._ranked():
+        # migration stays inside the donor's group (ISSUE 19): a
+        # different group's engines serve a different model — its
+        # prefill would never have written these bytes
+        for target in self._ranked(None, self._group_of(eng)):
             if target is eng or not getattr(target, "spill_enabled",
                                             False):
                 continue
@@ -504,13 +680,23 @@ class EngineRouter:
         (engine.steal_queued); receivers take only what they can admit
         on the next round, so a moved request never waits twice.
 
+        Rebalance is scoped WITHIN each model group (ISSUE 19): a
+        vision engine's idle slots can never absorb an LM backlog —
+        groups iterate in sorted-key order for determinism.
+
         With affinity on (ISSUE 16), a donor keeps any queued request
         its radix tree matches STRICTLY better than the receiver's —
         load smoothing must not cold-start a prompt whose warm prefix
         lives on the donor (the trip-time migration path covers the
         donor actually dying)."""
+        groups = self.groups
+        for gname in sorted(groups):
+            if len(groups[gname]) > 1:
+                self._rebalance_group(groups[gname])
+
+    def _rebalance_group(self, engines: List[InferenceEngine]) -> None:
         for ri, recv in sorted(
-                ((i, e) for i, e in enumerate(self.engines)
+                ((i, e) for i, e in enumerate(engines)
                  if e.degraded is None and not e.draining),
                 key=lambda ie: ((ie[1].slots_active
                                  + ie[1].queue_depth)
@@ -521,7 +707,7 @@ class EngineRouter:
             while room > 0:
                 donor = None
                 excess_best = 0
-                for e in self.engines:
+                for e in engines:
                     if e is recv or e.degraded is not None:
                         continue
                     free = e.slots - e.slots_active
@@ -585,6 +771,10 @@ class EngineRouter:
         victims — ride the next return, so a driver loop sees every
         request it submitted exactly once."""
         self._rebalance()
+        if self.tenancy is not None:
+            # release BEFORE draining the backlog: expiry terminals
+            # synthesized here ride THIS round's return
+            self._release_tenancy()
         out: List[GenerationResult] = list(self._settled_backlog)
         self._settled_backlog.clear()
         # prefill tier first: admit+prefill+export, then seat the
@@ -622,6 +812,39 @@ class EngineRouter:
             self._harvest(eng, out)
         return out
 
+    def _release_tenancy(self) -> None:
+        """Drain the tenancy controller's queues in WFQ order, gated
+        by each tenant's token bucket and each engine group's free
+        capacity this round. Expired entries (deadline / queue-wait
+        TTL from offer time) synthesize 'expired' terminals first,
+        mirroring the engine's own queue expiry. A released request
+        whose dispatch bounces off every engine returns to its queue
+        head with its token refunded."""
+        now = self._clock()
+        for entry in self.tenancy.expire(now):
+            self._stats["tenant_expired"] += 1
+            self._synthesize_terminal(entry.request, "expired",
+                                      "expired", latency=now - entry.t)
+        # free capacity per group: slots the engine could seat plus
+        # queue headroom, never negative — the WFQ release only hands
+        # out what the pool can actually admit this round
+        rooms: Dict[str, int] = {}
+        for eng in self.engines:
+            if eng.degraded is not None or eng.draining:
+                continue
+            room = max(0, (eng.slots - eng.slots_active)
+                       - eng.queue_depth)
+            if eng.max_queue is not None:
+                room = min(room, max(0, eng.max_queue
+                                     - eng.queue_depth))
+            g = self._group_of(eng)
+            rooms[g] = rooms.get(g, 0) + room
+        for entry in self.tenancy.release(rooms):
+            try:
+                self._dispatch(entry.request, t0=entry.t)
+            except (OverloadError, NoHealthyEngine):
+                self.tenancy.bounce(entry)
+
     def handoff(self, pkg) -> Optional[InferenceEngine]:
         """Seat one prefilled HandoffPackage on the least-loaded
         healthy serving engine (engine.import_handoff); None when no
@@ -629,7 +852,7 @@ class EngineRouter:
         retries next round. Reassigns the request's pending entry to
         the importer, so terminals and failover keep working across
         the disaggregation boundary."""
-        for eng in self._ranked():
+        for eng in self._ranked(None, self._req_group(pkg.request)):
             if not eng.import_handoff(pkg):
                 continue
             asg = self._pending.get(pkg.request.id)
@@ -653,10 +876,28 @@ class EngineRouter:
         (or, with no argument, everything that finished, id order) —
         identical semantics to InferenceEngine.run, one level up."""
         ids = [self.submit(r) for r in requests] if requests else None
+        prev_clock = None
         while any(not e.idle for e in self.engines) \
                 or any(not e.idle for e in self.prefill_engines) \
-                or self._handoff_backlog:
+                or self._handoff_backlog \
+                or (self.tenancy is not None and self.tenancy.pending):
             before = len(self._handoff_backlog)
+            if self.tenancy is not None and self.tenancy.pending \
+                    and all(e.idle for e in self.engines) \
+                    and not self._handoff_backlog:
+                # the only work left is parked behind tenant gates;
+                # on a frozen clock (no refill, no TTL expiry) another
+                # round cannot release anything — fail loud instead of
+                # spinning forever
+                now = self._clock()
+                if prev_clock is not None and now <= prev_clock:
+                    raise RuntimeError(
+                        f"{self.tenancy.pending} request(s) parked "
+                        "behind tenant admission gates cannot release "
+                        "(empty buckets and a non-advancing clock — "
+                        "advance the virtual clock or raise the "
+                        "refill rate)")
+                prev_clock = now
             # stuck-backlog detection must give a TRANSIENTLY
             # unseatable package one more round: seating runs at the
             # top of step(), so slots freed later in the same round
@@ -687,16 +928,35 @@ class EngineRouter:
         return [self.completed.pop(i) for i in ids]
 
     # ------------------------------------------------------- pool mutation
-    def add_engine(self, engine: Optional[InferenceEngine] = None
-                   ) -> InferenceEngine:
+    def add_engine(self, engine: Optional[InferenceEngine] = None,
+                   group: Optional[str] = None) -> InferenceEngine:
         """Grow the pool (the autoscaler's scale-up lever). With no
         argument the `engine_factory` builds the engine — over the
-        same model object, so the newcomer compiles nothing."""
+        same model object, so the newcomer compiles nothing. With a
+        dict-valued factory (heterogeneous fleets), `group` picks
+        which group's factory builds it ('default' when omitted); the
+        newcomer must land in the group it was asked for."""
         if engine is None:
             if self.engine_factory is None:
                 raise ValueError("add_engine() without an engine "
                                  "needs an engine_factory")
-            engine = self.engine_factory()
+            factory = self.engine_factory
+            if isinstance(factory, dict):
+                key = group or "default"
+                if key not in factory:
+                    raise ValueError(
+                        f"no engine_factory for group {key!r} "
+                        f"(have: {sorted(factory)})")
+                factory = factory[key]
+            engine = factory()
+        if group is not None:
+            got = self._group_of(engine)
+            if getattr(engine, "model_tag", None) is None:
+                engine.model_tag = group      # tag the untagged
+            elif got != group:
+                raise ValueError(
+                    f"engine is tagged {got!r}, asked for group "
+                    f"{group!r}")
         self.engines.append(engine)
         self._bump("engines_added")
         self._m_pool.set(len(self.engines))
@@ -705,6 +965,38 @@ class EngineRouter:
                        engine=engine.obs_name,
                        pool_size=len(self.engines))
         return engine
+
+    def move_engine(self, engine, group: str) -> InferenceEngine:
+        """Retag an IDLE engine into another group (ISSUE 19) —
+        compile-free capacity movement between groups serving the
+        same model object (executables are keyed on the model, so a
+        retag is pure bookkeeping; tests/test_tenancy.py pins zero
+        new traces). Refused when the engine still holds work, or
+        when the target group's members run a DIFFERENT model — an
+        engine cannot serve a model it was not built over."""
+        eng = self._resolve(engine)
+        src = self._group_of(eng)
+        if src == group:
+            return eng
+        if not eng.idle:
+            raise ValueError("engine still holds work; drain or step "
+                             "the pool idle before moving it")
+        for member in self.groups.get(group, []):
+            if getattr(member, "model", None) is not None \
+                    and getattr(eng, "model", None) is not member.model:
+                raise ValueError(
+                    f"group {group!r} serves a different model "
+                    "object; move_engine only retags same-model "
+                    "capacity (use add_engine(group=) with that "
+                    "group's factory instead)")
+            break
+        eng.model_tag = group
+        self._bump("group_moves")
+        obs.emit_event("group_rebalance", plane="serving",
+                       router=self._obs_name, from_group=src,
+                       to_group=group, action="move",
+                       engine=eng.obs_name)
+        return eng
 
     def drain(self, engine) -> InferenceEngine:
         """Flip one engine (by index or identity) to stop-admission:
@@ -757,6 +1049,16 @@ class EngineRouter:
             v = self._m_latency.quantile(q)
             return None if v is None else round(v * 1e3, 3)
 
+        groups = {
+            gname: {
+                "engines": len(members),
+                "healthy": sum(1 for e in members
+                               if e.degraded is None
+                               and not e.draining),
+                "slots_active": sum(e.slots_active for e in members),
+                "queue_depth": sum(e.queue_depth for e in members),
+            }
+            for gname, members in sorted(self.groups.items())}
         return {
             "pool_size": len(self.engines),
             "healthy": len(healthy),
@@ -768,6 +1070,9 @@ class EngineRouter:
             "queue_depth": sum(e.queue_depth for e in healthy),
             "request_p50_ms": pct(0.50),
             "request_p99_ms": pct(0.99),
+            "groups": groups,
+            "tenants": None if self.tenancy is None
+            else self.tenancy.health(),
             "stats": self.stats,
             "engines": per,
         }

@@ -334,6 +334,12 @@ class SpeculativeEngine:
         return self._t.layout_family
 
     @property
+    def model_tag(self):
+        """The TARGET's engine group (ISSUE 19) — routing is by the
+        model the client sees, and that is the target's."""
+        return self._t.model_tag
+
+    @property
     def draft_engine(self) -> InferenceEngine:
         return self._d
 

@@ -1,0 +1,380 @@
+"""Bucketed vision batch engine for heterogeneous fleets (ISSUE 19).
+
+BigDL's serving surface is a model ZOO behind one ingress
+(arXiv 2204.01715) — not just an LM. `VisionEngine` puts a
+classification `Predictor`-style forward behind the EXACT router
+surface `InferenceEngine` exposes (submit/step/run, drain, health,
+steal_queued, the KV-plane no-ops), so an `EngineRouter` can serve a
+vision group next to the 43M LM decode pool with dispatch, rebalance,
+failover and tenancy all group-scoped by `model_tag`.
+
+Design:
+
+* **One fixed-shape executable.** Every step pads up to `batch`
+  requests' feature vectors to a fixed `(batch, feature_len)` float32
+  block and runs ONE jitted forward; garbage pad rows are computed and
+  ignored host-side, exactly the LM decode idiom. Executables are
+  memoized process-wide on `(id(predict_fn), batch, feature_len)` —
+  engines built over the same predict function share them, so pool
+  growth (the autoscaler's group-rebalance lever) compiles NOTHING
+  new. `stats["forward_traces"]` reports this engine's delta.
+* **Requests are Requests.** `Request.prompt` carries the flattened
+  feature ints (len <= feature_len; right-padded with zeros); the
+  result's single "token" is the argmax class id, finish_reason
+  'classified'. Priority admission, deadline / queue-wait expiry and
+  reject-overload reuse the LM engine's semantics so tenancy and the
+  drills treat both planes uniformly.
+* **Deterministic + host-side.** No RNG, injectable clock, argmax
+  ties break low-index (jnp.argmax) — two replays are byte-identical.
+
+The KV plane is structurally absent: `prefix_match_tokens` is 0,
+`export_tree`/`import_tree`/`import_handoff` are refusal no-ops —
+which is what makes cross-group migration/handoff a no-op rather than
+a corruption when a misconfigured fleet tries it.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import obs
+from bigdl_tpu.serving.engine import (EngineDraining, GenerationResult,
+                                      InferenceEngine, OverloadError,
+                                      Request)
+
+__all__ = ["VisionEngine"]
+
+_VISION_IDS = itertools.count()
+
+# process-wide trace tally for the shared jitted forwards — engines
+# snapshot at creation and report deltas (the LM engine's _TRACES
+# idiom); keyed bumps happen at TRACE time only
+_TRACES: Dict[str, int] = {"forward": 0}
+
+# (id(predict_fn), batch, feature_len) → jitted forward; engines over
+# the same predict function share executables, so growing a vision
+# group compiles nothing new (the #buckets+1 analog: ONE forward)
+_FORWARD_CACHE: Dict[Tuple[int, int, int], Callable] = {}
+
+
+def _forward_for(predict_fn: Callable, batch: int,
+                 feature_len: int) -> Callable:
+    key = (id(predict_fn), batch, feature_len)
+    fn = _FORWARD_CACHE.get(key)
+    if fn is None:
+        def _traced(feats):
+            _TRACES["forward"] += 1
+            return jnp.argmax(predict_fn(feats), axis=-1)
+
+        fn = jax.jit(_traced)
+        _FORWARD_CACHE[key] = fn
+    return fn
+
+
+class VisionEngine:
+    """Fixed-batch classification engine behind the router surface.
+
+    >>> eng = VisionEngine(predict_fn, batch=4, feature_len=64,
+    ...                    model_tag="vision")
+    >>> router = EngineRouter([lm_eng, eng], tenancy=ctl)
+
+    `predict_fn(feats)` maps a `(batch, feature_len)` float32 array to
+    `(batch, num_classes)` logits — a closed-over-params apply, the
+    Predictor's forward. All knobs are constructor args, never env
+    (graftlint trace-env-read)."""
+
+    role = "serving"
+    tp = 1
+
+    def __init__(self, predict_fn: Callable, *, batch: int = 4,
+                 feature_len: int, model_tag: Optional[str] = "vision",
+                 max_queue: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 obs_label: Optional[str] = None):
+        if batch < 1:
+            raise ValueError("batch must be >= 1")
+        if feature_len < 1:
+            raise ValueError("feature_len must be >= 1")
+        self.model = predict_fn        # the identity move_engine checks
+        self.batch = batch
+        self.feature_len = feature_len
+        self.model_tag = model_tag
+        self.max_queue = max_queue
+        self._clock = clock
+        self._forward = _forward_for(predict_fn, batch, feature_len)
+        self._queue: deque = deque()
+        self._meta: Dict[int, Dict[str, float]] = {}
+        self._ids = itertools.count()
+        self.completed: Dict[int, GenerationResult] = {}
+        self._draining = False
+        self._stats = {"submitted": 0, "forwards": 0, "classified": 0,
+                       "rejected": 0, "expired": 0,
+                       # fleet-wide key the LM engine also reports —
+                       # router tests/drills read it group-agnostically
+                       "requests_done": 0}
+        self._obs_name = obs_label or f"vision{next(_VISION_IDS)}"
+        reg = obs.get_registry()
+        # a vision terminal IS a serving terminal: bind the exact
+        # family + label set the LM engine registers. The registry is
+        # runtime-idempotent (it hands back the one family and raises
+        # on any label-set drift), and a vision-only process on a
+        # fresh registry must still be able to create it — reg.get()
+        # would return None there.
+        self._m_requests = reg.counter(  # graftlint: disable=metric-family-contract
+            "serving_requests_total",
+            "requests reaching a terminal status",
+            labelnames=("engine", "status", "tp"))
+        self._trace0 = dict(_TRACES)
+
+    # -------------------------------------------------------------- router
+    # surface parity with InferenceEngine — the router is layout- and
+    # plane-blind, it only reads these
+    @property
+    def obs_name(self) -> str:
+        return self._obs_name
+
+    @property
+    def layout_family(self) -> str:
+        return "fp32/float32"
+
+    @property
+    def degraded(self) -> Optional[str]:
+        return None                   # no watchdog/retry plane here
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def slots(self) -> int:
+        return self.batch
+
+    @property
+    def slots_active(self) -> int:
+        return 0                      # forwards are synchronous
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def idle(self) -> bool:
+        return not self._queue
+
+    @property
+    def buckets(self) -> Tuple[int, ...]:
+        return (self.feature_len,)
+
+    @property
+    def spill_enabled(self) -> bool:
+        return False
+
+    def prefix_match_tokens(self, prompt: Sequence[int]) -> int:
+        return 0                      # no KV plane, nothing is warm
+
+    def export_tree(self) -> list:
+        return []
+
+    def import_tree(self, entries) -> int:
+        return 0
+
+    def import_handoff(self, pkg) -> bool:
+        return False
+
+    def take_handoffs(self) -> list:
+        return []
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        out = dict(self._stats)
+        out["forward_traces"] = (_TRACES["forward"]
+                                 - self._trace0["forward"])
+        return out
+
+    # ---------------------------------------------------------------- host
+    def submit(self, request: Request) -> int:
+        if self._draining:
+            raise EngineDraining(
+                "engine is draining (stop-admission): route new "
+                "requests to another engine in the pool")
+        n = len(request.prompt)
+        if n == 0:
+            raise ValueError("empty feature vector")
+        if n > self.feature_len:
+            raise ValueError(f"feature vector of {n} exceeds "
+                             f"feature_len={self.feature_len}")
+        in_flight = {r.id for r in self._queue} | set(self.completed)
+        if request.id is None:
+            rid = next(self._ids)
+            while rid in in_flight:
+                rid = next(self._ids)
+            request.id = rid
+        elif request.id in in_flight:
+            raise ValueError(f"request id {request.id} already in "
+                             "flight or completed-unclaimed")
+        if request.trace_id is None:
+            request.trace_id = f"{self._obs_name}/{request.id}"
+            request.hop = 0
+        self._expire_queued(self._clock())
+        if self.max_queue is not None \
+                and len(self._queue) >= self.max_queue:
+            # reject-only overload: a vision batch group sheds at the
+            # router/tenancy layer, not per-engine
+            self._stats["rejected"] += 1
+            obs.emit_event("request_rejected", plane="serving",
+                           engine=self._obs_name, request=request.id,
+                           queue_depth=len(self._queue),
+                           **self._trace_fields(request))
+            raise OverloadError(
+                f"queue full ({self.max_queue}); request "
+                f"{request.id} rejected")
+        self._meta[request.id] = {"t": self._clock()}
+        self._queue.append(request)
+        self._stats["submitted"] += 1
+        obs.emit_event("request_submit", plane="serving",
+                       engine=self._obs_name, request=request.id,
+                       prompt_len=n, priority=request.priority,
+                       tp=self.tp, role=self.role,
+                       **self._trace_fields(request))
+        return request.id
+
+    # one journey-context builder fleet-wide — tenant/trace stamps on
+    # vision lifecycle events must render exactly like the LM plane's
+    _trace_fields = staticmethod(InferenceEngine._trace_fields)
+
+    def _expire_queued(self, now: float) -> None:
+        keep: deque = deque()
+        for r in self._queue:
+            t0 = self._meta[r.id]["t"]
+            ttl = min(
+                t0 + r.deadline_s if r.deadline_s is not None
+                else float("inf"),
+                t0 + r.max_queue_wait_s
+                if r.max_queue_wait_s is not None else float("inf"))
+            if now >= ttl:
+                self._terminal(r, "expired", "expired")
+            else:
+                keep.append(r)
+        self._queue = keep
+
+    def _pop_next(self) -> Request:
+        best_i, best_p = 0, None
+        for i, r in enumerate(self._queue):
+            if best_p is None or r.priority > best_p:
+                best_i, best_p = i, r.priority
+        req = self._queue[best_i]
+        del self._queue[best_i]
+        return req
+
+    def steal_queued(self, k: int) -> List[Tuple[Request, float]]:
+        """Router-rebalance donor side: lowest priority, youngest
+        within — the inverse of _pop_next (the LM engine's contract)."""
+        out: List[Tuple[Request, float]] = []
+        for _ in range(min(k, len(self._queue))):
+            best_i, best_p = 0, None
+            for i, r in enumerate(self._queue):
+                if best_p is None or r.priority <= best_p:
+                    best_i, best_p = i, r.priority
+            req = self._queue[best_i]
+            del self._queue[best_i]
+            meta = self._meta.pop(req.id, None)
+            out.append((req, meta["t"] if meta else self._clock()))
+        return out
+
+    def _requeue(self, request: Request,
+                 t: Optional[float] = None) -> None:
+        self._meta[request.id] = {"t": self._clock() if t is None
+                                  else t}
+        self._queue.append(request)
+
+    def _terminal(self, req: Request, reason: str, status: str,
+                  tokens: Optional[List[int]] = None) -> None:
+        t0 = self._meta.pop(req.id, {}).get("t")
+        now = self._clock()
+        latency = None if t0 is None else now - t0
+        ttft = latency if (status == "done"
+                           and latency is not None) else None
+        res = GenerationResult(req.id, list(req.prompt),
+                               tokens or [], reason, status,
+                               ttft_s=ttft, latency_s=latency)
+        self.completed[req.id] = res
+        self._stats["expired" if status == "expired"
+                    else "classified"] += 1
+        if status == "done":
+            self._stats["requests_done"] += 1
+        if obs.enabled():
+            self._m_requests.labels(engine=self._obs_name,
+                                    status=status, tp="1").inc()
+        obs.emit_event("request_terminal", plane="serving",
+                       engine=self._obs_name, request=req.id,
+                       status=status, reason=reason,
+                       tokens=len(tokens or []),
+                       ttft_s=ttft, latency_s=latency,
+                       tp=self.tp, role=self.role,
+                       **self._trace_fields(req))
+
+    # ---------------------------------------------------------------- step
+    def step(self) -> List[GenerationResult]:
+        """Form one fixed-shape batch (priority order, at most
+        `batch`), run the shared jitted forward, settle every member
+        with its argmax class as the single emitted token."""
+        self._expire_queued(self._clock())
+        ids_before = set(self.completed)
+        if self._queue:
+            taken: List[Request] = []
+            while self._queue and len(taken) < self.batch:
+                taken.append(self._pop_next())
+            feats = np.zeros((self.batch, self.feature_len),
+                             dtype=np.float32)
+            for i, r in enumerate(taken):
+                # host-side list -> host buffer, no device involved
+                feats[i, :len(r.prompt)] = np.asarray(  # graftlint: disable=hidden-device-sync
+                    r.prompt, dtype=np.float32)
+            # THE one deliberate device->host fetch: the jitted
+            # forward's argmax classes, once per fixed-shape batch
+            # (never per request) — the engine's one-fetch-per-step
+            # idiom
+            classes = np.asarray(self._forward(feats))  # graftlint: disable=hidden-device-sync
+            self._stats["forwards"] += 1
+            for i, r in enumerate(taken):
+                self._terminal(r, "classified", "done",
+                               tokens=[int(classes[i])])
+        return [self.completed[rid]
+                for rid in sorted(set(self.completed) - ids_before)]
+
+    def run(self, requests: Optional[Sequence[Request]] = None
+            ) -> List[GenerationResult]:
+        ids = [self.submit(r) for r in requests] if requests else None
+        while self._queue:
+            self.step()
+        if ids is None:
+            out = sorted(self.completed.values(), key=lambda r: r.id)
+            self.completed = {}
+            return out
+        return [self.completed.pop(i) for i in ids]
+
+    # --------------------------------------------------------------- admin
+    def drain(self) -> None:
+        self._draining = True
+
+    def health(self) -> Dict[str, object]:
+        state = "ok"
+        if self._draining:
+            state = "drained" if self.idle else "draining"
+        return {
+            "state": state,
+            "model_tag": self.model_tag,
+            "slots": self.batch,
+            "slots_active": 0,
+            "queue_depth": len(self._queue),
+            "max_queue": self.max_queue,
+            "feature_len": self.feature_len,
+            "stats": self.stats,
+        }

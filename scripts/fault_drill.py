@@ -1974,6 +1974,124 @@ def drill_fleet_journey(workdir):
             "events": dict(sorted(counts.items()))}
 
 
+def drill_tenant_noisy(workdir):
+    """ISSUE 19: noisy-neighbor containment, twice. A 'quiet' tenant's
+    4-request burst runs once alone (reference) and once co-resident
+    with a 'noisy' tenant flooding 16 requests AT THE SAME INSTANT,
+    both through one tenancy-armed router under a virtual clock. The
+    noisy tenant is budgeted by ITS OWN TenantSpec — a 2-token bucket
+    refilling at 0.5/s and a 6-deep pending bound — so the flood is
+    deferred and shed by its own gate while the quiet tenant's bucket
+    never empties. Pins: every quiet request finishes 'done' with
+    tokens BITWISE identical to the quiet-only run (containment means
+    the co-resident flood changes nothing the quiet tenant can
+    observe in its output); the flood draws both 'deferred' and
+    'shed' tenant_throttled events billed to the noisy tenant only;
+    and TWO invocations of the mixed run produce byte-identical leg
+    digests (throttle event stream, per-tenant stats, every token) —
+    admission is a pure function of the trace and the injected
+    clock."""
+    from bigdl_tpu.serving import (EngineRouter, TenancyController,
+                                   TenantSpec)
+
+    quiet = [dict(prompt=[i + 1, i + 2, i + 3], max_new_tokens=4,
+                  temperature=0.7, seed=50 + i, tenant="quiet")
+             for i in range(4)]
+    noisy = [dict(prompt=[(3 * i) % 30 + 1, (5 * i) % 30 + 2],
+                  max_new_tokens=4, temperature=0.7, seed=150 + i,
+                  tenant="noisy") for i in range(16)]
+
+    def run(include_noisy):
+        clk = {"t": 0.0}
+
+        def c():
+            return clk["t"]
+
+        with _telemetry(clock=c) as log:
+            eng = _engine(slots=4, obs_label="s0", clock=c)
+            ctl = TenancyController(
+                [TenantSpec("quiet", bucket_capacity=8.0,
+                            refill_rate=2.0),
+                 TenantSpec("noisy", bucket_capacity=2.0,
+                            refill_rate=0.25, max_pending=6)],
+                clock=c)
+            router = EngineRouter([eng], clock=c, obs_label="r0",
+                                  tenancy=ctl)
+            got = {}
+
+            def step_round():
+                clk["t"] += 0.5
+                for res in router.step():
+                    got[res.id] = res
+
+            # wave 1: the quiet burst plus half the flood at t=0 —
+            # the flood instantly drains its 2-token bucket and fills
+            # its 6-deep pending bound (overflow sheds on arrival)
+            ids = [router.submit(_req(**s))
+                   for s in quiet + (noisy[:8] if include_noisy
+                                     else [])]
+            # a FIXED 4 rounds (2 virtual seconds) so wave 2 lands on
+            # a drained bucket at the same instant every invocation
+            for _ in range(4):
+                step_round()
+            # wave 2: the rest of the flood meets an empty bucket —
+            # these offers are DEFERRED (throttle events) until the
+            # pending bound sheds the tail
+            if include_noisy:
+                ids += [router.submit(_req(**s)) for s in noisy[8:]]
+            rounds = 0
+            while len(got) < len(ids):
+                rounds += 1
+                if rounds > 400:
+                    raise RuntimeError(
+                        f"tenant_noisy drill stalled: {len(got)}/"
+                        f"{len(ids)} settled after {rounds} rounds")
+                step_round()
+            throttled = log.events("tenant_throttled")
+            digest = json.dumps(
+                {"events": log.counts_by_kind(),
+                 "throttled": throttled,
+                 "stats": {t: ctl.stats(t) for t in ctl.tenants},
+                 "tokens": {i: got[i].tokens for i in ids}},
+                sort_keys=True)
+        return [got[i] for i in ids], throttled, ctl, digest
+
+    ref, ref_throttle, _, _ = run(False)
+    mixed, throttle1, ctl1, d1 = run(True)
+    _, _, _, d2 = run(True)
+
+    nq = len(quiet)
+    quiet_res, noisy_res = mixed[:nq], mixed[nq:]
+    quiet_tokens_identical = \
+        [r.tokens for r in quiet_res] == [r.tokens for r in ref]
+    actions = {e["action"] for e in throttle1}
+    billed = {e["tenant"] for e in throttle1}
+    nstat = ctl1.stats("noisy")
+    ok = (all(r.status == "done" for r in ref)
+          and not ref_throttle                 # quiet alone: no gate
+          and all(r.status == "done" for r in quiet_res)
+          and quiet_tokens_identical
+          and {"defer", "shed"} <= actions
+          and billed == {"noisy"}              # containment: the flood
+          and ctl1.stats("quiet")["deferred"] == 0   # bills only itself
+          and ctl1.stats("quiet")["shed"] == 0
+          and nstat["shed"] > 0
+          and sum(1 for r in noisy_res if r.status == "shed")
+          == nstat["shed"]
+          and all(r.status in ("done", "shed") for r in noisy_res)
+          and d1 == d2)
+    return {"ok": bool(ok),
+            "quiet_tokens_identical": quiet_tokens_identical,
+            "quiet_statuses": [r.status for r in quiet_res],
+            "noisy_statuses": sorted(
+                {r.status for r in noisy_res}),
+            "noisy_stats": nstat,
+            "throttle_actions": sorted(actions),
+            "throttle_billed_to": sorted(billed),
+            "report_byte_identical": d1 == d2,
+            "events": json.loads(d1)["events"]}
+
+
 TRAINING_LEGS = {
     "nan_skip": drill_nan_skip,
     "nan_skip_mesh": lambda wd: drill_nan_skip(wd, mesh=True),
@@ -2006,6 +2124,7 @@ SERVING_LEGS = {
     "fleet_tp_failover": drill_fleet_tp_failover,
     "fleet_journey": drill_fleet_journey,
     "slo_alert": drill_slo_alert,
+    "tenant_noisy": drill_tenant_noisy,
 }
 
 LEGS = {**TRAINING_LEGS, **SERVING_LEGS}

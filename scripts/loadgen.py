@@ -35,6 +35,18 @@ threshold alerts evaluate deterministically, and the report gains an
 counts and final states). Byte-identity is preserved: the SLO plane
 is a pure function of the trace.
 
+Multi-tenant mode (ISSUE 19): `--tenants N` stamps every request with
+a tenant and arms the router's TenancyController — deterministic
+token-bucket admission plus weighted-fair release on the SAME virtual
+clock. `--noisy-tenant i` makes tenant i submit `--noisy-mult`x the
+arrival mass while budgeting it with a tighter bucket: the containment
+demo is that the quiet tenants' p99 stays put while the noisy tenant
+is throttled by ITS OWN budget. `--vision-frac` mixes in vision
+classification requests served by a `model_tag="vision"` engine group
+next to the LM pool (dispatch/failover never cross groups). The
+report gains "tenants" (per-tenant goodput/p99/throttle counts) and
+`pool.groups` sections; byte-identity is preserved.
+
 Usage (CPU, reproducible):
     JAX_PLATFORMS=cpu python scripts/loadgen.py --requests 32 \
         --engines 2 --arrival bursty --seed 0
@@ -42,6 +54,8 @@ Usage (CPU, reproducible):
         --autoscale --target-p99 8.0 --max-engines 3
     JAX_PLATFORMS=cpu python scripts/loadgen.py --requests 32 \
         --slo-target-p99 6.0 --slo-goodput 0.95
+    JAX_PLATFORMS=cpu python scripts/loadgen.py --requests 24 \
+        --tenants 2 --noisy-tenant 1 --vision-frac 0.25 --seed 0
 """
 
 from __future__ import annotations
@@ -87,7 +101,11 @@ def make_trace(n_requests: int = 32, *, seed: int = 0,
                sessions: int = 0, session_turns: int = 3,
                think_s: float = 1.0, vocab: int = 50,
                shared_prefix_len: int = 0,
-               shared_frac: float = 0.9) -> dict:
+               shared_frac: float = 0.9,
+               tenants: int = 0, noisy_tenant: Optional[int] = None,
+               noisy_mult: float = 4.0,
+               vision_frac: float = 0.0,
+               feature_len: int = 8) -> dict:
     """Build a deterministic trace: `n_requests` single-shot requests
     plus `sessions` multi-turn sessions (their heads arrive through
     the same arrival process; later turns are scheduled at replay
@@ -103,7 +121,17 @@ def make_trace(n_requests: int = 32, *, seed: int = 0,
     prompt_len_choices) — the traffic shape whose prefill the paged
     prefix cache amortizes away. Non-shared requests draw a fully
     unique prompt of the same total length, keeping the two
-    populations comparable."""
+    populations comparable.
+
+    `tenants` > 0 stamps each request with one of `tenants` tenant
+    names (ISSUE 19), drawn from the same RandomState; the tenant at
+    index `noisy_tenant` submits `noisy_mult`x the per-request
+    probability mass — the noisy-neighbor arrival mix the tenancy
+    gate contains. `vision_frac` > 0 makes that fraction of the
+    single-shot requests vision classifications (`model_tag='vision'`,
+    a `feature_len`-int feature vector as the prompt) interleaved on
+    the same arrival process — the heterogeneous-fleet mixed trace;
+    sessions always stay LM."""
     if arrival not in ("poisson", "bursty"):
         raise ValueError(f"arrival {arrival!r}: expected poisson|bursty")
     rng = np.random.RandomState(seed)
@@ -135,6 +163,23 @@ def make_trace(n_requests: int = 32, *, seed: int = 0,
         )
         if deadline_frac and float(rng.rand()) < deadline_frac:
             spec["deadline_s"] = deadline_s
+        # ISSUE 19 draws ride AFTER the pre-existing ones, each behind
+        # its own flag — traces built without these knobs keep the
+        # exact pre-19 draw sequence (the drills pin those bytes)
+        if tenants:
+            w = np.ones(tenants)
+            if noisy_tenant is not None:
+                w[noisy_tenant] = noisy_mult
+            j = int(rng.choice(tenants, p=w / w.sum()))
+            spec["tenant"] = f"tenant{j}"
+        if vision_frac and i < n_requests \
+                and float(rng.rand()) < vision_frac:
+            # single-shot only: a session's history concat is an LM
+            # notion. The feature "prompt" reuses the token alphabet
+            spec["prompt"] = [int(x) for x in rng.randint(
+                1, vocab, feature_len)]
+            spec["model_tag"] = "vision"
+            spec["max_new_tokens"] = 1
         arrivals.append(Arrival(
             round(t, 6), spec,
             session=i - n_requests if i >= n_requests else None))
@@ -210,7 +255,12 @@ def replay(router, trace: dict, *, clock: Dict[str, float],
                 f"replay did not converge in {max_rounds} rounds "
                 f"({len(results)}/{expected} settled)")
         submit_due()
-        if heap and heap[0][0] > clock["t"] \
+        # the pool is only IDLE when no work is parked behind a tenant
+        # gate either — jumping while tenancy holds requests would skip
+        # the refill rounds that release them (and hide throttling)
+        parked = router.tenancy.pending if router.tenancy is not None \
+            else 0
+        if heap and heap[0][0] > clock["t"] and not parked \
                 and all(e.idle for e in router.engines):
             clock["t"] = heap[0][0]              # jump the idle gap
             continue
@@ -236,12 +286,14 @@ def replay(router, trace: dict, *, clock: Dict[str, float],
                 nxt = Arrival(round(clock["t"] + sess["think_s"], 6),
                               nspec, a.session, a.turn + 1)
                 heapq.heappush(heap, (nxt.t, next(seqc), nxt))
+    tenants_of = {rid: (a.spec.get("tenant") or "default")
+                  for rid, a in owner.items()}
     return _report(results, clock["t"], router, rejected, autoscaler,
-                   step_dt)
+                   step_dt, tenants_of=tenants_of)
 
 
 def _report(results, makespan, router, rejected, autoscaler,
-            step_dt) -> dict:
+            step_dt, tenants_of=None) -> dict:
     """The load report: goodput + SLO percentiles from the results'
     engine-clock lifecycle stamps (virtual seconds)."""
     done = [r for r in results.values() if r.status == "done"]
@@ -316,6 +368,36 @@ def _report(results, makespan, router, rejected, autoscaler,
             "hit_rate": (round(hits / len(results), 4)
                          if results else 0.0),
         }
+    groups = router.groups if hasattr(router, "groups") else {}
+    if len(groups) > 1:
+        report["pool"]["groups"] = {
+            g: len(members) for g, members in sorted(groups.items())}
+    ctl = getattr(router, "tenancy", None)
+    if ctl is not None:
+        # per-tenant rollup (ISSUE 19): terminal stamps split by the
+        # tenant each request billed against, plus the controller's
+        # own admission counters — all host-side, so the section rides
+        # the byte-identical acceptance like spec/kv_tier
+        tsec = {}
+        for name in ctl.tenants:
+            rs = [r for r in results.values()
+                  if (tenants_of or {}).get(r.id) == name]
+            tdone = [r for r in rs if r.status == "done"]
+            tlat = [r.latency_s for r in tdone
+                    if r.latency_s is not None]
+            st = ctl.stats(name)
+            tsec[name] = {
+                "requests": len(rs),
+                "done": len(tdone),
+                "goodput_tokens": sum(len(r.tokens) for r in tdone),
+                "latency_p50_s": _pctl(tlat, 0.50),
+                "latency_p99_s": _pctl(tlat, 0.99),
+                "throttled": {"deferred": st["deferred"],
+                              "shed": st["shed"]},
+                "expired": st["expired"],
+                "weight": ctl.spec(name).weight,
+            }
+        report["tenants"] = tsec
     if autoscaler is not None:
         report["autoscale"] = {
             "target_p99_s": autoscaler.target_p99_s,
@@ -339,7 +421,10 @@ def build_fleet(engines: int = 1, *, slots: int = 4,
                 spec_adapt_window: int = 4,
                 spec_probe_every: int = 16,
                 host_blocks: Optional[int] = None,
-                affinity: bool = False):
+                affinity: bool = False,
+                tenant_specs=None,
+                vision: bool = False, vision_engines: int = 1,
+                vision_batch: int = 4, feature_len: int = 8):
     """Tiny-LM fleet for the CLI and the drills: a routed pool over
     ONE model object (engines share executables — #buckets+1 compiles
     total however large the pool grows), every clock the same virtual
@@ -367,7 +452,15 @@ def build_fleet(engines: int = 1, *, slots: int = 4,
     dying; prefix hits re-admit the bytes), and `affinity=True`
     routes admissions to the engine whose radix tree already holds
     the longest prompt prefix — both pure placement, so tokens and
-    the byte-identical acceptance are unchanged."""
+    the byte-identical acceptance are unchanged.
+
+    ISSUE 19: `tenant_specs` arms a TenancyController on the SAME
+    virtual clock (per-tenant token-bucket admission + WFQ release at
+    the router), and `vision=True` adds a `model_tag='vision'` engine
+    group (`vision_engines` x VisionEngine over one shared predict
+    function — one executable group-wide) next to the LM pool, with a
+    dict-valued engine_factory so the Autoscaler can grow either
+    group."""
     import jax
 
     from bigdl_tpu.models.transformer import build_lm
@@ -417,10 +510,41 @@ def build_fleet(engines: int = 1, *, slots: int = 4,
                                  adapt_window=spec_adapt_window,
                                  probe_every=spec_probe_every)
 
-    router = EngineRouter([factory() for _ in range(engines)],
-                          engine_factory=factory,
-                          clock=lambda: clk["t"],
-                          affinity=affinity)
+    pool = [factory() for _ in range(engines)]
+    fleet_factory = factory
+    if vision:
+        from bigdl_tpu.serving import VisionEngine
+
+        # one predict function (closed-over weights) per fleet — the
+        # jitted forward memoizes on it, so every vision engine here
+        # (and any the autoscaler adds) shares ONE executable
+        w_vis = jax.random.normal(jax.random.PRNGKey(2),
+                                  (feature_len, 10))
+
+        def predict_fn(feats, _w=w_vis):
+            return feats @ _w
+
+        def vision_factory():
+            return VisionEngine(predict_fn, batch=vision_batch,
+                                feature_len=feature_len,
+                                model_tag="vision",
+                                clock=lambda: clk["t"])
+
+        pool.extend(vision_factory() for _ in range(vision_engines))
+        fleet_factory = {"default": factory, "vision": vision_factory}
+    # the router requires clock IDENTITY with its tenancy controller
+    # (one virtual timeline), so both get the same callable object
+    router_clock = lambda: clk["t"]  # noqa: E731
+    tenancy = None
+    if tenant_specs is not None:
+        from bigdl_tpu.serving import TenancyController
+
+        tenancy = TenancyController(tenant_specs, clock=router_clock)
+    router = EngineRouter(pool,
+                          engine_factory=fleet_factory,
+                          clock=router_clock,
+                          affinity=affinity,
+                          tenancy=tenancy)
     asc = Autoscaler(router, target_p99_s=target_p99_s,
                      max_engines=max_engines,
                      evaluate_every_s=evaluate_every_s) \
@@ -519,6 +643,42 @@ def main(argv=None) -> int:
                          "--sessions or --host-blocks)")
     ap.add_argument("--no-affinity", dest="affinity",
                     action="store_false")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="multi-tenant mode (ISSUE 19): stamp each "
+                         "request with one of N tenant names and arm "
+                         "the router's TenancyController (per-tenant "
+                         "token-bucket admission + weighted-fair "
+                         "release); the report gains a 'tenants' "
+                         "section (per-tenant goodput/p99/throttle "
+                         "counts); two runs stay byte-identical")
+    ap.add_argument("--noisy-tenant", type=int, default=None,
+                    help="index of the noisy tenant: it submits "
+                         "--noisy-mult x the arrival mass but is "
+                         "budgeted by a tight bucket "
+                         "(--noisy-bucket-capacity/--noisy-refill) — "
+                         "the containment demo")
+    ap.add_argument("--noisy-mult", type=float, default=4.0)
+    ap.add_argument("--bucket-capacity", type=float, default=8.0,
+                    help="token-bucket burst capacity for ordinary "
+                         "tenants")
+    ap.add_argument("--bucket-refill", type=float, default=1.0,
+                    help="token-bucket refill per virtual second for "
+                         "ordinary tenants")
+    ap.add_argument("--noisy-bucket-capacity", type=float, default=2.0)
+    ap.add_argument("--noisy-refill", type=float, default=0.5)
+    ap.add_argument("--noisy-max-pending", type=int, default=None,
+                    help="shed the noisy tenant's arrivals past this "
+                         "many deferred requests (its own bound — "
+                         "other tenants unbounded)")
+    ap.add_argument("--vision-frac", type=float, default=0.0,
+                    help="mixed heterogeneous trace (ISSUE 19): this "
+                         "fraction of the single-shot requests become "
+                         "vision classifications served by a "
+                         "model_tag='vision' engine group next to the "
+                         "LM pool (dispatch/failover never cross "
+                         "groups)")
+    ap.add_argument("--vision-engines", type=int, default=1)
+    ap.add_argument("--feature-len", type=int, default=8)
     ap.add_argument("--autoscale", action="store_true")
     ap.add_argument("--target-p99", type=float, default=8.0)
     ap.add_argument("--max-engines", type=int, default=4)
@@ -563,7 +723,12 @@ def main(argv=None) -> int:
                        sessions=args.sessions,
                        session_turns=args.turns,
                        shared_prefix_len=args.shared_prefix,
-                       shared_frac=args.shared_frac)
+                       shared_frac=args.shared_frac,
+                       tenants=args.tenants,
+                       noisy_tenant=args.noisy_tenant,
+                       noisy_mult=args.noisy_mult,
+                       vision_frac=args.vision_frac,
+                       feature_len=args.feature_len)
     # shared-prefix prompts are prefix + tail long: grow the bucket
     # ladder (and keep max_len a block multiple) so the COLD first
     # request of each prefix still fits one prefill bucket
@@ -579,6 +744,27 @@ def main(argv=None) -> int:
     # multi-turn sessions and spill-tier runs (ISSUE 16)
     affinity = args.affinity if args.affinity is not None \
         else bool(args.sessions or args.host_blocks is not None)
+    # multi-tenant mode (ISSUE 19): every tenant gets a deterministic
+    # token bucket on the fleet's virtual clock; the noisy tenant (if
+    # any) is budgeted tighter — containment comes from ITS bucket,
+    # never from penalizing the others
+    tenant_specs = None
+    if args.tenants:
+        from bigdl_tpu.serving import TenantSpec
+
+        tenant_specs = []
+        for j in range(args.tenants):
+            noisy = args.noisy_tenant is not None \
+                and j == args.noisy_tenant
+            tenant_specs.append(TenantSpec(
+                f"tenant{j}",
+                weight=1.0,
+                bucket_capacity=(args.noisy_bucket_capacity if noisy
+                                 else args.bucket_capacity),
+                refill_rate=(args.noisy_refill if noisy
+                             else args.bucket_refill),
+                max_pending=(args.noisy_max_pending if noisy
+                             else None)))
     router, asc, clk = build_fleet(
         args.engines, slots=args.slots, max_queue=args.max_queue,
         overload_policy=args.overload_policy,
@@ -590,7 +776,11 @@ def main(argv=None) -> int:
         spec_adaptive=args.spec_adaptive,
         spec_adapt_window=args.spec_adapt_window,
         spec_probe_every=args.spec_probe_every,
-        host_blocks=args.host_blocks, affinity=affinity)
+        host_blocks=args.host_blocks, affinity=affinity,
+        tenant_specs=tenant_specs,
+        vision=args.vision_frac > 0,
+        vision_engines=args.vision_engines,
+        feature_len=args.feature_len)
     # speculation flywheel (ISSUE 18): the distiller ingests every
     # completed stream in completion order (deterministic under the
     # virtual clock) and every --spec-swap-every results trains +
@@ -645,6 +835,26 @@ def main(argv=None) -> int:
                 long_window_s=20 * args.step_dt,
                 short_window_s=5 * args.step_dt,
                 clear_s=5 * args.step_dt))
+            # per-tenant objectives (ISSUE 19): same burn-rate shape
+            # over the tenant-labelled latency family, one objective
+            # per tenant, so the report/console can show which
+            # tenant's budget is burning (the quiet tenant should
+            # stay compliant while the noisy one throttles)
+            for j in range(args.tenants):
+                tn = f"tenant{j}"
+                rules.append(AlertRule(
+                    name=f"latency_p99_burn_{tn}",
+                    objective=SLOObjective(
+                        name=f"latency_p99_{tn}",
+                        kind="latency_quantile",
+                        metric="router_tenant_request_latency_seconds",
+                        target=args.slo_target_p99, q=0.99,
+                        labels={"router": router._obs_name,
+                                "tenant": tn}),
+                    kind="burn_rate",
+                    long_window_s=20 * args.step_dt,
+                    short_window_s=5 * args.step_dt,
+                    clear_s=5 * args.step_dt))
         if args.slo_goodput is not None:
             rules.append(AlertRule(
                 name="goodput_budget",

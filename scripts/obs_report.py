@@ -109,6 +109,9 @@ def summarize(events: List[dict]) -> Dict[str, object]:
             "rejected": by_kind.get("request_rejected", 0),
         }
         out["slo"] = _slo_section(term)
+    tenants = _tenant_section(events)
+    if tenants:
+        out["tenants"] = tenants
     journeys = _journeys_section(events)
     if journeys:
         out["journeys"] = journeys
@@ -216,6 +219,34 @@ def _slo_section(term: List[dict]) -> dict:
             f"tp={tp}": _slo_digest([e for e in term
                                      if e.get("tp") == tp])
             for tp in layouts}
+    return out
+
+
+def _tenant_section(events: List[dict]) -> Optional[dict]:
+    """Per-tenant compliance digest (ISSUE 19): the same SLO numbers
+    the per-engine table carries, split by the tenant each terminal
+    billed against, plus the tenant's throttle counts (token-bucket
+    defers/sheds from the router's admission gate and kv_quota blocks
+    from the engines). Only present when the run carried tenant
+    stamps; untagged terminals roll up under '(untagged)'."""
+    term = [e for e in events if e.get("kind") == "request_terminal"]
+    throttles = [e for e in events
+                 if e.get("kind") == "tenant_throttled"]
+    if not any(e.get("tenant") for e in term) and not throttles:
+        return None
+    tenants = sorted({e.get("tenant") or "(untagged)" for e in term}
+                     | {e["tenant"] for e in throttles})
+    out = {}
+    for t in tenants:
+        evs = [e for e in term
+               if (e.get("tenant") or "(untagged)") == t]
+        d = _slo_digest(evs) if evs else {"requests": 0, "done": 0}
+        thr = [e for e in throttles if e["tenant"] == t]
+        by_action: Dict[str, int] = {}
+        for e in thr:
+            by_action[e["action"]] = by_action.get(e["action"], 0) + 1
+        d["throttled"] = dict(sorted(by_action.items()))
+        out[t] = d
     return out
 
 
@@ -638,6 +669,24 @@ def render(events: List[dict], tail: int = 15) -> str:
             rows.append((tag, fmt_slo(d)))
         for layout, d in s["slo"].get("per_layout", {}).items():
             rows.append((layout, fmt_slo(d)))
+        lines.append(_fmt_table(rows))
+    if "tenants" in s:
+        lines.append("\ntenants:")
+        rows = []
+        for t, d in s["tenants"].items():
+            thr = d.get("throttled", {})
+            thr_txt = ("none" if not thr else
+                       " ".join(f"{k}={n}"
+                                for k, n in thr.items()))
+            if d["requests"]:
+                p99 = d.get("latency_p99_s")
+                p99_txt = "-" if p99 is None else f"{p99:.4g}s"
+                rows.append((t, f"done {d['done']}/{d['requests']}"
+                                f"  goodput {d['goodput_tokens']} tok"
+                                f"  p99 {p99_txt}"
+                                f"  throttled {thr_txt}"))
+            else:
+                rows.append((t, f"no terminals  throttled {thr_txt}"))
         lines.append(_fmt_table(rows))
     if "journeys" in s:
         jm = s["journeys"]["summary"]

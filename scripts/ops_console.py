@@ -144,6 +144,23 @@ def render_frame(events: List[dict]) -> str:
     else:
         lines.extend(_kv_rows([]))
 
+    # ---- tenants (ISSUE 19) ----------------------------------------
+    tn = s.get("tenants")
+    if tn:
+        lines.append(_rule("tenants"))
+        rows = []
+        for t, d in tn.items():
+            thr = d.get("throttled", {})
+            thr_txt = ("none" if not thr else
+                       " ".join(f"{k}={n}" for k, n in thr.items()))
+            if d["requests"]:
+                rows.append((t, f"done {d['done']}/{d['requests']}  "
+                                f"p99 {_fmt_s(d.get('latency_p99_s'))}"
+                                f"  throttled {thr_txt}"))
+            else:
+                rows.append((t, f"no terminals  throttled {thr_txt}"))
+        lines.extend(_kv_rows(rows))
+
     # ---- alerts -----------------------------------------------------
     lines.append(_rule("alerts"))
     al = s.get("alerts")

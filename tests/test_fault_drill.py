@@ -73,7 +73,8 @@ def test_elastic_drill_leg(tmp_path, leg):
                                  "fleet_affinity_failover", "fleet_drain",
                                  "fleet_autoscale",
                                  "fleet_tp_failover",
-                                 "fleet_journey", "slo_alert"])
+                                 "fleet_journey", "slo_alert",
+                                 "tenant_noisy"])
 def test_serving_drill_leg(tmp_path, leg):
     """ISSUE 4 + ISSUE 7 + ISSUE 10 + ISSUE 11 + ISSUE 14: the
     serving-plane reliability drills (poisoned co-batch, overload
@@ -85,8 +86,11 @@ def test_serving_drill_leg(tmp_path, leg):
     fires and resolves deterministically with a byte-identical
     slo_burn bundle) and the ISSUE 18 speculation-flywheel drill
     (planted accept collapse suspends speculation with tokens bitwise
-    target-only; a distilled hot-swapped draft resumes it) run
-    bit-deterministically on every tier-1 pass.
+    target-only; a distilled hot-swapped draft resumes it) and the
+    ISSUE 19 noisy-neighbor drill (a co-resident flood is throttled by
+    its own token bucket while the quiet tenant's tokens stay bitwise
+    identical to a quiet-only run) run bit-deterministically on every
+    tier-1 pass.
     Legs must actually DRILL here: the CPU-mesh conftest gives them 8
     devices, so the device-count skip escape is asserted shut."""
     fd = _load_drill()

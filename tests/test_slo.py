@@ -352,6 +352,8 @@ def test_histogram_window_matches_legacy_window_p99():
 class _StubEngine:
     slots = 2
     max_queue = 8
+    model_tag = None                   # ISSUE 19: the "default" group
+    degraded = None
 
     def __init__(self):
         self.slots_active = 0
@@ -359,6 +361,10 @@ class _StubEngine:
         self.overload_policy = "reject"
         self._state = "running"
         self.obs_name = "stub"
+
+    @property
+    def draining(self):
+        return self._state != "running"
 
     def health(self):
         return {"state": self._state}
@@ -382,7 +388,7 @@ class _StubRouter:
     def healthy_engines(self):
         return [e for e in self.engines if e._state == "running"]
 
-    def add_engine(self):
+    def add_engine(self, group=None):
         self.engines.append(_StubEngine())
 
     def drain(self, e):

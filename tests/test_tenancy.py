@@ -1,0 +1,444 @@
+"""Multi-tenant fleet isolation (ISSUE 19): deterministic token-bucket
+admission, weighted-fair release, per-tenant KV quotas, model-tagged
+engine groups (cross-group failover refusal), and the fleet-wide
+compile contract with tenancy armed.
+
+The headline guarantee — a noisy tenant contained by ITS OWN budget
+while the quiet tenant's tokens stay bitwise identical — is drilled
+end-to-end in scripts/fault_drill.py (tenant_noisy leg, tier-1 via
+test_fault_drill); this file covers the machinery at unit granularity.
+"""
+
+import jax
+import pytest
+
+from bigdl_tpu import obs
+from bigdl_tpu.models.transformer import build_lm
+from bigdl_tpu.serving import (EngineRouter, InferenceEngine, Request,
+                               TenancyController, TenantSpec,
+                               TokenBucket, VisionEngine)
+from bigdl_tpu.utils import faults
+
+_LM = None
+
+
+def _lm():
+    global _LM
+    if _LM is None:
+        _LM = build_lm(vocab_size=50, dim=32, num_heads=2, num_layers=2,
+                       max_len=64)
+        _LM.build(jax.random.PRNGKey(0))
+    return _LM
+
+
+def _engine(**kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("prefill_buckets", (8,))
+    return InferenceEngine(_lm(), **kw)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    prev = obs.set_enabled(True)
+    obs.reset_all()
+    faults.set_plan(None)
+    yield
+    faults.set_plan(None)
+    obs.reset_all()
+    obs.set_enabled(prev)
+
+
+# --------------------------------------------------------- token bucket
+
+class TestTokenBucket:
+    def test_deterministic_refill_under_injected_clock(self):
+        clk = {"t": 0.0}
+        b = TokenBucket(2.0, 0.5, clock=lambda: clk["t"])
+        assert b.try_take(1.0) and b.try_take(1.0)
+        assert not b.try_take(1.0)          # empty at t=0
+        clk["t"] = 1.0
+        assert b.peek() == pytest.approx(0.5)
+        assert not b.try_take(1.0)          # half a token is not one
+        clk["t"] = 2.0
+        assert b.try_take(1.0)
+        clk["t"] = 100.0                    # refill caps at capacity
+        assert b.peek() == pytest.approx(2.0)
+        # two buckets replaying the same clock script agree exactly
+        clk2 = {"t": 0.0}
+        b2 = TokenBucket(2.0, 0.5, clock=lambda: clk2["t"])
+        for t in (0.0, 0.7, 1.3, 2.9, 4.0):
+            clk["t"] = clk2["t"] = 200.0 + t
+            assert b.try_take(1.0) == b2.try_take(1.0)
+            assert b.peek() == b2.peek()
+
+    def test_give_refunds_within_capacity(self):
+        clk = {"t": 0.0}
+        b = TokenBucket(1.0, 1.0, clock=lambda: clk["t"])
+        assert b.try_take(1.0)
+        b.give(1.0)
+        assert b.try_take(1.0)              # refunded token spendable
+        b.give(5.0)                         # refund never overfills
+        assert b.peek() == pytest.approx(1.0)
+
+    def test_validates_constructor(self):
+        clk = {"t": 0.0}
+        with pytest.raises(ValueError):
+            TokenBucket(0.0, 1.0, clock=lambda: clk["t"])
+        with pytest.raises(ValueError):
+            TokenBucket(1.0, -1.0, clock=lambda: clk["t"])
+
+
+# ------------------------------------------------------------------ WFQ
+
+def _ctl(specs, clk):
+    return TenancyController(specs, clock=lambda: clk["t"])
+
+
+def _treq(i, tenant, **kw):
+    kw.setdefault("prompt", [1 + i % 7, 2 + i % 5])
+    kw.setdefault("max_new_tokens", 2)
+    return Request(id=i, tenant=tenant, **kw)
+
+
+class TestWFQ:
+    def test_service_shares_follow_weights(self):
+        """Both tenants fully backlogged with generous buckets: the
+        release sequence interleaves by finish tag, so a weight-2
+        tenant drains exactly twice as fast as a weight-1 tenant."""
+        clk = {"t": 0.0}
+        ctl = _ctl([TenantSpec("fast", weight=2.0, bucket_capacity=64,
+                               refill_rate=64),
+                    TenantSpec("slow", weight=1.0, bucket_capacity=64,
+                               refill_rate=64)], clk)
+        for i in range(12):
+            ctl.offer(_treq(i, "fast"))
+            ctl.offer(_treq(100 + i, "slow"))
+        out = ctl.release({"default": 12})
+        by = [ctl.resolve(e.request.tenant) for e in out]
+        assert by.count("fast") == 8 and by.count("slow") == 4
+
+    def test_noisy_submit_ratio_never_starves_quiet(self):
+        """10:1 noisy/quiet submit ratio, equal weights: the quiet
+        tenant's single head releases among the FIRST TWO released —
+        arrival mass buys no extra share."""
+        clk = {"t": 0.0}
+        ctl = _ctl([TenantSpec("noisy", bucket_capacity=64,
+                               refill_rate=64),
+                    TenantSpec("quiet", bucket_capacity=64,
+                               refill_rate=64)], clk)
+        for i in range(10):
+            ctl.offer(_treq(i, "noisy"))
+        ctl.offer(_treq(50, "quiet"))
+        out = ctl.release({"default": 2})
+        assert {ctl.resolve(e.request.tenant) for e in out} \
+            == {"noisy", "quiet"}
+
+    def test_empty_bucket_skipped_not_waited_on(self):
+        """A throttled tenant's head must never head-of-line-block the
+        others: with 'broke' unable to pay, every release goes to
+        'funded' even though broke's finish tags are smaller."""
+        clk = {"t": 0.0}
+        ctl = _ctl([TenantSpec("broke", bucket_capacity=1.0,
+                               refill_rate=0.001),
+                    TenantSpec("funded", bucket_capacity=64,
+                               refill_rate=64)], clk)
+        ctl.offer(_treq(0, "broke"))
+        ctl.offer(_treq(1, "broke"))        # tags 1, 2
+        for i in range(4):
+            ctl.offer(_treq(10 + i, "funded"))
+        first = ctl.release({"default": 1})
+        assert [e.request.tenant for e in first] == ["broke"]
+        rest = ctl.release({"default": 3})  # broke's bucket now empty
+        assert [e.request.tenant for e in rest] == ["funded"] * 3
+        assert ctl.queued("broke") == 1
+
+    def test_group_room_is_scoped(self):
+        """Release honours per-GROUP room: a room with only vision
+        capacity releases the vision-tagged head and leaves the LM
+        head queued, and vice versa (a full group never blocks the
+        other group's tenants)."""
+        clk = {"t": 0.0}
+        ctl = _ctl([TenantSpec("lmt", bucket_capacity=64,
+                               refill_rate=64),
+                    TenantSpec("vist", bucket_capacity=64,
+                               refill_rate=64)], clk)
+        ctl.offer(_treq(0, "lmt"))
+        ctl.offer(_treq(1, "vist", model_tag="vision"))
+        out = ctl.release({"vision": 4})
+        assert [e.request.model_tag for e in out] == ["vision"]
+        out = ctl.release({"default": 4})
+        assert [e.request.model_tag for e in out] == [None]
+
+    def test_two_controllers_replay_identically(self):
+        """Same offer/clock/release script on two fresh controllers →
+        identical release id sequences and stats (the byte-identity
+        the drills pin, at unit granularity)."""
+        def script(ctl, clk):
+            order = []
+            for i in range(6):
+                ctl.offer(_treq(i, "a" if i % 3 else "b"))
+            for t in (0.5, 1.0, 2.5):
+                clk["t"] = t
+                order += [e.request.id
+                          for e in ctl.release({"default": 1})]
+            return order, {n: ctl.stats(n) for n in ctl.tenants}
+
+        specs = [TenantSpec("a", bucket_capacity=2.0, refill_rate=1.0),
+                 TenantSpec("b", bucket_capacity=2.0, refill_rate=1.0)]
+        clk1, clk2 = {"t": 0.0}, {"t": 0.0}
+        r1 = script(_ctl(specs, clk1), clk1)
+        r2 = script(_ctl(specs, clk2), clk2)
+        assert r1 == r2
+
+    def test_unknown_tenant_rejected(self):
+        clk = {"t": 0.0}
+        ctl = _ctl([TenantSpec("a")], clk)
+        with pytest.raises(ValueError):
+            ctl.offer(_treq(0, "ghost"))
+        with pytest.raises(ValueError):
+            ctl.offer(_treq(1, None))       # no 'default' spec either
+
+
+# -------------------------------------------------------------- quotas
+
+class TestKVQuota:
+    def test_quota_bounds_concurrent_blocks_per_tenant(self):
+        """Tenant 'a' is capped at one exclusive KV block: its second
+        request waits for the first to finish while tenant 'b' admits
+        immediately — and everyone still completes."""
+        eng = _engine(slots=3, tenant_kv_quotas={"a": 1})
+        reqs = [Request(id=0, prompt=[1, 2, 3], max_new_tokens=6,
+                        tenant="a", seed=1),
+                Request(id=1, prompt=[4, 5, 6], max_new_tokens=6,
+                        tenant="a", seed=2),
+                Request(id=2, prompt=[7, 8, 9], max_new_tokens=6,
+                        tenant="b", seed=3)]
+        for r in reqs:
+            eng.submit(r)
+        eng.step()
+        active = {r.id for r in eng._req if r is not None}
+        assert 0 in active and 2 in active      # b admits beside a
+        assert 1 not in active                  # a's second: quota
+        throttles = obs.get_event_log().events("tenant_throttled")
+        assert [e["action"] for e in throttles] == ["kv_quota"]
+        assert throttles[0]["tenant"] == "a"
+        assert throttles[0]["request"] == 1
+        out = {r.id: r for r in eng.run()}
+        assert all(r.status == "done" for r in out.values())
+        # one throttle event per request id, not per blocked round
+        throttles = obs.get_event_log().events("tenant_throttled")
+        assert len(throttles) == 1
+
+    def test_quota_validates_constructor(self):
+        with pytest.raises(ValueError):
+            _engine(tenant_kv_quotas={"a": 0})
+
+
+# ------------------------------------------------- groups and failover
+
+class TestEngineGroups:
+    def test_dispatch_routes_by_model_tag(self):
+        lm = _engine()                      # group "default"
+        vis = VisionEngine(lambda f: f @ jax.numpy.ones((4, 3)),
+                           batch=2, feature_len=4)
+        router = EngineRouter([lm, vis])
+        assert sorted(router.groups) == ["default", "vision"]
+        a = router.submit(Request(prompt=[1, 2, 3], max_new_tokens=2,
+                                  seed=1))
+        b = router.submit(Request(prompt=[1, 2], model_tag="vision"))
+        out = {r.id: r for r in router.run()}
+        assert out[a].status == "done" and len(out[a].tokens) == 2
+        assert out[b].status == "done"
+        assert out[b].finish_reason == "classified"
+        assert lm.stats["requests_done"] == 1
+        assert vis.stats["requests_done"] == 1
+        assert vis.stats["classified"] == 1
+
+    def test_no_engine_for_group_raises(self):
+        router = EngineRouter([_engine()])
+        with pytest.raises(Exception) as ei:
+            router.submit(Request(prompt=[1, 2], model_tag="vision"))
+        assert "vision" in str(ei.value)
+
+    def test_cross_group_failover_refused(self):
+        """The only engine in the request's group dies mid-decode; a
+        HEALTHY engine in another group must NOT pick the request up
+        (PR-16 layout_family discipline, group-scoped): the request
+        fails rather than crossing groups."""
+        e0 = _engine(step_timeout_s=0.05)              # "default"
+        e1 = _engine(model_tag="other")                # healthy
+        router = EngineRouter([e0, e1])
+        faults.set_plan(faults.FaultPlan("serve_slow@1"))
+        try:
+            out = router.run([Request(prompt=[1, 2, 3],
+                                      max_new_tokens=4, seed=1)])
+        finally:
+            faults.set_plan(None)
+        assert e0.degraded is not None
+        assert e1.degraded is None                     # untouched
+        assert [r.status for r in out] == ["failed"]
+        assert router.stats["failover_lost"] == 1
+        assert e1.stats["requests_done"] == 0
+
+    def test_add_engine_resolves_group_factory(self):
+        def lm_factory():
+            return _engine()
+
+        router = EngineRouter([_engine()],
+                              engine_factory={"default": lm_factory})
+        e = router.add_engine(group="default")
+        # the untagged newcomer is tagged with its group at admission
+        assert len(router.engines) == 2
+        assert EngineRouter._group_of(e) == "default"
+        with pytest.raises(ValueError) as ei:
+            router.add_engine(group="vision")
+        assert "default" in str(ei.value)   # names known groups
+
+    def test_move_engine_requires_same_model(self):
+        e0, e1 = _engine(), _engine(model_tag="replica")
+        fresh = build_lm(vocab_size=50, dim=16, num_heads=2,
+                         num_layers=1, max_len=32)
+        fresh.build(jax.random.PRNGKey(9))
+        alien = InferenceEngine(fresh, slots=2, prefill_buckets=(8,),
+                                model_tag="alien")
+        router = EngineRouter([e0, e1, alien])
+        with pytest.raises(ValueError):
+            router.move_engine(e0, "alien")   # different model object
+        router.move_engine(e0, "replica")     # same model: allowed
+        assert e0.model_tag == "replica"
+        ev = obs.get_event_log().events("group_rebalance")
+        assert len(ev) == 1 and ev[0]["action"] == "move"
+
+
+# ----------------------------------------------------- compile contract
+
+class TestCompileContractWithTenancy:
+    def test_group_switch_compiles_nothing(self):
+        """Tenancy armed over two groups sharing one model: wave 1
+        pays #buckets prefills + 1 decode IN TOTAL; a second wave
+        through the OTHER group — and a move_engine group switch —
+        compile zero new executables."""
+        fresh = build_lm(vocab_size=50, dim=16, num_heads=2,
+                         num_layers=1, max_len=32)
+        fresh.build(jax.random.PRNGKey(1))
+
+        def eng(**kw):
+            return InferenceEngine(fresh, slots=2,
+                                   prefill_buckets=(8, 16), **kw)
+
+        clk = {"t": 0.0}
+        tick = lambda: clk["t"]  # noqa: E731
+        ctl = TenancyController(
+            [TenantSpec("a", bucket_capacity=64, refill_rate=64),
+             TenantSpec("b", bucket_capacity=64, refill_rate=64)],
+            clock=tick)
+        e0, e1 = eng(clock=tick), eng(model_tag="replica", clock=tick)
+        router = EngineRouter([e0, e1], clock=tick, tenancy=ctl)
+
+        from bigdl_tpu.serving.engine import _TRACES
+        traces0 = dict(_TRACES)
+
+        def wave(tag, base):
+            # prompt lengths straddle both buckets (8 and 16)
+            ids = [router.submit(Request(
+                prompt=[(base + i + j) % 40 + 1
+                        for j in range(3 if i % 2 else 10)],
+                max_new_tokens=3, seed=base + i, model_tag=tag,
+                tenant="a" if i % 2 else "b")) for i in range(4)]
+            rounds = 0
+            while not all(i in router.completed for i in ids):
+                rounds += 1
+                assert rounds < 200
+                clk["t"] += 0.5
+                router.step()
+            return [router.completed[i] for i in ids]
+
+        out = wave(None, 1)                     # group "default"
+        assert all(r.status == "done" for r in out)
+        assert _TRACES["prefill"] - traces0["prefill"] == 2
+        assert _TRACES["decode"] - traces0["decode"] == 1
+        traces1 = dict(_TRACES)
+        out = wave("replica", 20)               # group switch: wave 2
+        assert all(r.status == "done" for r in out)
+        router.move_engine(e0, "replica")       # and a group move
+        out = wave("replica", 40)
+        assert all(r.status == "done" for r in out)
+        assert dict(_TRACES) == traces1         # zero new executables
+
+
+# -------------------------------------------------------- vision engine
+
+class TestVisionEngine:
+    def _predict(self, feature_len=4, classes=3):
+        w = jax.random.normal(jax.random.PRNGKey(2),
+                              (feature_len, classes))
+
+        def predict_fn(feats, _w=w):
+            return feats @ _w
+        return predict_fn
+
+    def test_classifies_deterministically(self):
+        fn = self._predict()
+        eng = VisionEngine(fn, batch=2, feature_len=4)
+        reqs = [Request(prompt=[i + 1, i + 2], id=i) for i in range(3)]
+        out = {r.id: r for r in eng.run(reqs)}
+        assert all(r.status == "done" for r in out.values())
+        assert all(len(r.tokens) == 1 for r in out.values())
+        eng2 = VisionEngine(fn, batch=2, feature_len=4)
+        out2 = {r.id: r for r in eng2.run(
+            [Request(prompt=[i + 1, i + 2], id=i) for i in range(3)])}
+        assert [out[i].tokens for i in range(3)] \
+            == [out2[i].tokens for i in range(3)]
+        # same predict_fn + shape → the jitted forward is SHARED
+        assert eng2.stats["forward_traces"] == 0
+
+    def test_rejects_oversize_and_empty_prompts(self):
+        eng = VisionEngine(self._predict(), batch=2, feature_len=4)
+        with pytest.raises(ValueError):
+            eng.submit(Request(prompt=[]))
+        with pytest.raises(ValueError):
+            eng.submit(Request(prompt=[1, 2, 3, 4, 5]))
+
+
+# ------------------------------------------------------ router tenancy
+
+class TestRouterTenancy:
+    def test_clock_identity_enforced(self):
+        clk = {"t": 0.0}
+        ctl = TenancyController([TenantSpec("a")],
+                                clock=lambda: clk["t"])
+        with pytest.raises(ValueError):
+            EngineRouter([_engine()], clock=lambda: clk["t"],
+                         tenancy=ctl)
+
+    def test_shed_rides_step_and_bills_its_tenant(self):
+        """A max_pending shed settles through step() with status
+        'shed' (the loadgen accounting contract) and bumps only its
+        own tenant's counters."""
+        clk = {"t": 0.0}
+        tick = lambda: clk["t"]  # noqa: E731
+        ctl = TenancyController(
+            [TenantSpec("t", bucket_capacity=1.0, refill_rate=0.25,
+                        max_pending=2)], clock=tick)
+        router = EngineRouter([_engine(clock=tick)], clock=tick,
+                              tenancy=ctl)
+        a = router.submit(Request(prompt=[1, 2], max_new_tokens=2,
+                                  tenant="t", seed=1))
+        b = router.submit(Request(prompt=[3, 4], max_new_tokens=2,
+                                  tenant="t", seed=2))   # queues (2)
+        c = router.submit(Request(prompt=[5, 6], max_new_tokens=2,
+                                  tenant="t", seed=3))   # shed
+        out = {}
+        rounds = 0
+        while len(out) < 3:
+            rounds += 1
+            assert rounds < 100
+            clk["t"] += 0.5
+            for r in router.step():
+                out[r.id] = r
+        assert out[a].status == "done"
+        assert out[b].status == "done"       # refill eventually pays
+        assert out[c].status == "shed"
+        assert out[c].finish_reason == "throttled"
+        assert ctl.stats("t")["shed"] == 1
+        assert router.health()["tenants"]["t"]["shed"] == 1
