@@ -17,7 +17,14 @@ moment a wall-clock read or global RNG draw sneaks in. ISSUE 14 adds
 sampler's window, the injected clock) by contract — the slo_alert
 drill pins firing AND resolution byte-for-byte, bundle bytes
 included, which a `time.time()` in a state transition would break the
-same way it breaks the loadgen report. The ISSUE-9
+same way it breaks the loadgen report. ISSUE 20's scenario plane
+rides the same serving/ prefix — `serving/scenarios.py` (every
+arrival/spec draw comes from ONE np.random.RandomState(spec seed);
+compile twice, get the same trace) and `serving/sim.py` (simulated
+time IS the injected clock: a SimulatedEngine constructed without
+`clock=` refuses to start, and a wall-clock read in the cost model
+would put real milliseconds into a virtual-seconds timeline) — the
+10⁵-request byte-identity acceptance depends on both. The ISSUE-9
 elastic-training legs (preempt_resume / ckpt_async_torn / torn_shard
 / worldsize_resume) are covered by the scripts/fault_drill.py entry:
 their kill/torn-save steps must come from a FaultPlan schedule

@@ -39,7 +39,7 @@ import os
 from typing import Optional
 
 from bigdl_tpu.obs.events import (EventLog, get_event_log, read_jsonl,
-                                  set_event_log)
+                                  set_event_log, stream_jsonl)
 from bigdl_tpu.obs.exposition import ScrapeServer
 from bigdl_tpu.obs.flightrecorder import FlightRecorder, default_trigger
 from bigdl_tpu.obs.journey import (build_journeys, journeys_json,
@@ -56,6 +56,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "DEFAULT_LATENCY_BUCKETS", "get_registry", "set_registry",
     "EventLog", "get_event_log", "set_event_log", "read_jsonl",
+    "stream_jsonl",
     "SpanTracer", "get_tracer", "set_tracer",
     "FlightRecorder", "default_trigger",
     "build_journeys", "journeys_json", "summarize_journeys",

@@ -272,6 +272,32 @@ EVENT_KINDS: Dict[str, dict] = {
                "same-model engine compile-free; 'rebalance' = the "
                "Autoscaler drained an idle group's engine and grew "
                "the breaching group via its factory"},
+    # ---- scenario plane (ISSUE 20) -------------------------------------
+    "scenario_phase": {
+        "required": ("plane", "scenario", "phase", "t"),
+        "optional": ("arrivals", "note"),
+        "doc": "a compiled scenario crossed a phase boundary during "
+               "replay (ISSUE 20): `t` is the virtual-clock time, "
+               "`arrivals` the number of requests the phase "
+               "contributed — obs_report's scenario timeline reads "
+               "the sequence"},
+    "chaos_inject": {
+        "required": ("plane", "scenario", "action", "target", "t"),
+        "optional": ("note",),
+        "doc": "a chaos-schedule entry fired during scenario replay "
+               "(ISSUE 20): action watchdog_trip/drain/tenant_flood "
+               "applied to `target` (engine name or tenant) at "
+               "virtual time `t` — the marker that lets a post-mortem "
+               "separate injected faults from organic ones"},
+    "sim_calibration": {
+        "required": ("plane", "sources", "decode_ms_per_token",
+                     "prefill_ms_per_token"),
+        "optional": ("engine", "factors"),
+        "doc": "a SimulatedEngine cost model announced its provenance "
+               "(ISSUE 20): `sources` names the committed "
+               "BENCH_r0*.json rows the ms/token figures derive from "
+               "and `factors` the documented transformation constants "
+               "— the honesty trail behind every simulated latency"},
     # ---- observability plane -------------------------------------------
     "metrics_snapshot": {
         "required": ("snapshot",),
@@ -440,23 +466,30 @@ def _jsonable(o):
     return repr(o)
 
 
-def read_jsonl(path: str) -> List[dict]:
-    """Parse a JSONL event file; a torn final line (crash mid-write)
-    is dropped, not an error. Record conformance is judged against
-    the EVENT_KINDS registry above — run each record through
-    `validate_record` (obs_report does) rather than keeping a local
-    kind list."""
-    out = []
+def stream_jsonl(path: str):
+    """Yield events from a JSONL file one record at a time — the
+    streaming twin of `read_jsonl` (ISSUE 20): a 10⁶-event simulator
+    run must never be materialized as one list just to be summarized.
+    Same torn-tail tolerance: an undecodable line (crash mid-write) is
+    skipped, not an error."""
     with open(path) as f:
         for line in f:
             line = line.strip()
             if not line:
                 continue
             try:
-                out.append(json.loads(line))
+                yield json.loads(line)
             except json.JSONDecodeError:
                 continue  # torn tail
-    return out
+
+
+def read_jsonl(path: str) -> List[dict]:
+    """Parse a JSONL event file; a torn final line (crash mid-write)
+    is dropped, not an error. Record conformance is judged against
+    the EVENT_KINDS registry above — run each record through
+    `validate_record` (obs_report does) rather than keeping a local
+    kind list. Large files should prefer `stream_jsonl`."""
+    return list(stream_jsonl(path))
 
 
 # BIGDL_OBS_EVENTS=<path> attaches a JSONL file sink to the default

@@ -127,6 +127,18 @@ class MetricsSampler:
         self.interval_s = float(interval_s)
         self._clock = clock or time.monotonic
         self._samples: deque = deque(maxlen=capacity)
+        # whole-run baseline (ISSUE 20): the FIRST sample ever taken,
+        # held outside the ring so eviction can't touch it. Found by
+        # the fleet simulator: a 10^5-request scenario ticks the
+        # sampler far past any reasonable capacity, and every
+        # `window_s=None` query ("whole run" by contract) silently
+        # became "the last `capacity` samples" once the ring rolled —
+        # loadgen's end-of-run SLO compliance read only the tail of
+        # the run it claimed to summarize. `span(window_s=None)` now
+        # anchors at this baseline, so whole-run deltas/quantiles
+        # count from the actual start at any scale; bounded windows
+        # keep the ring's memory bound.
+        self._first: Optional[dict] = None
         self._lock = threading.Lock()
 
     @property
@@ -149,6 +161,8 @@ class MetricsSampler:
                "metrics": self.registry.snapshot()["metrics"]}
         with self._lock:
             self._samples.append(rec)
+            if self._first is None:
+                self._first = rec
         return rec
 
     def tick(self) -> Optional[dict]:
@@ -187,8 +201,17 @@ class MetricsSampler:
              ) -> Optional[Tuple[dict, dict]]:
         """(oldest-in-window, newest) sample pair — the two endpoints
         every window query diffs; None with fewer than two samples in
-        the window."""
+        the window. `window_s=None` means WHOLE RUN: the old endpoint
+        is the never-evicted first-sample baseline, so the answer
+        stays correct after the ring rolls (the sim-found truncation
+        bug — see the `_first` note in __init__)."""
         xs = self.samples(window_s)
+        if window_s is None:
+            with self._lock:
+                first = self._first
+            if first is not None and xs \
+                    and first["t"] < xs[0]["t"] - 1e-9:
+                xs = [first] + xs       # ring rolled past the start
         if len(xs) < 2:
             return None
         return xs[0], xs[-1]

@@ -2,7 +2,10 @@
 batching (the TPU-native analog of BigDL 2.0's Cluster Serving; see
 engine.py for the design contract), plus the fleet plane above it:
 EngineRouter (health-gated dispatch + failover, router.py) and the
-SLO-driven Autoscaler (autoscaler.py)."""
+SLO-driven Autoscaler (autoscaler.py). ISSUE 20 adds the scenario
+plane: a declarative workload/chaos compiler (scenarios.py) and a
+bench-calibrated fleet simulator (sim.py) that drive the SAME control
+plane at 10^5+ requests on a virtual clock."""
 
 from bigdl_tpu.serving.autoscaler import Autoscaler
 from bigdl_tpu.serving.bucketing import (bucket_for, bucket_histogram,
@@ -19,6 +22,10 @@ from bigdl_tpu.serving.prefix_cache import RadixPrefixCache
 from bigdl_tpu.serving.router import (EngineRouter, NoHealthyEngine,
                                       ROUTER_LATENCY_BUCKETS)
 from bigdl_tpu.serving.sampler import filter_logits, sample_logits
+from bigdl_tpu.serving.scenarios import (BUILTIN_SCENARIOS,
+                                         compile_scenario,
+                                         list_scenarios, load_scenario)
+from bigdl_tpu.serving.sim import CostModel, SimulatedEngine
 from bigdl_tpu.serving.speculative import SpeculativeEngine
 from bigdl_tpu.serving.tenancy import (TenancyController, TenantSpec,
                                        TokenBucket)
@@ -36,6 +43,8 @@ __all__ = [
     "TenancyController", "TenantSpec", "TokenBucket", "VisionEngine",
     "TPServingLM", "tp_serving_model", "tp_serving_specs",
     "gather_serving_params", "shard_serving_params",
+    "CostModel", "SimulatedEngine", "BUILTIN_SCENARIOS",
+    "compile_scenario", "load_scenario", "list_scenarios",
     "Autoscaler", "BlockPool", "RadixPrefixCache",
     "sample_logits", "filter_logits",
     "bucket_for", "bucket_histogram", "default_buckets", "pad_tokens",

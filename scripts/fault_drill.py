@@ -2092,6 +2092,122 @@ def drill_tenant_noisy(workdir):
             "events": json.loads(d1)["events"]}
 
 
+def drill_scenario_chaos(workdir):
+    """ISSUE 20: a compiled chaos scenario through the fleet
+    SIMULATOR, twice. The builtin `chaos_smoke` scenario (two tenants,
+    a 96-request steady phase, a watchdog trip on engine sim1 at
+    t=6s and a 48-request tenant_flood on tenant1 at t=10s) compiles
+    to one seeded trace; a two-SimulatedEngine pool (shared
+    calibrated CostModel — same group identity) behind a
+    tenancy-armed EngineRouter replays it on a virtual clock with a
+    FlightRecorder installed. Pins:
+
+    * the chaos timeline FIRES: both entries inject (`chaos_inject`
+      events), the watchdog trip degrades exactly sim1 with reason
+      'chaos_watchdog', sim1's in-flight work fails over to sim0, and
+      the flood's arrivals land as tenant1 traffic;
+    * the trip is an INCIDENT: exactly one flight-recorder bundle,
+      manifest naming engine_degraded on sim1;
+    * containment holds under chaos: every throttle (defer + the
+      flood's sheds) bills to tenant1 — tenant0 finishes every
+      request with zero throttles;
+    * zero lost: every compiled arrival reaches a terminal status;
+    * two replays are BYTE-IDENTICAL — the full report JSON (digest)
+      AND every flight-recorder bundle file, byte for byte. The
+      simulator's virtual clock + the scenario's single seeded stream
+      make the whole ops plane a pure function of the spec."""
+    from bigdl_tpu.obs.flightrecorder import FlightRecorder
+    from bigdl_tpu.serving import (EngineRouter, TenancyController,
+                                   TenantSpec)
+    from bigdl_tpu.serving.scenarios import compile_scenario
+    from bigdl_tpu.serving.sim import CostModel, SimulatedEngine
+
+    lg = _loadgen()
+    cost = CostModel.from_bench_artifacts()
+
+    def run(outdir):
+        trace = compile_scenario("chaos_smoke")
+        clk = {"t": 0.0}
+
+        def c():
+            return clk["t"]
+
+        with _telemetry(clock=c) as log:
+            fc = trace["fleet"]
+            # explicit obs labels: the scenario's chaos targets name
+            # engines ("sim1") — the ctor's process-global fallback
+            # counter would drift on the second run
+            pool = [SimulatedEngine(cost, clock=c, slots=fc["slots"],
+                                    max_queue=fc["max_queue"],
+                                    overload_policy=fc[
+                                        "overload_policy"],
+                                    pacing=fc["pacing"],
+                                    obs_label=f"sim{i}")
+                    for i in range(fc["engines"])]
+            tenancy = TenancyController(
+                [TenantSpec(**kw) for kw in trace["tenants"]],
+                clock=c)
+            router = EngineRouter(pool, clock=c, tenancy=tenancy,
+                                  obs_label="r0")
+            rec = FlightRecorder(outdir, clock=c)
+            for eng in pool:
+                rec.register_health_source(eng.obs_name, eng.health)
+            rec.install()
+            report = lg.replay(router, trace, clock=clk)
+            rec.close()
+            events = log.events()
+        return report, events, rec, pool
+
+    r1, ev1, rec1, pool1 = run(os.path.join(workdir, "run1"))
+    r2, ev2, rec2, _ = run(os.path.join(workdir, "run2"))
+    d1 = json.dumps(r1, sort_keys=True)
+    d2 = json.dumps(r2, sort_keys=True)
+
+    chaos_ev = [e for e in ev1 if e["kind"] == "chaos_inject"]
+    degraded_ev = [e for e in ev1 if e["kind"] == "engine_degraded"]
+    throttle_ev = [e for e in ev1 if e["kind"] == "tenant_throttled"]
+    billed = {e["tenant"] for e in throttle_ev}
+    chaos_ok = (sorted(e["action"] for e in chaos_ev)
+                == ["tenant_flood", "watchdog_trip"]
+                and r1["scenario"]["fired"]["chaos"] == 2)
+    trip_ok = (len(degraded_ev) == 1
+               and degraded_ev[0]["engine"] == "sim1"
+               and degraded_ev[0]["reason"] == "chaos_watchdog"
+               and pool1[1].degraded == "chaos_watchdog"
+               and pool1[0].degraded is None)
+    t0 = r1["tenants"]["tenant0"]
+    contained = (billed == {"tenant1"}
+                 and t0["throttled"] == {"deferred": 0, "shed": 0}
+                 and t0["done"] == t0["requests"])
+    zero_lost = (sum(r1["by_status"].values()) + r1["rejected"]
+                 == r1["requests"])
+
+    b1 = _bundle_bytes(os.path.join(workdir, "run1"))
+    b2 = _bundle_bytes(os.path.join(workdir, "run2"))
+    identical_bundles = bool(b1) and b1 == b2
+    manifest = json.loads(b1[os.path.join(
+        rec1.bundles[0], "manifest.json")]) if rec1.bundles else {}
+    bundle_ok = (len(rec1.bundles) == 1
+                 and manifest.get("incident") == "engine_degraded"
+                 and manifest.get("component") == "sim1")
+
+    counts = {}
+    for e in ev1:
+        counts[e["kind"]] = counts.get(e["kind"], 0) + 1
+    ok = (chaos_ok and trip_ok and contained and zero_lost
+          and bundle_ok and identical_bundles and d1 == d2)
+    return {"ok": bool(ok),
+            "chaos_fired": r1["scenario"]["fired"],
+            "by_status": r1["by_status"],
+            "watchdog_trip_ok": trip_ok,
+            "throttle_billed_to": sorted(billed),
+            "tenant0_untouched": contained,
+            "bundles": rec1.bundles,
+            "bundles_byte_identical": identical_bundles,
+            "report_byte_identical": d1 == d2,
+            "events": dict(sorted(counts.items()))}
+
+
 TRAINING_LEGS = {
     "nan_skip": drill_nan_skip,
     "nan_skip_mesh": lambda wd: drill_nan_skip(wd, mesh=True),
@@ -2125,6 +2241,7 @@ SERVING_LEGS = {
     "fleet_journey": drill_fleet_journey,
     "slo_alert": drill_slo_alert,
     "tenant_noisy": drill_tenant_noisy,
+    "scenario_chaos": drill_scenario_chaos,
 }
 
 LEGS = {**TRAINING_LEGS, **SERVING_LEGS}

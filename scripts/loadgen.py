@@ -220,12 +220,68 @@ def replay(router, trace: dict, *, clock: Dict[str, float],
     ingestion point (distiller corpus + swap cadence), between router
     steps so a hot-swap lands while the engines are quiescent.
     Returns the load report (see _report); deterministic for a fixed
-    (router config, trace, step_dt)."""
+    (router config, trace, step_dt).
+
+    Scenario traces (ISSUE 20) may carry "phases" and "chaos"
+    timelines (serving/scenarios.py): as the virtual clock crosses
+    each entry, replay emits a `scenario_phase` / `chaos_inject` event
+    and applies the chaos action — `watchdog_trip` calls the target
+    engine's `degrade()` hook (SimulatedEngine; a REAL engine's trip
+    is injected inside its step and belongs to fault_drill, so naming
+    one here is a spec error), `drain` works on both, `tenant_flood`
+    was compiled into the arrivals and fires as a marker only. The
+    idle-gap jump never skips a pending timeline entry."""
+    from bigdl_tpu import obs
     from bigdl_tpu.serving import NoHealthyEngine, OverloadError
 
     from bigdl_tpu.serving import Request
 
     sess = trace["sessions"]
+    scen_name = trace.get("name")
+    timeline = [("phase", p["t"], p) for p in trace.get("phases", [])]
+    timeline += [("chaos", c["t"], c) for c in trace.get("chaos", [])]
+    timeline.sort(key=lambda e: (e[1], 0 if e[0] == "phase" else 1))
+    tl_idx = [0]
+    tl_fired = {"phase": 0, "chaos": 0}
+
+    def _apply_chaos(entry):
+        action = entry["action"]
+        if action == "tenant_flood":
+            return                        # arrivals were compiled in
+        target = entry.get("target")
+        eng = next((e for e in router.engines
+                    if e.obs_name == target), None)
+        if eng is None:
+            raise ValueError(
+                f"chaos target {target!r} names no pool engine "
+                f"(have {[e.obs_name for e in router.engines]})")
+        if action == "drain":
+            eng.drain()
+        elif action == "watchdog_trip":
+            if not hasattr(eng, "degrade"):
+                raise ValueError(
+                    f"chaos watchdog_trip targets {target!r}, which "
+                    "has no degrade() hook — real-engine trips are "
+                    "fault_drill territory (serve_watchdog leg)")
+            eng.degrade("chaos_watchdog")
+
+    def fire_timeline():
+        while tl_idx[0] < len(timeline) \
+                and timeline[tl_idx[0]][1] <= clock["t"] + 1e-9:
+            kind, t, e = timeline[tl_idx[0]]
+            tl_idx[0] += 1
+            tl_fired[kind] += 1
+            if kind == "phase":
+                obs.emit_event("scenario_phase", plane="serving",
+                               scenario=scen_name, phase=e["name"],
+                               t=t, arrivals=e.get("arrivals"))
+            else:
+                obs.emit_event("chaos_inject", plane="serving",
+                               scenario=scen_name, action=e["action"],
+                               target=e.get("target"), t=t,
+                               note=e.get("note"))
+                _apply_chaos(e)
+
     heap = [(a.t, i, a) for i, a in enumerate(trace["arrivals"])]
     heapq.heapify(heap)
     seqc = itertools.count(len(heap))
@@ -254,6 +310,7 @@ def replay(router, trace: dict, *, clock: Dict[str, float],
             raise RuntimeError(
                 f"replay did not converge in {max_rounds} rounds "
                 f"({len(results)}/{expected} settled)")
+        fire_timeline()
         submit_due()
         # the pool is only IDLE when no work is parked behind a tenant
         # gate either — jumping while tenancy holds requests would skip
@@ -262,7 +319,10 @@ def replay(router, trace: dict, *, clock: Dict[str, float],
             else 0
         if heap and heap[0][0] > clock["t"] and not parked \
                 and all(e.idle for e in router.engines):
-            clock["t"] = heap[0][0]              # jump the idle gap
+            jump = heap[0][0]                    # jump the idle gap —
+            if tl_idx[0] < len(timeline):        # never past a pending
+                jump = min(jump, timeline[tl_idx[0]][1])  # timeline hit
+            clock["t"] = jump
             continue
         # the round costs step_dt BEFORE its results land: a request
         # admitted this round sees TTFT >= step_dt, like a real step
@@ -288,8 +348,22 @@ def replay(router, trace: dict, *, clock: Dict[str, float],
                 heapq.heappush(heap, (nxt.t, next(seqc), nxt))
     tenants_of = {rid: (a.spec.get("tenant") or "default")
                   for rid, a in owner.items()}
-    return _report(results, clock["t"], router, rejected, autoscaler,
-                   step_dt, tenants_of=tenants_of)
+    report = _report(results, clock["t"], router, rejected, autoscaler,
+                     step_dt, tenants_of=tenants_of)
+    if scen_name is not None:
+        # scenario provenance (ISSUE 20): the compiled timelines plus
+        # how much of each actually fired before the traffic drained —
+        # pure functions of the trace, so the section rides the
+        # byte-identical acceptance
+        report["scenario"] = {
+            "name": scen_name,
+            "seed": trace.get("seed"),
+            "phases": trace.get("phases", []),
+            "chaos": [{k: c[k] for k in ("t", "action", "target")
+                       if k in c} for c in trace.get("chaos", [])],
+            "fired": dict(tl_fired),
+        }
+    return report
 
 
 def _report(results, makespan, router, rejected, autoscaler,
@@ -552,6 +626,62 @@ def build_fleet(engines: int = 1, *, slots: int = 4,
     return router, asc, clk
 
 
+def build_sim_fleet(engines: int = 1, *, slots: int = 4,
+                    prefill_buckets=(8, 16, 32),
+                    max_queue: Optional[int] = None,
+                    overload_policy: str = "reject",
+                    clock: Optional[Dict[str, float]] = None,
+                    pacing: str = "throughput",
+                    autoscale: bool = False,
+                    target_p99_s: float = 8.0,
+                    max_engines: int = 4,
+                    evaluate_every_s: float = 1.0,
+                    tenant_specs=None):
+    """Simulated fleet (ISSUE 20): the same router/autoscaler/tenancy
+    control plane as build_fleet, but every engine is a
+    SimulatedEngine over ONE shared CostModel calibrated from the
+    committed BENCH_r0*.json rows — no jax, no compiles, so a
+    10^5-request scenario replays in wall-clock seconds. The shared
+    CostModel object doubles as the router's group identity (engines
+    in a group must share a model object). Returns
+    (router, autoscaler-or-None, clk), same shape as build_fleet so
+    the replay/report path is identical."""
+    from bigdl_tpu.serving import Autoscaler, EngineRouter
+    from bigdl_tpu.serving.sim import CostModel, SimulatedEngine
+
+    clk = clock if clock is not None else {"t": 0.0}
+    router_clock = lambda: clk["t"]  # noqa: E731
+    cost = CostModel.from_bench_artifacts()
+    # per-fleet engine names (sim0..simN-1, autoscaler growth
+    # continues the sequence): scenario chaos entries target engines
+    # BY NAME, and the ctor's fallback counter is process-global — a
+    # second fleet in one process would drift to sim2/sim3 and break
+    # every compiled "target": "sim1"
+    ids = itertools.count()
+
+    def factory():
+        return SimulatedEngine(cost, clock=router_clock, slots=slots,
+                               prefill_buckets=prefill_buckets,
+                               max_queue=max_queue,
+                               overload_policy=overload_policy,
+                               pacing=pacing,
+                               obs_label=f"sim{next(ids)}")
+
+    pool = [factory() for _ in range(engines)]
+    tenancy = None
+    if tenant_specs is not None:
+        from bigdl_tpu.serving import TenancyController
+
+        tenancy = TenancyController(tenant_specs, clock=router_clock)
+    router = EngineRouter(pool, engine_factory=factory,
+                          clock=router_clock, tenancy=tenancy)
+    asc = Autoscaler(router, target_p99_s=target_p99_s,
+                     max_engines=max_engines,
+                     evaluate_every_s=evaluate_every_s) \
+        if autoscale else None
+    return router, asc, clk
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--requests", type=int, default=32)
@@ -694,11 +824,62 @@ def main(argv=None) -> int:
                          "'done' (e.g. 0.95 -> bad-terminal budget "
                          "0.05); threshold alert + report section as "
                          "above")
+    ap.add_argument("--scenario", default=None,
+                    help="drive a compiled scenario instead of "
+                         "make_trace (ISSUE 20): a built-in name "
+                         "(serving/scenarios.py — diurnal_noisy, "
+                         "flash_crowd, agentic_sessions, "
+                         "regional_failover, chaos_smoke) or a JSON "
+                         "spec path; the scenario's tenants/fleet/"
+                         "chaos sections override the corresponding "
+                         "flags and the report gains a 'scenario' "
+                         "section (phases, chaos timeline, fired "
+                         "counts)")
+    ap.add_argument("--scenario-scale", type=float, default=1.0,
+                    help="multiply every scenario shape's request "
+                         "count (0.01 shrinks the 1e5-request day to "
+                         "a smoke test)")
+    ap.add_argument("--sim", action="store_true",
+                    help="serve the trace with SimulatedEngines "
+                         "(ISSUE 20): the identical router/autoscaler/"
+                         "tenancy/SLO/journey control plane over a "
+                         "cost model calibrated from the committed "
+                         "BENCH_r0*.json rows — no jax, no compiles, "
+                         "10^5-request scenarios replay in wall-clock "
+                         "seconds; the report gains a 'sim' section "
+                         "(pacing + calibration provenance)")
+    ap.add_argument("--sim-pacing", default=None,
+                    choices=("per_step", "throughput"),
+                    help="sim scheduling mode: per_step mirrors the "
+                         "real engine's one-token-per-round structure "
+                         "(the divergence-test mode), throughput is "
+                         "the fluid large-scale mode (default; "
+                         "scenario fleet specs may set it)")
     ap.add_argument("--json", default=None,
                     help="also write the report to this path")
     args = ap.parse_args(argv)
     if args.spec_adaptive or args.spec_distill:
         args.spec_draft = True           # flywheel knobs ride the pool
+    if args.sim:
+        for flag, name in ((args.tp, "--tp"),
+                           (args.spec_draft, "--spec-draft/--spec-*"),
+                           (args.host_blocks, "--host-blocks"),
+                           (args.vision_frac, "--vision-frac"),
+                           (args.shared_prefix, "--shared-prefix")):
+            if flag:
+                ap.error(f"{name} exercises real-engine machinery "
+                         "(device KV, drafts, shards) that the cost "
+                         "model replaces — run it without --sim")
+
+    # scenario mode (ISSUE 20): compile the declarative spec down to
+    # the same trace format; its tenants/fleet sections override the
+    # corresponding CLI knobs below
+    scenario_trace = None
+    if args.scenario:
+        from bigdl_tpu.serving.scenarios import compile_scenario
+
+        scenario_trace = compile_scenario(args.scenario,
+                                          scale=args.scenario_scale)
 
     # size the in-memory event ring to the trace BEFORE any engine
     # emits (ISSUE 11): the journeys rollup below reads the ring, and
@@ -707,28 +888,43 @@ def main(argv=None) -> int:
     # incomplete journeys. ~16 events/request is a safe ceiling
     # (submit/terminal/prefix/handoff/router records); the
     # BIGDL_OBS_EVENTS file sink is unaffected (disk keeps all).
+    # ISSUE 20: the ring is CAPPED at 2^18 records — a 10^5-request
+    # scenario would otherwise pin ~1.6M dicts of host RAM. When the
+    # cap bites, the report says so ("events" section) and the
+    # journeys rollup steps aside instead of mis-reporting journeys
+    # whose early hops rolled off; the file sink keeps everything for
+    # scripts/obs_report.py's streaming parser.
     from bigdl_tpu import obs
 
-    expected_requests = args.requests + args.sessions * args.turns
+    if scenario_trace is not None:
+        sess_cfg = scenario_trace["sessions"]
+        expected_requests = len(scenario_trace["arrivals"]) \
+            + sess_cfg["count"] * max(sess_cfg["turns"] - 1, 0)
+    else:
+        expected_requests = args.requests + args.sessions * args.turns
+    ring_cap = min(max(4096, 16 * expected_requests), 1 << 18)
     obs.set_event_log(obs.EventLog(
-        capacity=max(4096, 16 * expected_requests),
+        capacity=ring_cap,
         path=os.environ.get("BIGDL_OBS_EVENTS") or None))
 
-    trace = make_trace(args.requests, seed=args.seed,
-                       arrival=args.arrival, rate=args.rate,
-                       burst_size=args.burst_size,
-                       burst_gap_s=args.burst_gap,
-                       deadline_frac=args.deadline_frac,
-                       deadline_s=args.deadline,
-                       sessions=args.sessions,
-                       session_turns=args.turns,
-                       shared_prefix_len=args.shared_prefix,
-                       shared_frac=args.shared_frac,
-                       tenants=args.tenants,
-                       noisy_tenant=args.noisy_tenant,
-                       noisy_mult=args.noisy_mult,
-                       vision_frac=args.vision_frac,
-                       feature_len=args.feature_len)
+    if scenario_trace is not None:
+        trace = scenario_trace
+    else:
+        trace = make_trace(args.requests, seed=args.seed,
+                           arrival=args.arrival, rate=args.rate,
+                           burst_size=args.burst_size,
+                           burst_gap_s=args.burst_gap,
+                           deadline_frac=args.deadline_frac,
+                           deadline_s=args.deadline,
+                           sessions=args.sessions,
+                           session_turns=args.turns,
+                           shared_prefix_len=args.shared_prefix,
+                           shared_frac=args.shared_frac,
+                           tenants=args.tenants,
+                           noisy_tenant=args.noisy_tenant,
+                           noisy_mult=args.noisy_mult,
+                           vision_frac=args.vision_frac,
+                           feature_len=args.feature_len)
     # shared-prefix prompts are prefix + tail long: grow the bucket
     # ladder (and keep max_len a block multiple) so the COLD first
     # request of each prefix still fits one prefill bucket
@@ -749,7 +945,13 @@ def main(argv=None) -> int:
     # any) is budgeted tighter — containment comes from ITS bucket,
     # never from penalizing the others
     tenant_specs = None
-    if args.tenants:
+    if scenario_trace is not None and scenario_trace.get("tenants"):
+        # the scenario declares its tenants (TenantSpec kwargs dicts)
+        from bigdl_tpu.serving import TenantSpec
+
+        tenant_specs = [TenantSpec(**kw)
+                        for kw in scenario_trace["tenants"]]
+    elif args.tenants:
         from bigdl_tpu.serving import TenantSpec
 
         tenant_specs = []
@@ -765,22 +967,45 @@ def main(argv=None) -> int:
                              else args.bucket_refill),
                 max_pending=(args.noisy_max_pending if noisy
                              else None)))
-    router, asc, clk = build_fleet(
-        args.engines, slots=args.slots, max_queue=args.max_queue,
-        overload_policy=args.overload_policy,
-        prefill_buckets=buckets, max_len=max_len,
-        block_size=args.block_size,
-        autoscale=args.autoscale,
-        target_p99_s=args.target_p99, max_engines=args.max_engines,
-        tp=args.tp, spec_draft=args.spec_draft, spec_k=args.spec_k,
-        spec_adaptive=args.spec_adaptive,
-        spec_adapt_window=args.spec_adapt_window,
-        spec_probe_every=args.spec_probe_every,
-        host_blocks=args.host_blocks, affinity=affinity,
-        tenant_specs=tenant_specs,
-        vision=args.vision_frac > 0,
-        vision_engines=args.vision_engines,
-        feature_len=args.feature_len)
+    # a scenario's fleet section overrides the sizing flags
+    fleet_cfg = dict(engines=args.engines, slots=args.slots,
+                     max_queue=args.max_queue,
+                     overload_policy=args.overload_policy)
+    sim_pacing = "throughput"
+    if scenario_trace is not None:
+        fc = scenario_trace.get("fleet", {})
+        fleet_cfg.update({k: fc[k] for k in fleet_cfg if k in fc})
+        sim_pacing = fc.get("pacing", sim_pacing)
+    if args.sim_pacing is not None:
+        sim_pacing = args.sim_pacing
+    if args.sim:
+        router, asc, clk = build_sim_fleet(
+            fleet_cfg["engines"], slots=fleet_cfg["slots"],
+            max_queue=fleet_cfg["max_queue"],
+            overload_policy=fleet_cfg["overload_policy"],
+            prefill_buckets=buckets, pacing=sim_pacing,
+            autoscale=args.autoscale, target_p99_s=args.target_p99,
+            max_engines=args.max_engines, tenant_specs=tenant_specs)
+    else:
+        router, asc, clk = build_fleet(
+            fleet_cfg["engines"], slots=fleet_cfg["slots"],
+            max_queue=fleet_cfg["max_queue"],
+            overload_policy=fleet_cfg["overload_policy"],
+            prefill_buckets=buckets, max_len=max_len,
+            block_size=args.block_size,
+            autoscale=args.autoscale,
+            target_p99_s=args.target_p99,
+            max_engines=args.max_engines,
+            tp=args.tp, spec_draft=args.spec_draft,
+            spec_k=args.spec_k,
+            spec_adaptive=args.spec_adaptive,
+            spec_adapt_window=args.spec_adapt_window,
+            spec_probe_every=args.spec_probe_every,
+            host_blocks=args.host_blocks, affinity=affinity,
+            tenant_specs=tenant_specs,
+            vision=args.vision_frac > 0,
+            vision_engines=args.vision_engines,
+            feature_len=args.feature_len)
     # speculation flywheel (ISSUE 18): the distiller ingests every
     # completed stream in completion order (deterministic under the
     # virtual clock) and every --spec-swap-every results trains +
@@ -856,11 +1081,21 @@ def main(argv=None) -> int:
                     short_window_s=5 * args.step_dt,
                     clear_s=5 * args.step_dt))
         if args.slo_goodput is not None:
+            # under --sim the engine-side serving_requests_total family
+            # is silent (SimulatedEngine registers no metric families —
+            # engine.py is that family's one registration site), so the
+            # budget watches the router-side per-tenant counter
+            # instead; it is only fed for tenant-stamped traffic, so a
+            # tenant-less sim run measures None (never violates)
+            gmetric, glabels = ("serving_requests_total", None)
+            if args.sim:
+                gmetric = "serving_tenant_requests_total"
+                glabels = {"router": router._obs_name}
             rules.append(AlertRule(
                 name="goodput_budget",
                 objective=SLOObjective(
                     name="goodput", kind="error_budget",
-                    metric="serving_requests_total",
+                    metric=gmetric, labels=glabels,
                     target=round(1.0 - args.slo_goodput, 9)),
                 kind="threshold", window_s=20 * args.step_dt,
                 for_s=2 * args.step_dt, clear_s=5 * args.step_dt))
@@ -952,12 +1187,43 @@ def main(argv=None) -> int:
     # two-runs-byte-identical acceptance is unaffected by labels
     from bigdl_tpu import obs
 
-    if obs.enabled() and len(obs.get_event_log()):
+    if args.sim:
+        # calibration provenance in the report (ISSUE 20): where every
+        # simulated millisecond came from — deterministic floats, so
+        # the section rides the byte-identical acceptance
+        prov = router.engines[0].model.provenance()
+        report["sim"] = {
+            "pacing": sim_pacing,
+            "decode_ms_per_token": prov["decode_ms_per_token"],
+            "prefill_ms_per_token": prov["prefill_ms_per_token"],
+            "calibration_sources": len(prov["sources"]),
+            "calibration_spread_frac":
+                prov["factors"]["calibration_spread_frac"],
+        }
+    ring_rolled = False
+    if obs.enabled():
+        nring = len(obs.get_event_log())
+        ring_rolled = nring >= ring_cap
+        # honest accounting for capped runs (no silent truncation):
+        # the ring holds the TAIL of the run; the file sink (if set)
+        # holds everything
+        report["events"] = {"ring_capacity": ring_cap,
+                            "ring_events": nring,
+                            "ring_rolled": ring_rolled}
+    if obs.enabled() and len(obs.get_event_log()) and not ring_rolled:
         from bigdl_tpu.obs.journey import (build_journeys,
                                            summarize_journeys)
 
         report["journeys"] = summarize_journeys(
             build_journeys(obs.get_event_log().events()))
+    elif ring_rolled:
+        # early hops rolled off the ring — a journey rollup here would
+        # mis-report rolled journeys as lost hops; obs_report over the
+        # JSONL sink is the honest path at this scale
+        report["journeys"] = {
+            "skipped": "event ring rolled "
+                       f"({ring_cap} capacity) — use "
+                       "BIGDL_OBS_EVENTS + scripts/obs_report.py"}
     text = json.dumps(report, sort_keys=True)
     print(text)
     if args.json:

@@ -37,6 +37,14 @@ un-fenced device dispatch measure dispatch, not compute —
 them with a real device→host fetch (the shipped instrumentation does:
 the loss fetch fences training steps, the token fetch fences decode).
 
+Scale (ISSUE 20): the CLI stream-parses the JSONL (one pass,
+`obs.stream_jsonl`, torn-tail tolerant) so a 10⁶-event simulator run
+summarizes without materializing the file as one list; every rendered
+section table is row-capped with an honest "N more rows not shown"
+footer, and journey reconstruction — the one hold that needs every
+trace-stamped event — is capped with a named skip, never a silent
+subset.
+
 Usage:
     python scripts/obs_report.py /tmp/run.jsonl [--tail 20]
 """
@@ -62,13 +70,135 @@ from bigdl_tpu.obs.events import (EVENT_KINDS,  # noqa: E402
                                   validate_record)
 
 
-def summarize(events: List[dict]) -> Dict[str, object]:
-    """Machine-readable digest of an event list (the report renders
-    this; tests assert on it)."""
-    out: Dict[str, object] = {"total_events": len(events)}
+# -------------------------------------------------- streaming digest
+#
+# ISSUE 20: a 10⁶-event simulator run must not be materialized as one
+# list just to be summarized. `summarize` makes a SINGLE pass over any
+# iterable of events (a list in tests, `obs.stream_jsonl(path)` from
+# the CLI), keeping only what the sections need: trimmed terminal
+# stamps, the low-volume section events, streamed accumulators for the
+# high-volume counters, the LAST metrics snapshot, and a bounded
+# timeline tail. The one unavoidable high-volume hold is journey
+# reconstruction (every trace-stamped event) — that is capped at
+# _JOURNEY_EVENT_CAP with an HONEST skipped note, never a silent
+# truncation.
+
+_JOURNEY_EVENT_CAP = 500_000   # trace-stamped events held for journeys
+_JOURNEY_TABLE_CAP = 200       # per-request rows kept in the digest
+_TAIL_KEEP = 64                # timeline tail held during the pass
+
+# the only request_terminal fields any section reads — a million
+# trimmed stamps is a few hundred MB smaller than a million full
+# events with prompts and provenance attached
+_TERM_FIELDS = ("kind", "status", "tokens", "ts", "ttft_s",
+                "latency_s", "tp", "role", "engine", "tenant")
+
+
+def summarize(events,
+              journey_event_cap: int = _JOURNEY_EVENT_CAP
+              ) -> Dict[str, object]:
+    """Machine-readable digest of an event iterable (the report
+    renders this; tests assert on it). Single pass, bounded memory
+    modulo the per-request stamp lists and the capped journey hold."""
+    from collections import deque
+
+    total = 0
     by_kind: Dict[str, int] = {}
+    nonconformant = 0
+    ts_min = ts_max = None
+    train = {"steps": 0, "first_loss": None, "last_loss": None,
+             "thr_sum": 0.0, "updates": 0}
+    term: List[dict] = []
+    throttles: List[dict] = []
+    alert_ev: List[dict] = []
+    incident_ev: List[dict] = []
+    prefix = {"hits": 0, "tokens_saved": 0, "blocks_reused": 0,
+              "evicts": 0, "blocks_evicted": 0}
+    kv = {"spills": 0, "spilled_blocks": 0, "readmits": 0,
+          "readmitted_blocks": 0}
+    migrate_ev: List[dict] = []
+    spec_rounds: Dict[str, dict] = {}
+    spec_fallbacks: List[dict] = []
+    spec_adjusts: List[dict] = []
+    spec_swaps: List[dict] = []
+    faults: List[str] = []
+    ckpt_ev: List[dict] = []
+    snapshot = None
+    trace_events: List[dict] = []
+    trace_event_count = 0
+    tail = deque(maxlen=_TAIL_KEEP)
+
     for e in events:
-        by_kind[e.get("kind", "?")] = by_kind.get(e.get("kind", "?"), 0) + 1
+        total += 1
+        kind = e.get("kind", "?")
+        by_kind[kind] = by_kind.get(kind, 0) + 1
+        if validate_record(e):
+            nonconformant += 1
+        ts = e.get("ts")
+        if isinstance(ts, (int, float)):
+            ts_min = ts if ts_min is None else min(ts_min, ts)
+            ts_max = ts if ts_max is None else max(ts_max, ts)
+        tail.append(e)
+        if e.get("trace") is not None:
+            trace_event_count += 1
+            if trace_event_count <= journey_event_cap:
+                trace_events.append(e)
+        if kind == "train_step":
+            train["steps"] += 1
+            if "loss" in e:
+                if train["first_loss"] is None:
+                    train["first_loss"] = e["loss"]
+                train["last_loss"] = e["loss"]
+            train["thr_sum"] += e.get("throughput", 0.0)
+            if e.get("update_applied", True):
+                train["updates"] += 1
+        elif kind == "request_terminal":
+            term.append({k: e.get(k) for k in _TERM_FIELDS})
+        elif kind == "tenant_throttled":
+            throttles.append({"kind": kind, "tenant": e.get("tenant"),
+                              "action": e.get("action")})
+        elif kind in ("alert_firing", "alert_resolved"):
+            alert_ev.append(e)
+        elif kind == "incident_dump":
+            incident_ev.append(e)
+        elif kind == "prefix_hit":
+            prefix["hits"] += 1
+            prefix["tokens_saved"] += e.get("matched_tokens", 0)
+            prefix["blocks_reused"] += e.get("blocks", 0)
+        elif kind == "prefix_evict":
+            prefix["evicts"] += 1
+            prefix["blocks_evicted"] += e.get("blocks", 0)
+        elif kind == "kv_spill":
+            kv["spills"] += 1
+            kv["spilled_blocks"] += e.get("blocks", 0)
+        elif kind == "kv_readmit":
+            kv["readmits"] += 1
+            kv["readmitted_blocks"] += e.get("blocks", 0)
+        elif kind == "prefix_migrate":
+            migrate_ev.append(e)
+        elif kind == "spec_verify":
+            eng = spec_rounds.setdefault(e.get("engine", "?"), {
+                "draft": e.get("draft_engine"), "rounds": 0,
+                "proposed": 0, "accepted": 0, "emitted": 0})
+            eng["rounds"] += 1
+            eng["proposed"] += e.get("proposed", 0)
+            eng["accepted"] += e.get("accepted", 0)
+            eng["emitted"] += e.get("emitted", 0)
+        elif kind == "spec_fallback":
+            spec_fallbacks.append(e)
+        elif kind == "spec_k_adjust":
+            spec_adjusts.append(e)
+        elif kind == "draft_swap":
+            spec_swaps.append(e)
+        elif kind == "fault_injected":
+            faults.append(f'{e["fault"]}@{e["step"]}')
+        elif kind in ("checkpoint_save", "checkpoint_load",
+                      "checkpoint_corrupt_skipped"):
+            ckpt_ev.append(e)
+        elif kind == "metrics_snapshot":
+            snapshot = e["snapshot"]
+
+    out: Dict[str, object] = {"total_events": total}
     out["by_kind"] = dict(sorted(by_kind.items()))
     unknown = sorted(k for k in by_kind if k not in EVENT_KINDS)
     if unknown:
@@ -76,27 +206,22 @@ def summarize(events: List[dict]) -> Dict[str, object]:
         # registry does not know (graftlint pins committed code, but a
         # JSONL file may come from anywhere)
         out["unknown_kinds"] = unknown
-    nonconformant = sum(1 for e in events if validate_record(e))
     if nonconformant:
         out["nonconformant_records"] = nonconformant
 
-    steps = [e for e in events if e.get("kind") == "train_step"]
-    if steps:
+    if train["steps"]:
         # loss is omitted on non-fence steps (no summary/log sink
         # needed it, so the loop never fetched it) — report from the
         # steps that carry one
-        losses = [s["loss"] for s in steps if "loss" in s]
         out["training"] = {
-            "steps": len(steps),
-            "first_loss": losses[0] if losses else None,
-            "last_loss": losses[-1] if losses else None,
+            "steps": train["steps"],
+            "first_loss": train["first_loss"],
+            "last_loss": train["last_loss"],
             "mean_throughput": round(
-                sum(s["throughput"] for s in steps) / len(steps), 2),
-            "updates_applied": sum(
-                1 for s in steps if s.get("update_applied", True)),
+                train["thr_sum"] / train["steps"], 2),
+            "updates_applied": train["updates"],
             "anomalies": by_kind.get("anomaly", 0),
         }
-    term = [e for e in events if e.get("kind") == "request_terminal"]
     if term:
         by_status: Dict[str, int] = {}
         for e in term:
@@ -104,42 +229,55 @@ def summarize(events: List[dict]) -> Dict[str, object]:
         out["serving"] = {
             "requests": len(term),
             "by_status": dict(sorted(by_status.items())),
-            "tokens_generated": sum(e.get("tokens", 0) for e in term),
+            "tokens_generated": sum(e.get("tokens") or 0
+                                    for e in term),
             "degradations": by_kind.get("engine_degraded", 0),
             "rejected": by_kind.get("request_rejected", 0),
         }
         out["slo"] = _slo_section(term)
-    tenants = _tenant_section(events)
+    tenants = _tenant_section(term + throttles)
     if tenants:
         out["tenants"] = tenants
-    journeys = _journeys_section(events)
-    if journeys:
-        out["journeys"] = journeys
-    alerts = _alerts_section(events)
+    if trace_event_count > journey_event_cap:
+        # HONEST skip: reconstructing journeys needs every
+        # trace-stamped event in memory at once — over the cap the
+        # section names the overflow instead of silently tabling a
+        # subset of requests
+        out["journeys"] = {
+            "skipped": f"{trace_event_count} trace-stamped events "
+                       f"exceed the {journey_event_cap}-event journey "
+                       f"hold — raise summarize(journey_event_cap=) "
+                       f"to reconstruct"}
+    else:
+        journeys = _journeys_section(trace_events)
+        if journeys:
+            out["journeys"] = journeys
+    alerts = _alerts_section(alert_ev + incident_ev,
+                             span_ts=(ts_min, ts_max))
     if alerts:
         out["alerts"] = alerts
-    incidents = _incidents_section(events)
+    incidents = _incidents_section(incident_ev)
     if incidents:
         out["incidents"] = incidents
-    prefix = _prefix_section(events)
-    if prefix:
-        out["prefix"] = prefix
-    kv_tier = _kv_tier_section(events)
+    prefix_sec = _prefix_section(prefix, snapshot)
+    if prefix_sec:
+        out["prefix"] = prefix_sec
+    kv_tier = _kv_tier_section(kv, migrate_ev, len(term),
+                               prefix["hits"], snapshot)
     if kv_tier:
         out["kv_tier"] = kv_tier
-    spec = _speculation_section(events)
+    spec = _speculation_section(spec_rounds, spec_fallbacks,
+                                spec_adjusts, spec_swaps)
     if spec:
         out["speculation"] = spec
-    faults = [e for e in events if e.get("kind") == "fault_injected"]
     if faults:
-        out["faults"] = [f'{e["fault"]}@{e["step"]}' for e in faults]
-    ckpt = _checkpoint_section(events)
+        out["faults"] = faults
+    ckpt = _checkpoint_section(ckpt_ev, snapshot)
     if ckpt:
         out["checkpoints"] = ckpt
-
-    snaps = [e for e in events if e.get("kind") == "metrics_snapshot"]
-    if snaps:
-        out["metrics"] = _digest_snapshot(snaps[-1]["snapshot"])
+    if snapshot is not None:
+        out["metrics"] = _digest_snapshot(snapshot)
+    out["timeline_tail"] = list(tail)
     return out
 
 
@@ -228,7 +366,9 @@ def _tenant_section(events: List[dict]) -> Optional[dict]:
     billed against, plus the tenant's throttle counts (token-bucket
     defers/sheds from the router's admission gate and kv_quota blocks
     from the engines). Only present when the run carried tenant
-    stamps; untagged terminals roll up under '(untagged)'."""
+    stamps; untagged terminals roll up under '(untagged)'. Accepts
+    any event list — summarize passes just the terminal + throttle
+    records its streaming pass kept."""
     term = [e for e in events if e.get("kind") == "request_terminal"]
     throttles = [e for e in events
                  if e.get("kind") == "tenant_throttled"]
@@ -260,7 +400,7 @@ def _journeys_section(events: List[dict]) -> Optional[dict]:
     if not journeys:
         return None
     table = []
-    for j in journeys:
+    for j in journeys[:_JOURNEY_TABLE_CAP]:
         table.append({
             "trace": j["trace"], "request": j["request"],
             "status": j["status"], "tokens": j["tokens"],
@@ -271,23 +411,37 @@ def _journeys_section(events: List[dict]) -> Optional[dict]:
                  "dwell_s": h["dwell_s"]} for h in j["hops"]],
             "lost_hops": j["lost_hops"],
         })
-    return {"summary": summarize_journeys(journeys), "table": table}
+    out = {"summary": summarize_journeys(journeys), "table": table}
+    if len(journeys) > _JOURNEY_TABLE_CAP:
+        # summary covers ALL journeys; the per-request table is capped
+        # — name the overflow (no-silent-caps)
+        out["table_more"] = len(journeys) - _JOURNEY_TABLE_CAP
+    return out
 
 
-def _alerts_section(events: List[dict]) -> Optional[dict]:
+def _alerts_section(events: List[dict],
+                    span_ts: Optional[tuple] = None) -> Optional[dict]:
     """Alerts / SLO digest (ISSUE 14): the firing→resolved timeline
     reconstructed from `alert_firing`/`alert_resolved` events
     (obs/slo.py), per-objective compliance over the run (time spent
     firing vs the event span), and cross-links to the flight-recorder
     bundles those firings dumped (incident_dump events whose
-    trigger_kind is alert_firing)."""
+    trigger_kind is alert_firing). `span_ts=(ts_min, ts_max)` lets the
+    streaming pass supply the WHOLE run's span without handing over
+    every event; without it the span is the passed events' ts extent
+    (the original list-mode behavior, pinned by test_slo)."""
     firing = [e for e in events if e.get("kind") == "alert_firing"]
     resolved = [e for e in events if e.get("kind") == "alert_resolved"]
     if not (firing or resolved):
         return None
-    ts = [e["ts"] for e in events
-          if isinstance(e.get("ts"), (int, float))]
-    span = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+    if span_ts is not None and span_ts[0] is not None:
+        lo, hi = span_ts
+        ts = [lo, hi]
+        span = hi - lo
+    else:
+        ts = [e["ts"] for e in events
+              if isinstance(e.get("ts"), (int, float))]
+        span = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
     timeline: List[dict] = []
     open_by_alert: Dict[str, dict] = {}
     for e in sorted(firing + resolved, key=lambda r: r.get("seq", 0)):
@@ -375,27 +529,24 @@ def _incidents_section(events: List[dict]) -> Optional[dict]:
     }
 
 
-def _prefix_section(events: List[dict]) -> Optional[dict]:
+def _prefix_section(acc: dict, snapshot: Optional[dict]
+                    ) -> Optional[dict]:
     """Prefix-cache digest (ISSUE 8): hit rate / tokens and bytes
     saved / pool occupancy, from the serving_prefix_* counters and the
     serving_kv_pool_blocks_in_use gauge of the last embedded
     metrics_snapshot, cross-checked against the raw prefix_hit /
     prefix_evict events (which carry per-hit matched token counts even
-    when no snapshot was logged)."""
-    hits_ev = [e for e in events if e.get("kind") == "prefix_hit"]
-    evict_ev = [e for e in events if e.get("kind") == "prefix_evict"]
-    snaps = [e for e in events if e.get("kind") == "metrics_snapshot"]
+    when no snapshot was logged). `acc` is summarize's streamed
+    hit/evict accumulator — the raw events are never held."""
     out: dict = {}
-    if hits_ev:
-        out["hits"] = len(hits_ev)
-        out["tokens_saved"] = sum(e.get("matched_tokens", 0)
-                                  for e in hits_ev)
-        out["blocks_reused"] = sum(e.get("blocks", 0) for e in hits_ev)
-    if evict_ev:
-        out["blocks_evicted"] = sum(e.get("blocks", 0)
-                                    for e in evict_ev)
-    if snaps:
-        metrics = snaps[-1]["snapshot"].get("metrics", {})
+    if acc["hits"]:
+        out["hits"] = acc["hits"]
+        out["tokens_saved"] = acc["tokens_saved"]
+        out["blocks_reused"] = acc["blocks_reused"]
+    if acc["evicts"]:
+        out["blocks_evicted"] = acc["blocks_evicted"]
+    if snapshot is not None:
+        metrics = snapshot.get("metrics", {})
 
         def total(name):
             fam = metrics.get(name)
@@ -425,25 +576,23 @@ def _prefix_section(events: List[dict]) -> Optional[dict]:
     return out or None
 
 
-def _kv_tier_section(events: List[dict]) -> Optional[dict]:
+def _kv_tier_section(acc: dict, migrate_ev: List[dict], n_term: int,
+                     n_hits: int, snapshot: Optional[dict]
+                     ) -> Optional[dict]:
     """Host spill-tier digest (ISSUE 16): spill/re-admit block flow
-    from the kv_spill / kv_readmit events, warm-state migrations from
-    prefix_migrate (source -> target paths), per-tier occupancy from
-    the serving_kv_tier_blocks_in_use gauge of the last embedded
-    metrics snapshot, and the hit-source split — a prefix hit whose
-    chain had spilled re-admits from host (one kv_readmit event per
-    re-admitted hit), the rest serve straight from the device tree,
-    and everything else prefilled cold (miss)."""
-    spill_ev = [e for e in events if e.get("kind") == "kv_spill"]
-    readmit_ev = [e for e in events if e.get("kind") == "kv_readmit"]
-    migrate_ev = [e for e in events
-                  if e.get("kind") == "prefix_migrate"]
-    if not (spill_ev or readmit_ev or migrate_ev):
+    from the kv_spill / kv_readmit events (streamed into `acc`),
+    warm-state migrations from prefix_migrate (source -> target
+    paths), per-tier occupancy from the serving_kv_tier_blocks_in_use
+    gauge of the last embedded metrics snapshot, and the hit-source
+    split — a prefix hit whose chain had spilled re-admits from host
+    (one kv_readmit event per re-admitted hit), the rest serve
+    straight from the device tree, and everything else prefilled cold
+    (miss)."""
+    if not (acc["spills"] or acc["readmits"] or migrate_ev):
         return None
     out: dict = {
-        "spilled_blocks": sum(e.get("blocks", 0) for e in spill_ev),
-        "readmitted_blocks": sum(e.get("blocks", 0)
-                                 for e in readmit_ev),
+        "spilled_blocks": acc["spilled_blocks"],
+        "readmitted_blocks": acc["readmitted_blocks"],
         "migrations": len(migrate_ev),
         "migrated_blocks": sum(e.get("blocks", 0) for e in migrate_ev),
     }
@@ -452,17 +601,14 @@ def _kv_tier_section(events: List[dict]) -> Optional[dict]:
             {"source": e.get("source"), "target": e.get("target"),
              "blocks": e.get("blocks"), "chains": e.get("chains")}
             for e in migrate_ev]
-    term = [e for e in events if e.get("kind") == "request_terminal"]
-    hits = sum(1 for e in events if e.get("kind") == "prefix_hit")
-    if term:
+    if n_term:
         out["hit_source"] = {
-            "host": len(readmit_ev),
-            "device": max(hits - len(readmit_ev), 0),
-            "miss": max(len(term) - hits, 0),
+            "host": acc["readmits"],
+            "device": max(n_hits - acc["readmits"], 0),
+            "miss": max(n_term - n_hits, 0),
         }
-    snaps = [e for e in events if e.get("kind") == "metrics_snapshot"]
-    if snaps:
-        occ = snaps[-1]["snapshot"].get("metrics", {}).get(
+    if snapshot is not None:
+        occ = snapshot.get("metrics", {}).get(
             "serving_kv_tier_blocks_in_use")
         if occ is not None:
             out["tier_blocks_in_use"] = {
@@ -472,29 +618,20 @@ def _kv_tier_section(events: List[dict]) -> Optional[dict]:
     return out
 
 
-def _speculation_section(events: List[dict]) -> Optional[dict]:
+def _speculation_section(per_engine: Dict[str, dict],
+                         fallbacks: List[dict], adjusts: List[dict],
+                         swaps: List[dict]) -> Optional[dict]:
     """Speculative-decoding digest (ISSUE 15): per-engine accept rate
-    and draft-overhead share from the `spec_verify` round events, plus
-    any `spec_fallback` degradations. `draft_overhead_share` is the
-    fraction of draft proposals whose compute bought no token (wasted
-    / proposed) — the price of misprediction; `tokens_per_round` is
-    the amortization the verify pass achieved (1.0 = no better than
-    target-only decode)."""
-    rounds = [e for e in events if e.get("kind") == "spec_verify"]
-    fallbacks = [e for e in events if e.get("kind") == "spec_fallback"]
-    adjusts = [e for e in events if e.get("kind") == "spec_k_adjust"]
-    swaps = [e for e in events if e.get("kind") == "draft_swap"]
-    if not (rounds or fallbacks or adjusts or swaps):
+    and draft-overhead share streamed from the `spec_verify` round
+    events (summarize accumulates them — verify rounds are per-token
+    volume, never held), plus any `spec_fallback` degradations.
+    `draft_overhead_share` is the fraction of draft proposals whose
+    compute bought no token (wasted / proposed) — the price of
+    misprediction; `tokens_per_round` is the amortization the verify
+    pass achieved (1.0 = no better than target-only decode)."""
+    if not (per_engine or fallbacks or adjusts or swaps):
         return None
-    per_engine: Dict[str, dict] = {}
-    for e in rounds:
-        eng = per_engine.setdefault(e.get("engine", "?"), {
-            "draft": e.get("draft_engine"), "rounds": 0, "proposed": 0,
-            "accepted": 0, "emitted": 0})
-        eng["rounds"] += 1
-        eng["proposed"] += e.get("proposed", 0)
-        eng["accepted"] += e.get("accepted", 0)
-        eng["emitted"] += e.get("emitted", 0)
+    per_engine = {k: dict(v) for k, v in per_engine.items()}
     for eng in per_engine.values():
         prop = eng["proposed"]
         eng["accept_rate"] = (round(eng["accepted"] / prop, 4)
@@ -539,7 +676,9 @@ def _speculation_section(events: List[dict]) -> Optional[dict]:
     return out
 
 
-def _checkpoint_section(events: List[dict]) -> Optional[dict]:
+def _checkpoint_section(events: List[dict],
+                        snapshot: Optional[dict] = None
+                        ) -> Optional[dict]:
     """Checkpoint digest (ISSUE 9): save cadence and durations from
     the enriched `checkpoint_save` events (`async`/`duration_s`/
     `shard`/`nshards` fields), load + corrupt-skip counts, and the
@@ -574,9 +713,8 @@ def _checkpoint_section(events: List[dict]) -> Optional[dict]:
         out["nshards"] = max(int(e.get("nshards", 1)) for e in units)
     if loads:
         out["sharded_loads"] = sum(1 for e in loads if e.get("sharded"))
-    snaps = [e for e in events if e.get("kind") == "metrics_snapshot"]
-    if snaps:
-        fam = snaps[-1]["snapshot"].get("metrics", {}).get(
+    if snapshot is not None:
+        fam = snapshot.get("metrics", {}).get(
             "training_checkpoint_seconds")
         if fam is not None:
             out["histogram"] = {
@@ -610,6 +748,21 @@ def _digest_snapshot(snapshot: dict) -> dict:
     return out
 
 
+_SECTION_ROW_CAP = 24  # rendered rows per section table
+
+
+def _capped(rows: List[tuple],
+            cap: int = _SECTION_ROW_CAP) -> List[tuple]:
+    """Cap a section's rendered rows with an HONEST footer naming how
+    many were dropped (no-silent-caps) — a million-request run must
+    not print a million per-engine lines, and must not pretend it
+    printed them all either."""
+    if len(rows) <= cap:
+        return rows
+    return rows[:cap] + [("…", f"{len(rows) - cap} more rows "
+                               f"not shown")]
+
+
 def _fmt_table(rows: List[tuple], indent: str = "  ") -> str:
     if not rows:
         return ""
@@ -617,13 +770,16 @@ def _fmt_table(rows: List[tuple], indent: str = "  ") -> str:
     return "\n".join(f"{indent}{str(k):<{w}}  {v}" for k, v in rows)
 
 
-def render(events: List[dict], tail: int = 15) -> str:
+def render(events, tail: int = 15) -> str:
+    """Render the report text from any event iterable (list or
+    `obs.stream_jsonl` generator — one pass either way). Every
+    section table is row-capped with an honest footer (_capped)."""
     s = summarize(events)
     lines = [f"telemetry report — {s['total_events']} events"]
     lines.append("\nevents by kind:")
-    lines.append(_fmt_table(
+    lines.append(_fmt_table(_capped(
         [(k + ("" if k in EVENT_KINDS else " [unregistered]"), n)
-         for k, n in sorted(s["by_kind"].items())]))
+         for k, n in sorted(s["by_kind"].items())])))
     if "training" in s:
         t = s["training"]
         lines.append("\ntraining:")
@@ -669,7 +825,7 @@ def render(events: List[dict], tail: int = 15) -> str:
             rows.append((tag, fmt_slo(d)))
         for layout, d in s["slo"].get("per_layout", {}).items():
             rows.append((layout, fmt_slo(d)))
-        lines.append(_fmt_table(rows))
+        lines.append(_fmt_table(_capped(rows)))
     if "tenants" in s:
         lines.append("\ntenants:")
         rows = []
@@ -687,32 +843,40 @@ def render(events: List[dict], tail: int = 15) -> str:
                                 f"  throttled {thr_txt}"))
             else:
                 rows.append((t, f"no terminals  throttled {thr_txt}"))
-        lines.append(_fmt_table(rows))
+        lines.append(_fmt_table(_capped(rows)))
     if "journeys" in s:
-        jm = s["journeys"]["summary"]
         lines.append("\nrequest journeys:")
-        lines.append(_fmt_table([
-            ("requests", jm["count"]),
-            ("complete", jm["complete"]),
-            ("cross-engine", jm["cross_engine"]),
-            ("cross-layout", jm["cross_layout"]),
-            ("max hops", jm["max_hops"]),
-            ("lost hops", jm["lost_hops"]),
-            ("superseded terminals", jm["superseded_terminals"])]))
-        rows = []
-        for j in s["journeys"]["table"][:20]:
-            path = " -> ".join(
-                f"{h['engine'] or '?'}"
-                + (f"[tp{h['tp']}]" if h["tp"] not in (None, 1) else "")
-                + (f"({h['dwell_s']:.3g}s)"
-                   if h["dwell_s"] is not None else "")
-                for h in j["hops"])
-            rows.append((j["trace"], f"{path} => {j['status']} "
-                                     f"({j['tokens']} tok)"))
-        if len(s["journeys"]["table"]) > 20:
-            rows.append(("...",
-                         f"{len(s['journeys']['table']) - 20} more"))
-        lines.append(_fmt_table(rows))
+        if "skipped" in s["journeys"]:
+            lines.append(f"  skipped: {s['journeys']['skipped']}")
+        else:
+            jm = s["journeys"]["summary"]
+            lines.append(_fmt_table([
+                ("requests", jm["count"]),
+                ("complete", jm["complete"]),
+                ("cross-engine", jm["cross_engine"]),
+                ("cross-layout", jm["cross_layout"]),
+                ("max hops", jm["max_hops"]),
+                ("lost hops", jm["lost_hops"]),
+                ("superseded terminals", jm["superseded_terminals"])]))
+            rows = []
+            for j in s["journeys"]["table"][:20]:
+                path = " -> ".join(
+                    f"{h['engine'] or '?'}"
+                    + (f"[tp{h['tp']}]"
+                       if h["tp"] not in (None, 1) else "")
+                    + (f"({h['dwell_s']:.3g}s)"
+                       if h["dwell_s"] is not None else "")
+                    for h in j["hops"])
+                rows.append((j["trace"], f"{path} => {j['status']} "
+                                         f"({j['tokens']} tok)"))
+            # the digest table is itself capped — count BOTH cuts in
+            # the footer so nothing is silently dropped
+            more = (len(s["journeys"]["table"]) - 20
+                    if len(s["journeys"]["table"]) > 20 else 0) \
+                + s["journeys"].get("table_more", 0)
+            if more:
+                rows.append(("…", f"{more} more rows not shown"))
+            lines.append(_fmt_table(rows))
     if "alerts" in s:
         al = s["alerts"]
         lines.append("\nalerts / SLO:")
@@ -736,7 +900,7 @@ def render(events: List[dict], tail: int = 15) -> str:
                 f"{rec['rule_kind']}) -> {state}"))
         for b in al.get("bundles", []):
             rows.append((b, "post-mortem bundle (slo_burn)"))
-        lines.append(_fmt_table(rows))
+        lines.append(_fmt_table(_capped(rows)))
     if "incidents" in s:
         inc = s["incidents"]
         lines.append("\nincidents (flight recorder):")
@@ -745,7 +909,7 @@ def render(events: List[dict], tail: int = 15) -> str:
                   f"{b['incident']} @ {b['component']} "
                   f"(trigger {b['trigger_kind']})")
                  for b in inc["bundles"]]
-        lines.append(_fmt_table(rows))
+        lines.append(_fmt_table(_capped(rows)))
     if "prefix" in s:
         p = s["prefix"]
         lines.append("\nprefix cache:")
@@ -754,7 +918,7 @@ def render(events: List[dict], tail: int = 15) -> str:
         if "pool_blocks_in_use" in p:
             rows += [(f"pool in use [{eng}]", v)
                      for eng, v in p["pool_blocks_in_use"].items()]
-        lines.append(_fmt_table(rows))
+        lines.append(_fmt_table(_capped(rows)))
     if "kv_tier" in s:
         kt = s["kv_tier"]
         lines.append("\nkv tier (host spill):")
@@ -774,7 +938,7 @@ def render(events: List[dict], tail: int = 15) -> str:
         for key, v in sorted(kt.get("tier_blocks_in_use",
                                     {}).items()):
             rows.append((f"tier in use [{key}]", v))
-        lines.append(_fmt_table(rows))
+        lines.append(_fmt_table(_capped(rows)))
     if "speculation" in s:
         sp = s["speculation"]
         lines.append("\nspeculative decoding:")
@@ -799,7 +963,7 @@ def render(events: List[dict], tail: int = 15) -> str:
             rows.append((f"{w['engine']} SWAP #{w['swap']}",
                          f"round {w['round']} ({w['source']}): "
                          f"accept {bef} -> {aft}"))
-        lines.append(_fmt_table(rows))
+        lines.append(_fmt_table(_capped(rows)))
         if sp.get("k_timeline"):
             kt = sp["k_timeline"]
             traj = " ".join(
@@ -823,7 +987,7 @@ def render(events: List[dict], tail: int = 15) -> str:
             rows.append((f"{mode} save (hist)",
                          f"n={h['count']} p50/p95="
                          f"{sec(h['p50_s'])}/{sec(h['p95_s'])}"))
-        lines.append(_fmt_table(rows))
+        lines.append(_fmt_table(_capped(rows)))
     if "metrics" in s:
         lines.append("\nmetrics (last snapshot):")
         rows = []
@@ -836,11 +1000,14 @@ def render(events: List[dict], tail: int = 15) -> str:
                                 f"p50/p95/p99={pcts}"))
             else:
                 rows.append((k, v))
-        lines.append(_fmt_table(rows))
-    if tail and events:
-        lines.append(f"\ntimeline (last {min(tail, len(events))}):")
+        lines.append(_fmt_table(_capped(rows, cap=64)))
+    tail_events = s.get("timeline_tail", [])
+    if tail and tail_events:
+        shown = tail_events[-min(tail, len(tail_events)):]
+        lines.append(f"\ntimeline (last {len(shown)} of "
+                     f"{s['total_events']}):")
         rows = []
-        for e in events[-tail:]:
+        for e in shown:
             extra = {k: v for k, v in e.items()
                      if k not in ("schema", "ts", "seq", "kind",
                                   "snapshot")}
@@ -861,24 +1028,30 @@ def main(argv=None) -> int:
                          "journeys as a Perfetto/chrome-trace JSON "
                          "(one track per request, obs/journey.py)")
     args = ap.parse_args(argv)
-    from bigdl_tpu.obs.events import read_jsonl
+    from bigdl_tpu.obs.events import stream_jsonl
 
+    # stream, never materialize: a 10⁶-event sim run summarizes in
+    # one pass with bounded holds (ISSUE 20)
     try:
-        events = read_jsonl(args.path)
+        text = render(stream_jsonl(args.path), tail=args.tail)
     except OSError as e:
         print(f"obs-report: cannot read {args.path}: {e}")
         return 2
-    if not events:
+    if text.startswith("telemetry report — 0 events"):
         print(f"obs-report: no events in {args.path}")
         return 2
-    print(render(events, tail=args.tail))
+    print(text)
     if args.perfetto:
         import json as _json
 
         from bigdl_tpu.obs.journey import build_journeys, to_perfetto
 
+        # second streaming pass: only the trace-stamped lifecycle
+        # events feed the journey builder
+        trace_events = [e for e in stream_jsonl(args.path)
+                        if e.get("trace") is not None]
         with open(args.perfetto, "w") as f:
-            _json.dump(to_perfetto(build_journeys(events)), f)
+            _json.dump(to_perfetto(build_journeys(trace_events)), f)
         print(f"\nperfetto journey tracks -> {args.perfetto}")
     return 0
 

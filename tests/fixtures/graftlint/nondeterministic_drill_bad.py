@@ -45,3 +45,18 @@ def alert_evaluate(rule, window_s):
     # report and bundle bytes) drift run to run
     fired_at = time.time()  # BAD
     return {"alert": rule, "fired_at": fired_at, "window_s": window_s}
+
+
+def compile_scenario(spec):
+    # ISSUE 20: scenario arrival draws from the global stream — the
+    # compiled trace differs run to run, so "two replays are
+    # byte-identical" is dead before the simulator even starts
+    times = np.random.exponential(0.25, spec["n"])  # BAD
+    return sorted(times)
+
+
+class SimulatedEngine:
+    def step(self):
+        # ISSUE 20: a wall-clock read inside the simulator mixes real
+        # milliseconds into the virtual-seconds timeline
+        return time.monotonic()  # BAD

@@ -56,3 +56,20 @@ class AlertEngine:
     def evaluate(self, rule, window_s):
         return {"alert": rule, "fired_at": self._clock(),
                 "window_s": window_s}
+
+
+def compile_scenario(spec):
+    # ISSUE 20: ONE seeded stream per compile — the trace is a pure
+    # function of the spec (same seed, same arrivals, every time)
+    rng = np.random.RandomState(spec["seed"])
+    return sorted(rng.exponential(0.25, spec["n"]))
+
+
+class SimulatedEngine:
+    # ISSUE 20: simulated time IS the injected clock — the ctor
+    # refuses clock=None, and every stamp reads self._clock()
+    def __init__(self, cost_model, clock):
+        self._model, self._clock = cost_model, clock
+
+    def step(self):
+        return self._clock()

@@ -74,7 +74,7 @@ def test_elastic_drill_leg(tmp_path, leg):
                                  "fleet_autoscale",
                                  "fleet_tp_failover",
                                  "fleet_journey", "slo_alert",
-                                 "tenant_noisy"])
+                                 "tenant_noisy", "scenario_chaos"])
 def test_serving_drill_leg(tmp_path, leg):
     """ISSUE 4 + ISSUE 7 + ISSUE 10 + ISSUE 11 + ISSUE 14: the
     serving-plane reliability drills (poisoned co-batch, overload
@@ -89,8 +89,11 @@ def test_serving_drill_leg(tmp_path, leg):
     target-only; a distilled hot-swapped draft resumes it) and the
     ISSUE 19 noisy-neighbor drill (a co-resident flood is throttled by
     its own token bucket while the quiet tenant's tokens stay bitwise
-    identical to a quiet-only run) run bit-deterministically on every
-    tier-1 pass.
+    identical to a quiet-only run) and the ISSUE 20 scenario-chaos
+    drill (a compiled chaos scenario — watchdog trip + tenant flood —
+    replayed twice through the calibrated simulator with report AND
+    flight-recorder bundle bytes identical) run bit-deterministically
+    on every tier-1 pass.
     Legs must actually DRILL here: the CPU-mesh conftest gives them 8
     devices, so the device-count skip escape is asserted shut."""
     fd = _load_drill()

@@ -1,0 +1,165 @@
+"""Scenario compiler (ISSUE 20): declarative workload shapes compile
+to the loadgen trace format deterministically — one seeded stream,
+validated specs, phases/chaos provenance, and the append-only chaos
+contract (adding a flood never perturbs the base traffic's draws)."""
+
+import json
+
+import pytest
+
+from bigdl_tpu.serving.scenarios import (BUILTIN_SCENARIOS,
+                                         compile_scenario,
+                                         list_scenarios,
+                                         load_scenario)
+
+
+def _arrival_key(a):
+    return (a.t, json.dumps(a.spec, sort_keys=True), a.session, a.turn)
+
+
+# ------------------------------------------------------------- builtins
+
+def test_builtins_compile_to_wellformed_traces():
+    """Every built-in compiles (scaled down — the acceptance scenario
+    is 1e5 requests) to a sorted, fully-typed trace with phases and
+    chaos timelines the replay loop can fire in order."""
+    assert list_scenarios() == sorted(BUILTIN_SCENARIOS)
+    for name in list_scenarios():
+        trace = compile_scenario(name, scale=0.01)
+        assert trace["name"] == name
+        ts = [a.t for a in trace["arrivals"]]
+        assert ts == sorted(ts) and len(ts) > 0
+        for a in trace["arrivals"]:
+            assert isinstance(a.spec["prompt"], list)
+            assert a.spec["max_new_tokens"] >= 1
+            assert 0 <= a.spec["seed"] < 2 ** 31
+        pts = [p["t"] for p in trace["phases"]]
+        assert pts == sorted(pts) and len(pts) >= 1
+        cts = [c["t"] for c in trace["chaos"]]
+        assert cts == sorted(cts)
+        declared = {t["name"] for t in trace["tenants"]}
+        for a in trace["arrivals"]:
+            if "tenant" in a.spec:
+                assert a.spec["tenant"] in declared
+
+
+def test_compile_is_deterministic():
+    """Two compiles of one spec are identical lists — the seeded
+    single-stream contract the 1e5-request byte-identity rides."""
+    for name in ("chaos_smoke", "flash_crowd", "agentic_sessions"):
+        t1 = compile_scenario(name, scale=0.5)
+        t2 = compile_scenario(name, scale=0.5)
+        assert [_arrival_key(a) for a in t1["arrivals"]] \
+            == [_arrival_key(a) for a in t2["arrivals"]]
+        assert t1["phases"] == t2["phases"]
+        assert t1["chaos"] == t2["chaos"]
+        assert t1["sessions"] == t2["sessions"]
+
+
+def test_scale_shrinks_every_shape_and_flood():
+    full = compile_scenario("chaos_smoke")
+    tiny = compile_scenario("chaos_smoke", scale=0.25)
+    assert len(tiny["arrivals"]) < len(full["arrivals"])
+    # 96-request steady + 48-request flood at quarter scale
+    assert len(tiny["arrivals"]) == 24 + 12
+    with pytest.raises(ValueError, match="scale"):
+        compile_scenario("chaos_smoke", scale=0.0)
+
+
+def test_flood_appends_without_perturbing_base_draws():
+    """Chaos floods draw AFTER the shapes: the base traffic of a
+    spec-with-flood is the spec-without-flood's traffic verbatim, so
+    A/B-ing a chaos schedule changes only the injected arrivals."""
+    spec = load_scenario("chaos_smoke")
+    base_spec = json.loads(json.dumps(spec))
+    base_spec["chaos"] = [c for c in base_spec["chaos"]
+                          if c["action"] != "tenant_flood"]
+    with_flood = compile_scenario(spec)
+    without = compile_scenario(base_spec)
+    base_keys = [_arrival_key(a) for a in without["arrivals"]]
+    flood_keys = [_arrival_key(a) for a in with_flood["arrivals"]]
+    assert len(flood_keys) == len(base_keys) + 48
+    for k in base_keys:                  # every base arrival survives
+        assert k in flood_keys
+    extra = list(flood_keys)
+    for k in base_keys:
+        extra.remove(k)
+    assert all(json.loads(k[1])["tenant"] == "tenant1" for k in extra)
+
+
+def test_sessions_shape_builds_continuations():
+    trace = compile_scenario("agentic_sessions", scale=0.5)
+    sess = trace["sessions"]
+    assert sess["count"] >= 1 and sess["turns"] >= 2
+    assert set(sess["continuations"]) == set(range(sess["count"]))
+    for blocks in sess["continuations"].values():
+        assert len(blocks) == sess["turns"] - 1
+    heads = [a for a in trace["arrivals"] if a.session is not None]
+    assert len(heads) == sess["count"]
+    assert all(a.turn == 0 for a in heads)
+
+
+def test_diurnal_phases_partition_the_day():
+    trace = compile_scenario("diurnal_noisy", scale=0.01)
+    labels = [p["name"] for p in trace["phases"]]
+    assert labels == ["diurnal:trough", "diurnal:ramp",
+                      "diurnal:peak", "diurnal:decay"]
+    n_flood = next(c for c in trace["chaos"]
+                   if c["action"] == "tenant_flood")
+    # phase counts partition the diurnal arrivals (floods excluded)
+    assert sum(p["arrivals"] for p in trace["phases"]) \
+        == len(trace["arrivals"]) - 20      # 2000-request flood @1%
+    assert n_flood["target"] == "tenant1"
+    # the curve maximum sits at the ramp/peak boundary: the middle
+    # half of the day must far outweigh the trough/decay quarters
+    by_name = {p["name"]: p["arrivals"] for p in trace["phases"]}
+    assert by_name["diurnal:ramp"] + by_name["diurnal:peak"] \
+        > 2 * (by_name["diurnal:trough"] + by_name["diurnal:decay"])
+
+
+# ----------------------------------------------------------- validation
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="unknown scenario"):
+        load_scenario("nope_not_a_scenario")
+    with pytest.raises(ValueError, match="shapes"):
+        compile_scenario({"seed": 0})
+    with pytest.raises(ValueError, match="shape kind"):
+        compile_scenario({"shapes": [{"kind": "sawtooth", "n": 4}]})
+    with pytest.raises(ValueError, match="undeclared"):
+        compile_scenario({"shapes": [
+            {"kind": "steady", "n": 4, "rate": 1.0,
+             "tenant_mix": {"ghost": 1.0}}]})
+    with pytest.raises(ValueError, match="chaos action"):
+        compile_scenario({"shapes": [{"kind": "steady", "n": 4}],
+                          "chaos": [{"t": 1.0, "action": "meteor"}]})
+    with pytest.raises(ValueError, match="tenant_flood"):
+        compile_scenario({"shapes": [{"kind": "steady", "n": 4}],
+                          "chaos": [{"t": 1.0,
+                                     "action": "tenant_flood"}]})
+    with pytest.raises(ValueError, match="regions"):
+        compile_scenario({"shapes": [{"kind": "regional_wave"}]})
+    with pytest.raises(ValueError, match="target"):
+        compile_scenario({"shapes": [{"kind": "steady", "n": 4}],
+                          "chaos": [{"t": 1.0,
+                                     "action": "watchdog_trip"}]})
+    with pytest.raises(ValueError, match="one sessions shape"):
+        compile_scenario({"shapes": [
+            {"kind": "sessions", "count": 2},
+            {"kind": "sessions", "count": 2}]})
+    with pytest.raises(ValueError, match="name"):
+        compile_scenario({"tenants": [{"weight": 1.0}],
+                          "shapes": [{"kind": "steady", "n": 4}]})
+
+
+def test_json_spec_roundtrip(tmp_path):
+    """A spec file compiles exactly like its dict — the
+    `loadgen.py --scenario path.json` input path."""
+    spec = load_scenario("chaos_smoke")
+    p = tmp_path / "scenario.json"
+    p.write_text(json.dumps(spec))
+    t1 = compile_scenario(str(p))
+    t2 = compile_scenario(spec)
+    assert [_arrival_key(a) for a in t1["arrivals"]] \
+        == [_arrival_key(a) for a in t2["arrivals"]]
+    assert t1["chaos"] == t2["chaos"]

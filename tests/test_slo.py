@@ -78,14 +78,18 @@ def test_sampler_ring_capacity_and_tick_rate_limit():
         ctr.inc()
         assert sampler.tick() is not None
     assert len(sampler) == 4                    # ring bound
-    # oldest samples rolled off: the window now starts at t=3
+    # oldest samples rolled off the RING: the sample list starts at
+    # t=3, but whole-run queries keep the first-sample baseline
     assert sampler.samples()[0]["t"] == 3.0
     assert sampler.latest()["t"] == 6.0
     # window selection is by sample time relative to the newest
     assert [s["t"] for s in sampler.samples(window_s=2.0)] \
         == [4.0, 5.0, 6.0]
-    # delta/rate over the full ring and over a window
-    assert sampler.delta("x_total") == 3.0      # counts 3 → 6
+    # whole-run delta/rate anchor at the never-evicted baseline
+    # (t=0, count 0) — eviction must not silently turn "whole run"
+    # into "last capacity samples" (sim-found truncation, ISSUE 20)
+    assert sampler.span()[0]["t"] == 0.0
+    assert sampler.delta("x_total") == 6.0      # counts 0 → 6
     assert sampler.rate("x_total") == pytest.approx(1.0)
     assert sampler.delta("x_total", window_s=1.0) == 1.0
     # a family absent from the newest sample → None; absent series
@@ -95,6 +99,59 @@ def test_sampler_ring_capacity_and_tick_rate_limit():
         MetricsSampler(reg, capacity=1)
     with pytest.raises(ValueError):
         MetricsSampler(reg, interval_s=-1.0)
+
+
+def test_whole_run_queries_survive_ring_roll():
+    """Regression for the ISSUE 20 sim-found control-plane bug: a
+    10^5-request scenario ticks the sampler thousands of times past
+    `capacity`, and every `window_s=None` ("whole run" by contract)
+    query used to diff against the oldest SURVIVING ring sample —
+    loadgen's end-of-run SLO compliance silently summarized only the
+    tail of the run. With the never-evicted first-sample baseline,
+    whole-run deltas/quantiles/error budgets count from the actual
+    start after the ring rolls, while bounded windows still read only
+    the ring. Real components throughout (registry, sampler,
+    SLOObjective) — the fix must hold outside the simulator."""
+    clk, c = _clock()
+    reg = obs.set_registry(obs.MetricsRegistry(clock=c))
+    ctr = reg.counter("serving_requests_total", "", ("status",))
+    h = reg.histogram("req_latency_seconds",
+                      buckets=(0.1, 1.0, 10.0, 100.0))
+    sampler = MetricsSampler(reg, interval_s=0.0, capacity=4, clock=c)
+    sampler.sample()                      # the t=0 baseline
+    for _ in range(20):                   # bad, slow head ...
+        clk["t"] += 1.0
+        ctr.labels(status="shed").inc()
+        h.observe(50.0)
+        sampler.sample()
+    for _ in range(20):                   # ... clean fast tail fills
+        clk["t"] += 1.0                   # the whole ring
+        ctr.labels(status="done").inc()
+        h.observe(0.05)
+        sampler.sample()
+    assert len(sampler) == 4              # ring rolled long ago
+    # whole-run endpoints: the baseline survives eviction
+    old, new = sampler.span()
+    assert old["t"] == 0.0 and new["t"] == 40.0
+    assert sampler.delta("serving_requests_total",
+                         labels={"status": "shed"}) == 20.0
+    deltas = dict((k["status"], v) for k, v in
+                  sampler.series_deltas("serving_requests_total"))
+    assert deltas == {"done": 20.0, "shed": 20.0}
+    # whole-run error budget sees the bad head (50% shed), and the
+    # whole-run p75 lands in the slow head's bucket — a truncated
+    # window would report the clean tail's <= 0.1
+    obj = SLOObjective(name="goodput", kind="error_budget",
+                       metric="serving_requests_total", target=0.05)
+    assert obj.measure(sampler) == pytest.approx(0.5)
+    assert obj.violated(obj.measure(sampler))
+    p75 = sampler.window_quantile("req_latency_seconds", 0.75)
+    assert p75 is not None and p75 > 1.0        # head not forgotten
+    # bounded windows are untouched: the last 3 samples are all clean
+    assert sampler.delta("serving_requests_total",
+                         labels={"status": "shed"}, window_s=3.0) == 0.0
+    assert sampler.window_quantile("req_latency_seconds", 0.99,
+                                   window_s=3.0) <= 0.1
 
 
 def test_sampler_series_deltas_and_error_budget():
